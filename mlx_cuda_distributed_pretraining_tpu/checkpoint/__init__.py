@@ -1,0 +1,4 @@
+from .safetensors_io import load_safetensors, save_safetensors
+from .manager import CheckpointManager
+
+__all__ = ["load_safetensors", "save_safetensors", "CheckpointManager"]

@@ -1,0 +1,190 @@
+"""Run-directory checkpoint manager.
+
+Reproduces the reference's ``runs/`` layout exactly (reference:
+core/training.py:169-195, 1347-1394) so downstream tools (plotting, export,
+model CLI) work unchanged:
+
+    runs/<name>/
+        log.txt
+        config.yaml
+        metadata.json          # append-only ledger of checkpoints
+        tokenizer/
+        checkpoints/
+            step_<N>_model.safetensors
+            step_<N>_optimizer.safetensors
+            step_<N>_state.json
+
+Arrays are gathered to host on save; optimizer state is stored as a
+flattened safetensors file plus a JSON sidecar for non-array leaves.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..utils.tree import flatten_dict, unflatten_dict
+from .safetensors_io import load_safetensors, save_safetensors
+
+
+def _to_numpy_tree(tree: Any) -> Any:
+    return jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+
+class CheckpointManager:
+    def __init__(self, run_dir: str):
+        self.run_dir = run_dir
+        self.checkpoint_dir = os.path.join(run_dir, "checkpoints")
+
+    # -- run dir lifecycle --------------------------------------------------
+    @staticmethod
+    def setup_run_directory(runs_root: str, name: str, overwrite: bool = False) -> str:
+        run_dir = os.path.join(runs_root, name)
+        if os.path.exists(run_dir):
+            if not overwrite:
+                raise ValueError(
+                    f"Run directory {run_dir!r} already exists; set overwrite: true "
+                    "or choose a unique run name"
+                )
+            shutil.rmtree(run_dir)
+        os.makedirs(os.path.join(run_dir, "checkpoints"), exist_ok=True)
+        return run_dir
+
+    # -- paths --------------------------------------------------------------
+    def paths_for_step(self, step) -> Tuple[str, str, str]:
+        base = os.path.join(self.checkpoint_dir, f"step_{step}")
+        return (f"{base}_model.safetensors", f"{base}_optimizer.safetensors", f"{base}_state.json")
+
+    # -- save ---------------------------------------------------------------
+    def save(
+        self,
+        step,
+        params: Any,
+        opt_state: Optional[Any] = None,
+        training_state: Optional[Dict[str, Any]] = None,
+        metadata_extra: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, str]:
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        model_path, opt_path, state_path = self.paths_for_step(step)
+
+        flat_params = flatten_dict(_to_numpy_tree(params))
+        save_safetensors(model_path, flat_params, metadata={"format": "pt"})
+
+        if opt_state is not None:
+            flat_opt = flatten_dict(_to_numpy_tree(opt_state))
+            arrays = {k: v for k, v in flat_opt.items() if isinstance(v, np.ndarray)}
+            scalars = {
+                k: (v.item() if isinstance(v, np.generic) else v)
+                for k, v in flat_opt.items()
+                if not isinstance(v, np.ndarray)
+            }
+            save_safetensors(opt_path, arrays, metadata={"scalars": json.dumps(scalars)})
+
+        training_state = dict(training_state or {})
+        training_state.setdefault("step", int(step) if str(step).isdigit() else step)
+        with open(state_path, "w") as f:
+            json.dump(training_state, f, indent=2)
+
+        self._append_metadata(step, model_path, metadata_extra)
+        return {"model": model_path, "optimizer": opt_path, "state": state_path}
+
+    def _append_metadata(self, step, model_path: str, extra: Optional[Dict[str, Any]]) -> None:
+        meta_path = os.path.join(self.run_dir, "metadata.json")
+        ledger: Dict[str, Any] = {}
+        if os.path.exists(meta_path):
+            try:
+                with open(meta_path) as f:
+                    ledger = json.load(f)
+            except (json.JSONDecodeError, OSError):
+                ledger = {}
+        entries = ledger.setdefault("checkpoints", [])
+        entry = {"step": step, "path": model_path, "timestamp": time.time()}
+        if extra:
+            entry.update(extra)
+        entries.append(entry)
+        with open(meta_path, "w") as f:
+            json.dump(ledger, f, indent=2)
+
+    # -- load ---------------------------------------------------------------
+    def load(
+        self, step, like_params: Optional[Any] = None, like_opt_state: Optional[Any] = None
+    ) -> Tuple[Any, Optional[Any], Dict[str, Any]]:
+        model_path, opt_path, state_path = self.paths_for_step(step)
+        params = self.load_params(model_path, like=like_params)
+
+        opt_state = None
+        if like_opt_state is not None and os.path.exists(opt_path):
+            arrays, meta = load_safetensors(opt_path)
+            scalars = json.loads(meta.get("scalars", "{}"))
+            flat = dict(arrays)
+            flat.update(scalars)
+            like_flat = flatten_dict(_to_numpy_tree(like_opt_state))
+            rebuilt = {}
+            for k, ref in like_flat.items():
+                if k in flat:
+                    v = flat[k]
+                    if isinstance(ref, np.ndarray) and isinstance(v, np.ndarray):
+                        rebuilt[k] = v.astype(ref.dtype).reshape(ref.shape)
+                    else:
+                        rebuilt[k] = type(ref)(v) if not isinstance(v, np.ndarray) else v
+                else:
+                    rebuilt[k] = ref
+            nested = unflatten_dict(rebuilt)
+            opt_state = _restructure_like(like_opt_state, nested)
+
+        training_state: Dict[str, Any] = {}
+        if os.path.exists(state_path):
+            with open(state_path) as f:
+                training_state = json.load(f)
+        return params, opt_state, training_state
+
+    @staticmethod
+    def load_params(model_path: str, like: Optional[Any] = None) -> Any:
+        """Tolerant load (reference: models/llama.py:414-477): extra keys in
+        the file are dropped, missing keys keep the ``like`` value."""
+        arrays, _ = load_safetensors(model_path)
+        nested = unflatten_dict(arrays)
+        if like is None:
+            return nested
+        like_flat = flatten_dict(_to_numpy_tree(like))
+        out = {}
+        for k, ref in like_flat.items():
+            if k in arrays:
+                out[k] = arrays[k].astype(ref.dtype).reshape(ref.shape)
+            else:
+                out[k] = ref
+        return _restructure_like(like, unflatten_dict(out))
+
+    def latest_step(self) -> Optional[str]:
+        """Highest numeric step with a model file, or "final" if present."""
+        if not os.path.isdir(self.checkpoint_dir):
+            return None
+        steps = []
+        has_final = False
+        for fname in os.listdir(self.checkpoint_dir):
+            if fname.endswith("_model.safetensors") and fname.startswith("step_"):
+                tag = fname[len("step_"):-len("_model.safetensors")]
+                if tag == "final":
+                    has_final = True
+                elif tag.isdigit():
+                    steps.append(int(tag))
+        if has_final:
+            return "final"
+        return str(max(steps)) if steps else None
+
+
+def _restructure_like(like: Any, nested_dict: Any) -> Any:
+    """Map a nested plain-dict (string keys, possibly stringified list
+    indices) back onto the structure of ``like`` (dicts/lists/tuples)."""
+    if isinstance(like, dict):
+        return {k: _restructure_like(v, nested_dict[k]) for k, v in like.items()}
+    if isinstance(like, (list, tuple)):
+        vals = [_restructure_like(v, nested_dict[str(i)]) for i, v in enumerate(like)]
+        return type(like)(vals) if isinstance(like, tuple) else vals
+    return nested_dict

@@ -1,0 +1,95 @@
+"""Pure-Python safetensors reader/writer.
+
+The reference relies on ``mx.save_safetensors`` (reference:
+core/training.py:1351); here the format is implemented directly so
+checkpoints interoperate with the safetensors ecosystem (HF, mlx-lm) with no
+native dependency. Format: ``u64le header_len | header JSON | raw tensor
+bytes``; each header entry maps name -> {dtype, shape, data_offsets}.
+
+bfloat16 is supported via ``ml_dtypes`` (ships with jaxlib).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+import ml_dtypes
+import numpy as np
+
+_DTYPE_TO_ST = {
+    np.dtype(np.float64): "F64",
+    np.dtype(np.float32): "F32",
+    np.dtype(np.float16): "F16",
+    np.dtype(ml_dtypes.bfloat16): "BF16",
+    np.dtype(np.int64): "I64",
+    np.dtype(np.int32): "I32",
+    np.dtype(np.int16): "I16",
+    np.dtype(np.int8): "I8",
+    np.dtype(np.uint8): "U8",
+    np.dtype(np.uint16): "U16",
+    np.dtype(np.uint32): "U32",
+    np.dtype(np.uint64): "U64",
+    np.dtype(np.bool_): "BOOL",
+    np.dtype(ml_dtypes.float8_e4m3fn): "F8_E4M3",
+    np.dtype(ml_dtypes.float8_e5m2): "F8_E5M2",
+}
+_ST_TO_DTYPE = {v: k for k, v in _DTYPE_TO_ST.items()}
+
+
+def save_safetensors(
+    path: str,
+    tensors: Dict[str, np.ndarray],
+    metadata: Optional[Dict[str, str]] = None,
+) -> None:
+    """Write ``tensors`` (flat dict of numpy arrays) to ``path``."""
+    header: Dict[str, Any] = {}
+    if metadata:
+        header["__metadata__"] = {str(k): str(v) for k, v in metadata.items()}
+
+    blobs = []
+    offset = 0
+    for name in sorted(tensors):
+        arr = np.ascontiguousarray(tensors[name])
+        st_dtype = _DTYPE_TO_ST.get(arr.dtype)
+        if st_dtype is None:
+            raise ValueError(f"unsupported dtype {arr.dtype} for tensor {name!r}")
+        data = arr.tobytes()
+        header[name] = {
+            "dtype": st_dtype,
+            "shape": list(arr.shape),
+            "data_offsets": [offset, offset + len(data)],
+        }
+        blobs.append(data)
+        offset += len(data)
+
+    header_bytes = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    # Pad header to 8-byte alignment (spec allows trailing spaces).
+    pad = (8 - len(header_bytes) % 8) % 8
+    header_bytes += b" " * pad
+
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(header_bytes)))
+        f.write(header_bytes)
+        for data in blobs:
+            f.write(data)
+
+
+def load_safetensors(path: str) -> Tuple[Dict[str, np.ndarray], Dict[str, str]]:
+    """Read ``path`` → (tensors dict, metadata dict)."""
+    with open(path, "rb") as f:
+        (header_len,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(header_len).decode("utf-8"))
+        body = f.read()
+
+    metadata = header.pop("__metadata__", {}) or {}
+    tensors: Dict[str, np.ndarray] = {}
+    for name, info in header.items():
+        dtype = _ST_TO_DTYPE.get(info["dtype"])
+        if dtype is None:
+            raise ValueError(f"unsupported safetensors dtype {info['dtype']!r}")
+        begin, end = info["data_offsets"]
+        arr = np.frombuffer(body[begin:end], dtype=dtype)
+        tensors[name] = arr.reshape(info["shape"]).copy()
+    return tensors, metadata

@@ -1,0 +1,327 @@
+"""YAML config schema.
+
+Mirrors the reference's config surface (reference: core/training.py:52-167)
+so that its 58 config YAMLs port nearly verbatim: top-level sections
+``data / model / training / logging / system / resume`` plus ``name`` and
+``overwrite``. TPU-specific additions live under ``system.mesh`` (device mesh
+axis sizes) and ``model.attention.attention_type``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+
+def _get(d: Optional[Dict[str, Any]], key: str, default: Any = None) -> Any:
+    if d is None:
+        return default
+    v = d.get(key, default)
+    return default if v is None else v
+
+
+@dataclass
+class DataConfig:
+    """Section ``data`` (reference: core/training.py:53-60)."""
+
+    input_file: Optional[str] = None
+    preprocessing: Dict[str, Any] = field(default_factory=dict)
+    tokenizer: Dict[str, Any] = field(default_factory=dict)
+    tokenizer_path: Optional[str] = None
+    validation_file: Optional[str] = None
+    weight_path: Optional[str] = None
+    # TPU additions: streaming sources ("jsonl" | "hf_stream" | "synthetic")
+    source: str = "jsonl"
+    streaming: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def max_context_size(self) -> int:
+        return int(_get(self.preprocessing, "max_context_size", 1024))
+
+    @property
+    def chunk_overlap(self) -> int:
+        return int(_get(self.preprocessing, "chunk_overlap", 0))
+
+
+@dataclass
+class ModelConfig:
+    """Section ``model`` (reference: core/training.py:62-68)."""
+
+    architecture: str = "llama"
+    dimensions: Dict[str, Any] = field(default_factory=dict)
+    attention: Dict[str, Any] = field(default_factory=dict)
+    normalization: Dict[str, Any] = field(default_factory=dict)
+    rope: Dict[str, Any] = field(default_factory=dict)
+    misc: Dict[str, Any] = field(default_factory=dict)
+    moe: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def hidden_size(self) -> int:
+        return int(_get(self.dimensions, "hidden_size", 128))
+
+    @property
+    def intermediate_size(self) -> int:
+        return int(_get(self.dimensions, "intermediate_size", 4 * self.hidden_size))
+
+    @property
+    def num_layers(self) -> int:
+        return int(_get(self.dimensions, "num_layers", 4))
+
+    @property
+    def num_heads(self) -> int:
+        return int(_get(self.attention, "num_heads", 8))
+
+    @property
+    def num_kv_heads(self) -> int:
+        return int(_get(self.attention, "num_kv_heads", self.num_heads))
+
+    @property
+    def head_dim(self) -> int:
+        return int(_get(self.attention, "head_dim", self.hidden_size // self.num_heads))
+
+    @property
+    def attention_type(self) -> str:
+        """"simple" | "flash" | "flex" — dispatch mirrors reference
+        models/llama.py:181-209 (flex > flash > simple)."""
+        if _get(self.attention, "use_flex_attention", False):
+            return "flex"
+        if _get(self.attention, "use_flash_attention", False):
+            return "flash"
+        return str(_get(self.attention, "attention_type", "simple"))
+
+
+@dataclass
+class TrainingConfig:
+    """Section ``training`` (reference: core/training.py:70-89)."""
+
+    hyperparameters: Dict[str, Any] = field(default_factory=dict)
+    scheduler: Dict[str, Any] = field(default_factory=dict)
+    optimization: Dict[str, Any] = field(default_factory=dict)
+    epochs: Optional[int] = None
+    early_stopping: Dict[str, Any] = field(
+        default_factory=lambda: {
+            "enabled": False,
+            "patience": 3,
+            "min_delta": 0.001,
+            "metric": "val_loss",
+            "mode": "min",
+        }
+    )
+    lr_finder: Dict[str, Any] = field(
+        default_factory=lambda: {
+            "enabled": False,
+            "min_lr": 1e-7,
+            "max_lr": 1.0,
+            "num_steps": 100,
+        }
+    )
+
+    @property
+    def batch_size(self) -> int:
+        return int(_get(self.hyperparameters, "batch_size", 16))
+
+    @property
+    def learning_rate(self) -> float:
+        return float(_get(self.hyperparameters, "learning_rate", 3e-4))
+
+    @property
+    def weight_decay(self) -> float:
+        return float(_get(self.hyperparameters, "weight_decay", 0.0))
+
+    @property
+    def iters(self) -> Optional[int]:
+        v = _get(self.hyperparameters, "iters", None)
+        return None if v is None else int(v)
+
+    @property
+    def gradient_clip(self) -> Optional[float]:
+        v = _get(self.hyperparameters, "gradient_clip", None)
+        return None if v is None else float(v)
+
+    @property
+    def gradient_accumulation_steps(self) -> int:
+        return int(_get(self.hyperparameters, "gradient_accumulation_steps", 1))
+
+    @property
+    def optimizer_name(self) -> str:
+        return str(_get(self.optimization, "optimizer", "adamw")).lower()
+
+
+@dataclass
+class LoggingConfig:
+    """Section ``logging`` (reference: core/training.py:91-106)."""
+
+    log_dir: str = "logs"
+    checkpoint_dir: str = "checkpoints"
+    steps: Dict[str, Any] = field(default_factory=dict)
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    tensorboard: bool = False
+    wandb: bool = False
+    wandb_project: Optional[str] = None
+    wandb_entity: Optional[str] = None
+    log_memory_usage: bool = False
+    log_gradient_norm: bool = False
+    log_parameter_norm: bool = False
+    log_samples: bool = False
+    log_samples_count: int = 3
+
+    @property
+    def logging_interval(self) -> int:
+        return int(_get(self.steps, "logging_interval", 1))
+
+    @property
+    def checkpoint_interval(self) -> int:
+        return int(_get(self.steps, "checkpoint_interval", 1000))
+
+    @property
+    def validation_interval(self) -> int:
+        return int(_get(self.steps, "validation_interval", 0))
+
+
+@dataclass
+class SystemConfig:
+    """Section ``system`` (reference: core/training.py:108-122).
+
+    ``distributed/devices/cuda_devices`` are accepted for config compatibility
+    but the execution model is SPMD over ``mesh`` — there is no thread-queue
+    device manager to configure.
+    """
+
+    seed: int = 42
+    device: str = "tpu"
+    distributed: bool = False
+    devices: Optional[List[str]] = None
+    cuda_devices: Optional[List[int]] = None
+    memory_limit: Optional[int] = None
+    mixed_precision: bool = False
+    precision: str = "bfloat16"
+    gradient_checkpointing: bool = False
+    gradient_checkpointing_ratio: float = 0.5
+    model_parallel: bool = False
+    model_parallel_size: int = 1
+    zero_optimization_level: int = 0
+    # TPU-native: named mesh axis sizes, e.g. {dp: 4, tp: 2, sp: 1}.
+    # -1 on the dp axis means "all remaining devices".
+    mesh: Dict[str, int] = field(default_factory=dict)
+    # Ring/blockwise sequence parallelism (context parallel) over the sp axis.
+    sequence_parallel: bool = False
+    # Rematerialization policy: "none" | "full" | "dots" (overrides
+    # gradient_checkpointing when set).
+    remat: Optional[str] = None
+
+    @property
+    def compute_dtype(self) -> str:
+        if not self.mixed_precision:
+            return "float32"
+        # float16 requested by legacy configs is mapped to bfloat16: TPUs have
+        # native bf16 MXU support and no fp16 fast path.
+        return "bfloat16"
+
+
+@dataclass
+class ResumeConfig:
+    """Section ``resume`` (reference: core/training.py:124-127)."""
+
+    checkpoint: str = ""
+    reset_optimizer: bool = False
+    reset_training_state: bool = False
+
+
+_SECTION_TYPES = {
+    "data": DataConfig,
+    "model": ModelConfig,
+    "training": TrainingConfig,
+    "logging": LoggingConfig,
+    "system": SystemConfig,
+}
+
+
+def _build_section(cls, raw: Optional[Dict[str, Any]]):
+    raw = dict(raw or {})
+    names = {f.name for f in dataclasses.fields(cls)}
+    known = {k: v for k, v in raw.items() if k in names}
+    # Unknown keys are preserved rather than rejected so forward-compatible
+    # configs load (the reference raises TypeError on unknown keys; we're
+    # deliberately more tolerant and stash extras).
+    extras = {k: v for k, v in raw.items() if k not in names}
+    obj = cls(**known)
+    if extras:
+        object.__setattr__(obj, "_extras", extras)
+    return obj
+
+
+@dataclass
+class Config:
+    """Top-level config (reference: core/training.py:129-167)."""
+
+    name: str
+    data: DataConfig = field(default_factory=DataConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    training: TrainingConfig = field(default_factory=TrainingConfig)
+    logging: LoggingConfig = field(default_factory=LoggingConfig)
+    system: SystemConfig = field(default_factory=SystemConfig)
+    resume: Optional[ResumeConfig] = None
+    overwrite: bool = False
+
+    @classmethod
+    def from_dict(cls, config_dict: Dict[str, Any]) -> "Config":
+        if "name" not in config_dict:
+            raise ValueError("Config must specify a 'name' field at the top level")
+        sections = {
+            key: _build_section(typ, config_dict.get(key))
+            for key, typ in _SECTION_TYPES.items()
+        }
+        resume = None
+        if config_dict.get("resume"):
+            resume = _build_section(ResumeConfig, config_dict["resume"])
+        return cls(
+            name=config_dict["name"],
+            overwrite=bool(config_dict.get("overwrite", False)),
+            resume=resume,
+            **sections,
+        )
+
+    @classmethod
+    def from_yaml(cls, yaml_path: str) -> "Config":
+        with open(yaml_path, "r") as f:
+            config_dict = yaml.safe_load(f)
+        return cls.from_dict(config_dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"name": self.name, "overwrite": self.overwrite}
+        for key in _SECTION_TYPES:
+            section = getattr(self, key)
+            d = dataclasses.asdict(section)
+            d.update(getattr(section, "_extras", {}))
+            out[key] = d
+        if self.resume is not None:
+            out["resume"] = dataclasses.asdict(self.resume)
+        return out
+
+    def to_yaml(self, path: str) -> None:
+        with open(path, "w") as f:
+            yaml.safe_dump(self.to_dict(), f, sort_keys=False)
+
+
+def apply_overrides(config_dict: Dict[str, Any], overrides: Dict[str, Any]) -> Dict[str, Any]:
+    """Apply dotted-path overrides, e.g. ``{"training.hyperparameters.batch_size": 8}``.
+
+    Mirrors the reference's CLI-override mechanism (reference:
+    core/training.py:1941-2006, hybrid_distributed.py:802-814) without the
+    temp-YAML indirection.
+    """
+    out = dict(config_dict)
+    for path, value in overrides.items():
+        parts = path.split(".")
+        node = out
+        for p in parts[:-1]:
+            nxt = node.get(p)
+            if not isinstance(nxt, dict):
+                nxt = {}
+            node[p] = dict(nxt)
+            node = node[p]
+        node[parts[-1]] = value
+    return out

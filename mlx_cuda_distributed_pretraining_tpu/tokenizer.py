@@ -1,0 +1,187 @@
+"""Tokenizers: byte-level fallback + HF ``tokenizers`` wrapper.
+
+Capability parity with the reference's TokenizerManager (reference:
+core/training.py:324-440): load an external ``tokenizer.json`` when
+``data.tokenizer_path`` is set, otherwise a byte-level tokenizer with
+vocab = 256 + special tokens; ``tokenize_doc`` wraps in BOS/EOS and
+truncates to the context size.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Dict, List, Optional
+
+
+class ByteTokenizer:
+    """Byte-level tokenizer: ids 0..normal_vocab_size-1 are raw bytes, special
+    tokens follow (reference: core/training.py:381-396)."""
+
+    def __init__(self, normal_vocab_size: int = 256, special_tokens: Optional[Dict[str, str]] = None):
+        special_tokens = special_tokens or {"pad": "<pad>", "bos": "<bos>", "eos": "<eos>"}
+        self.normal_vocab_size = normal_vocab_size
+        self.special_token_names = dict(special_tokens)
+        self.special_token_ids: Dict[str, int] = {}
+        for i, key in enumerate(special_tokens):
+            self.special_token_ids[key] = normal_vocab_size + i
+        self.vocab_size = normal_vocab_size + len(special_tokens)
+
+    @property
+    def pad_id(self) -> int:
+        return self.special_token_ids.get("pad", 0)
+
+    @property
+    def bos_id(self) -> int:
+        return self.special_token_ids.get("bos", self.vocab_size - 2)
+
+    @property
+    def eos_id(self) -> int:
+        return self.special_token_ids.get("eos", self.vocab_size - 1)
+
+    def encode(self, text: str) -> List[int]:
+        return [b for b in text.encode("utf-8") if b < self.normal_vocab_size]
+
+    def decode(self, ids: List[int]) -> str:
+        raw = bytes(i for i in ids if 0 <= i < self.normal_vocab_size)
+        return raw.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """Wrapper over a HuggingFace ``tokenizers`` tokenizer.json."""
+
+    def __init__(self, tokenizer_file: str, special_tokens: Optional[Dict[str, str]] = None):
+        from tokenizers import Tokenizer  # baked-in dependency
+
+        self._tok = Tokenizer.from_file(tokenizer_file)
+        self.tokenizer_file = tokenizer_file
+        self.vocab_size = self._tok.get_vocab_size()
+        special_tokens = special_tokens or {"pad": "<pad>", "bos": "<bos>", "eos": "<eos>"}
+        self.special_token_names = dict(special_tokens)
+        self.special_token_ids = {}
+        for key, tok_str in special_tokens.items():
+            tid = self._tok.token_to_id(tok_str)
+            if tid is not None:
+                self.special_token_ids[key] = tid
+
+    @property
+    def pad_id(self) -> int:
+        return self.special_token_ids.get("pad", 0)
+
+    @property
+    def bos_id(self) -> int:
+        return self.special_token_ids.get("bos", 1)
+
+    @property
+    def eos_id(self) -> int:
+        return self.special_token_ids.get("eos", 2)
+
+    def encode(self, text: str) -> List[int]:
+        return self._tok.encode(text, add_special_tokens=False).ids
+
+    def decode(self, ids: List[int]) -> str:
+        special = set(self.special_token_ids.values())
+        return self._tok.decode([i for i in ids if i not in special], skip_special_tokens=True)
+
+
+class TokenizerManager:
+    """Resolves the tokenizer from config and provides doc-level tokenize.
+
+    Reference parity: core/training.py:324-440 — external tokenizer path
+    first, byte fallback otherwise; ``tokenize_doc`` adds BOS/EOS and
+    truncates to ``max_context_size + 2``; the tokenizer is copied into the
+    run directory for reproducibility.
+    """
+
+    def __init__(self, data_config: Any, run_dir: Optional[str] = None):
+        tok_cfg = dict(getattr(data_config, "tokenizer", None) or {})
+        special = dict(tok_cfg.get("special_tokens") or {"pad": "<pad>", "bos": "<bos>", "eos": "<eos>"})
+        self.max_context_size = int(
+            (getattr(data_config, "preprocessing", None) or {}).get("max_context_size", 1024)
+        )
+        self.external_path: Optional[str] = None
+
+        tokenizer_path = getattr(data_config, "tokenizer_path", None)
+        tok_file = None
+        if tokenizer_path:
+            candidate = os.path.join(tokenizer_path, "tokenizer.json")
+            if os.path.isfile(candidate):
+                tok_file = candidate
+            elif os.path.isfile(tokenizer_path):
+                tok_file = tokenizer_path
+
+        if tok_file:
+            self.tokenizer: Any = HFTokenizer(tok_file, special)
+            self.external_path = tok_file
+        else:
+            self.tokenizer = ByteTokenizer(int(tok_cfg.get("normal_vocab_size", 256)), special)
+
+        if run_dir:
+            self.save_to_run_dir(run_dir)
+
+    # -- delegation ---------------------------------------------------------
+    @property
+    def vocab_size(self) -> int:
+        return self.tokenizer.vocab_size
+
+    @property
+    def pad_id(self) -> int:
+        return self.tokenizer.pad_id
+
+    @property
+    def bos_id(self) -> int:
+        return self.tokenizer.bos_id
+
+    @property
+    def eos_id(self) -> int:
+        return self.tokenizer.eos_id
+
+    def tokenize(self, text: str) -> List[int]:
+        return self.tokenizer.encode(text)
+
+    def detokenize(self, ids: List[int]) -> str:
+        return self.tokenizer.decode(list(ids))
+
+    def tokenize_doc(self, text: str, max_length: Optional[int] = None) -> List[int]:
+        """BOS + tokens + EOS, truncated to ``max_length + 2`` total."""
+        max_length = self.max_context_size if max_length is None else max_length
+        ids = self.tokenize(text)[:max_length]
+        return [self.bos_id] + ids + [self.eos_id]
+
+    def save_to_run_dir(self, run_dir: str) -> None:
+        tok_dir = os.path.join(run_dir, "tokenizer")
+        os.makedirs(tok_dir, exist_ok=True)
+        if self.external_path:
+            shutil.copy(self.external_path, os.path.join(tok_dir, "tokenizer.json"))
+        else:
+            meta = {
+                "type": "byte",
+                "normal_vocab_size": self.tokenizer.normal_vocab_size,
+                "special_tokens": self.tokenizer.special_token_names,
+            }
+            with open(os.path.join(tok_dir, "byte_tokenizer.json"), "w") as f:
+                json.dump(meta, f, indent=2)
+
+    @classmethod
+    def from_run_dir(cls, run_dir: str) -> "TokenizerManager":
+        """Rehydrate from a run directory saved by ``save_to_run_dir``."""
+        from .config import DataConfig
+
+        tok_dir = os.path.join(run_dir, "tokenizer")
+        hf_file = os.path.join(tok_dir, "tokenizer.json")
+        byte_file = os.path.join(tok_dir, "byte_tokenizer.json")
+        if os.path.isfile(hf_file):
+            cfg = DataConfig(tokenizer_path=tok_dir)
+            return cls(cfg)
+        if os.path.isfile(byte_file):
+            with open(byte_file) as f:
+                meta = json.load(f)
+            cfg = DataConfig(
+                tokenizer={
+                    "normal_vocab_size": meta.get("normal_vocab_size", 256),
+                    "special_tokens": meta.get("special_tokens"),
+                }
+            )
+            return cls(cfg)
+        return cls(DataConfig())

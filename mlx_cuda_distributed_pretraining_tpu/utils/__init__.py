@@ -1,0 +1,3 @@
+from .tree import flatten_dict, unflatten_dict, tree_size, tree_bytes
+
+__all__ = ["flatten_dict", "unflatten_dict", "tree_size", "tree_bytes"]

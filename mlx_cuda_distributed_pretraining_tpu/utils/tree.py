@@ -1,0 +1,52 @@
+"""Pytree <-> flat-dict helpers used by checkpointing and export."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+
+def flatten_dict(tree: Any, sep: str = ".", _prefix: str = "") -> Dict[str, Any]:
+    """Flatten a nested dict/list pytree into ``{"a.b.0.c": leaf}``."""
+    out: Dict[str, Any] = {}
+    if isinstance(tree, dict):
+        items = tree.items()
+    elif isinstance(tree, (list, tuple)):
+        items = ((str(i), v) for i, v in enumerate(tree))
+    else:
+        return {_prefix.rstrip(sep): tree} if _prefix else {"": tree}
+    for k, v in items:
+        key = f"{_prefix}{k}"
+        if isinstance(v, (dict, list, tuple)) and len(v) > 0:
+            out.update(flatten_dict(v, sep=sep, _prefix=key + sep))
+        else:
+            out[key] = v
+    return out
+
+
+def unflatten_dict(flat: Dict[str, Any], sep: str = ".") -> Dict[str, Any]:
+    """Inverse of :func:`flatten_dict`. List nodes are reconstructed as dicts
+    keyed by stringified indices; model code treats them equivalently."""
+    out: Dict[str, Any] = {}
+    for key, value in flat.items():
+        parts = key.split(sep)
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return out
+
+
+def tree_size(tree: Any) -> int:
+    """Total number of elements across all leaves."""
+    return sum(int(np.prod(x.shape)) if hasattr(x, "shape") else 1 for x in jax.tree_util.tree_leaves(tree))
+
+
+def tree_bytes(tree: Any) -> int:
+    total = 0
+    for x in jax.tree_util.tree_leaves(tree):
+        if hasattr(x, "nbytes"):
+            total += int(x.nbytes)
+    return total

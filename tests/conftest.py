@@ -1,0 +1,18 @@
+"""Test harness: force an 8-device virtual CPU platform BEFORE jax imports.
+
+This is the multi-device-without-a-pod strategy from SURVEY.md §4: DP/TP/SP
+sharding correctness is validated on a virtual CPU mesh
+(``--xla_force_host_platform_device_count=8``); the real TPU chip is only
+used by bench.py.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

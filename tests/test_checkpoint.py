@@ -1,0 +1,79 @@
+import json
+import os
+
+import ml_dtypes
+import numpy as np
+
+from mlx_cuda_distributed_pretraining_tpu.checkpoint import (
+    CheckpointManager,
+    load_safetensors,
+    save_safetensors,
+)
+from mlx_cuda_distributed_pretraining_tpu.utils.tree import flatten_dict, unflatten_dict
+
+
+def test_safetensors_roundtrip(tmp_path):
+    path = str(tmp_path / "t.safetensors")
+    tensors = {
+        "a": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "b.c": np.ones((2, 2), dtype=ml_dtypes.bfloat16),
+        "d": np.array([1, 2, 3], dtype=np.int64),
+    }
+    save_safetensors(path, tensors, metadata={"format": "pt"})
+    loaded, meta = load_safetensors(path)
+    assert meta["format"] == "pt"
+    assert set(loaded) == set(tensors)
+    for k in tensors:
+        assert loaded[k].dtype == tensors[k].dtype
+        np.testing.assert_array_equal(np.asarray(loaded[k], np.float64), np.asarray(tensors[k], np.float64))
+
+
+def test_safetensors_matches_external_reader(tmp_path):
+    """Cross-check our writer against the `safetensors` pip package if present."""
+    try:
+        from safetensors.numpy import load_file
+    except ImportError:
+        return
+    path = str(tmp_path / "t.safetensors")
+    tensors = {"w": np.random.randn(4, 5).astype(np.float32)}
+    save_safetensors(path, tensors)
+    ext = load_file(path)
+    np.testing.assert_array_equal(ext["w"], tensors["w"])
+
+
+def test_flatten_unflatten():
+    tree = {"layers": [{"w": 1, "b": 2}, {"w": 3}], "head": {"w": 4}}
+    flat = flatten_dict(tree)
+    assert flat["layers.0.w"] == 1 and flat["head.w"] == 4
+    nested = unflatten_dict(flat)
+    assert nested["layers"]["0"]["b"] == 2
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    run_dir = CheckpointManager.setup_run_directory(str(tmp_path), "run1")
+    mgr = CheckpointManager(run_dir)
+    params = {"emb": np.random.randn(8, 4).astype(np.float32), "layers": [{"w": np.ones((4, 4), np.float32)}]}
+    opt_state = {"mu": {"emb": np.zeros((8, 4), np.float32)}, "count": np.int32(5)}
+    mgr.save(100, params, opt_state, {"step": 100, "total_tokens": 12345})
+
+    p2, o2, ts = mgr.load(100, like_params=params, like_opt_state=opt_state)
+    np.testing.assert_array_equal(p2["emb"], params["emb"])
+    np.testing.assert_array_equal(p2["layers"][0]["w"], params["layers"][0]["w"])
+    assert ts["total_tokens"] == 12345
+    assert int(o2["count"]) == 5
+
+    # metadata ledger appended
+    with open(os.path.join(run_dir, "metadata.json")) as f:
+        ledger = json.load(f)
+    assert ledger["checkpoints"][0]["step"] == 100
+    assert mgr.latest_step() == "100"
+
+
+def test_overwrite_guard(tmp_path):
+    CheckpointManager.setup_run_directory(str(tmp_path), "r")
+    try:
+        CheckpointManager.setup_run_directory(str(tmp_path), "r", overwrite=False)
+        assert False
+    except ValueError:
+        pass
+    CheckpointManager.setup_run_directory(str(tmp_path), "r", overwrite=True)

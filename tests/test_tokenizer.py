@@ -1,0 +1,24 @@
+from mlx_cuda_distributed_pretraining_tpu.config import DataConfig
+from mlx_cuda_distributed_pretraining_tpu.tokenizer import ByteTokenizer, TokenizerManager
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    ids = tok.encode("hello world")
+    assert tok.decode(ids) == "hello world"
+    assert tok.vocab_size == 259
+    assert tok.pad_id == 256 and tok.bos_id == 257 and tok.eos_id == 258
+
+
+def test_tokenize_doc_wraps_and_truncates():
+    mgr = TokenizerManager(DataConfig(preprocessing={"max_context_size": 8}))
+    ids = mgr.tokenize_doc("abcdefghijklmnop")
+    assert ids[0] == mgr.bos_id and ids[-1] == mgr.eos_id
+    assert len(ids) == 10  # 8 + BOS + EOS
+
+
+def test_run_dir_roundtrip(tmp_path):
+    mgr = TokenizerManager(DataConfig(), run_dir=str(tmp_path))
+    mgr2 = TokenizerManager.from_run_dir(str(tmp_path))
+    assert mgr2.vocab_size == mgr.vocab_size
+    assert mgr2.detokenize(mgr2.tokenize("xyz")) == "xyz"
