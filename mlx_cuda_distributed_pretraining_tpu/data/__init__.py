@@ -1,0 +1,4 @@
+from .packing import pack_documents, pad_documents
+from .memory import DataManager
+
+__all__ = ["pack_documents", "pad_documents", "DataManager"]

@@ -1,0 +1,153 @@
+"""In-memory JSONL data manager.
+
+Capability parity with the reference DataManager (reference:
+core/training.py:442-543): loads JSONL ``{"text": ...}`` files, tokenizes
+with doc chunking + ``chunk_overlap``, serves deterministic shuffled train
+batches and sequential validation batches with a persistent ``val_ptr``.
+
+TPU-first differences: batches are static-shape packed ``[B, L]`` int32
+arrays (see packing.py), and multi-host sharding slices rows by
+``process_index`` so each host feeds its local devices disjoint data.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from .packing import batch_views, chunk_tokens, pack_documents, pad_documents
+
+Batch = Dict[str, np.ndarray]
+
+
+def load_jsonl_texts(path: str) -> List[str]:
+    texts: List[str] = []
+    with open(path, "r") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict) and "text" in obj:
+                texts.append(obj["text"])
+            elif isinstance(obj, str):
+                texts.append(obj)
+    return texts
+
+
+class DataManager:
+    def __init__(
+        self,
+        data_config: Any,
+        tokenizer: Any,
+        batch_size: int,
+        seq_len: Optional[int] = None,
+        seed: int = 42,
+        packing: bool = True,
+        process_index: int = 0,
+        process_count: int = 1,
+        base_dir: str = ".",
+    ):
+        self.tokenizer = tokenizer
+        self.batch_size = batch_size
+        self.seq_len = seq_len or tokenizer.max_context_size
+        self.seed = seed
+        self.packing = packing
+        self.process_index = process_index
+        self.process_count = process_count
+        self.pad_id = tokenizer.pad_id
+        self.chunk_overlap = getattr(data_config, "chunk_overlap", 0)
+        self.val_ptr = 0
+
+        self.train_rows = self._load_split(
+            os.path.join(base_dir, data_config.input_file) if data_config.input_file else None
+        )
+        val_file = getattr(data_config, "validation_file", None)
+        self.val_rows = self._load_split(os.path.join(base_dir, val_file) if val_file else None)
+
+        if len(self.train_rows) == 0:
+            raise ValueError("no training data: input_file missing or empty")
+
+        # Per-host shard: contiguous row striding keeps every host's row count
+        # equal (truncate to a common multiple).
+        if process_count > 1:
+            n = (len(self.train_rows) // process_count) * process_count
+            self.train_rows = self.train_rows[process_index:n:process_count]
+            if len(self.val_rows):
+                nv = max((len(self.val_rows) // process_count) * process_count, 0)
+                self.val_rows = self.val_rows[process_index:nv:process_count] if nv else self.val_rows[:0]
+
+    # -- construction -------------------------------------------------------
+    def _load_split(self, path: Optional[str]) -> np.ndarray:
+        if not path or not os.path.exists(path):
+            return np.zeros((0, self.seq_len + 1), np.int32)
+        docs: List[List[int]] = []
+        for text in load_jsonl_texts(path):
+            ids = self.tokenizer.tokenize_doc(text, max_length=10**9)
+            # Long docs are chunked at token level with overlap carried over.
+            for chunk in chunk_tokens(ids, self.seq_len + 1, self.chunk_overlap):
+                docs.append(chunk)
+        if self.packing:
+            return pack_documents(docs, self.seq_len, self.pad_id)
+        return pad_documents(docs, self.seq_len, self.pad_id)
+
+    # -- batches ------------------------------------------------------------
+    @property
+    def batches_per_epoch(self) -> int:
+        return max(1, len(self.train_rows) // self.batch_size)
+
+    def _epoch_perm(self, epoch: int) -> np.ndarray:
+        rng = np.random.default_rng(self.seed + epoch)
+        return rng.permutation(len(self.train_rows))
+
+    def generate_batch(self, step: int) -> Batch:
+        """Deterministic batch for global step: row permutation reshuffled
+        each epoch (reference: core/training.py:458-464,494-506)."""
+        epoch = step // self.batches_per_epoch
+        idx_in_epoch = step % self.batches_per_epoch
+        perm = self._epoch_perm(epoch)
+        lo = idx_in_epoch * self.batch_size
+        sel = perm[lo : lo + self.batch_size]
+        if len(sel) < self.batch_size:  # wrap the tail
+            sel = np.concatenate([sel, perm[: self.batch_size - len(sel)]])
+        rows = self.train_rows[sel]
+        inputs, targets, mask = batch_views(rows, self.pad_id)
+        return {"inputs": inputs, "targets": targets, "mask": mask}
+
+    @property
+    def has_validation_data(self) -> bool:
+        return len(self.val_rows) >= self.batch_size
+
+    def generate_validation_batch(self, batch_idx: Optional[int] = None) -> Batch:
+        """Sequential validation batches with persistent pointer (reference:
+        core/training.py val_ptr behavior)."""
+        if batch_idx is not None:
+            self.val_ptr = batch_idx * self.batch_size
+        if self.val_ptr + self.batch_size > len(self.val_rows):
+            self.val_ptr = 0
+        rows = self.val_rows[self.val_ptr : self.val_ptr + self.batch_size]
+        self.val_ptr += self.batch_size
+        inputs, targets, mask = batch_views(rows, self.pad_id)
+        return {"inputs": inputs, "targets": targets, "mask": mask}
+
+    def num_validation_batches(self, cap: int = 50) -> int:
+        """Validation uses at most ``cap`` batches (reference:
+        core/training.py:1262-1345 caps at 50)."""
+        return min(cap, len(self.val_rows) // self.batch_size)
+
+    def iter_validation(self, cap: int = 50) -> Iterator[Batch]:
+        for i in range(self.num_validation_batches(cap)):
+            yield self.generate_validation_batch(i)
+
+    # -- bookkeeping for checkpoint state -----------------------------------
+    def state_dict(self) -> Dict[str, int]:
+        return {"val_ptr": self.val_ptr}
+
+    def load_state_dict(self, state: Dict[str, int]) -> None:
+        self.val_ptr = int(state.get("val_ptr", 0))

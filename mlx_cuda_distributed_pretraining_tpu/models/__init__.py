@@ -1,0 +1,4 @@
+from .llama import LlamaArgs, init_params, forward
+from .registry import resolve_architecture
+
+__all__ = ["LlamaArgs", "init_params", "forward", "resolve_architecture"]

@@ -1,0 +1,399 @@
+"""Pure-pytree Llama decoder.
+
+Capability parity with the reference model (reference: models/llama.py:
+ModelArgs :17-41, RMSNorm :44-56, RoPE :59-139, MLP :141-160, attention
+dispatch :181-209, TransformerBlock :298-319, Model :322-477) designed
+TPU-first:
+
+- params are a nested dict of ``jnp.ndarray`` (no module framework) so
+  sharding rules, optimizer partitions and checkpoints address leaves by
+  path;
+- ``forward`` is a pure function — jit/grad/shard_map compose directly;
+- attention dispatch simple/flash/flex selects the Pallas kernel at trace
+  time; masks/score-mods are traceable index functions (ops/masks.py);
+- canonical SwiGLU (``silu(gate) * up``) instead of the reference's
+  nonstandard ``gate * sigmoid(up) * 2`` (models/llama.py:151) — documented
+  behavioral divergence (SURVEY.md §7.3);
+- RMSNorm computes in fp32 regardless of compute dtype; logits are fp32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import masks as masks_lib
+from ..ops.attention import reference_attention
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class LlamaArgs:
+    vocab_size: int = 259
+    hidden_size: int = 128
+    intermediate_size: int = 256
+    num_layers: int = 4
+    num_heads: int = 8
+    num_kv_heads: int = 8
+    head_dim: int = 16
+    max_position_embeddings: int = 1024
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    rope_traditional: bool = False
+    rope_scaling_factor: Optional[float] = None
+    attention_bias: bool = False
+    mlp_bias: bool = False
+    tie_word_embeddings: bool = True
+    logit_scale: Optional[float] = None
+    attention_type: str = "simple"  # simple | flash | flex
+    # flex-attention mask program (traceable builders in ops/masks.py)
+    mask_type: str = "causal"  # causal | sliding_window | prefix_lm
+    window_size: int = 512
+    prefix_len: int = 0
+    score_mod_type: Optional[str] = None  # None | alibi | soft_cap
+    soft_cap: float = 50.0
+    # MoE fields accepted for config compatibility (reference declares but
+    # never uses them: models/llama.py:40-41); a real MoE block keys off them.
+    num_local_experts: int = 0
+    num_experts_per_tok: int = 0
+
+    @classmethod
+    def from_config(cls, model_cfg: Any, vocab_size: int) -> "LlamaArgs":
+        att = dict(getattr(model_cfg, "attention", None) or {})
+        rope = dict(getattr(model_cfg, "rope", None) or {})
+        misc = dict(getattr(model_cfg, "misc", None) or {})
+        norm = dict(getattr(model_cfg, "normalization", None) or {})
+        scaling = rope.get("scaling") or {}
+        scale_factor = scaling.get("factor") if isinstance(scaling, dict) else None
+        return cls(
+            vocab_size=vocab_size,
+            hidden_size=model_cfg.hidden_size,
+            intermediate_size=model_cfg.intermediate_size,
+            num_layers=model_cfg.num_layers,
+            num_heads=model_cfg.num_heads,
+            num_kv_heads=model_cfg.num_kv_heads,
+            head_dim=model_cfg.head_dim,
+            max_position_embeddings=int(att.get("max_position_embeddings") or 0)
+            or 4096,
+            rms_norm_eps=float(norm.get("rms_norm_eps", 1e-5)),
+            rope_theta=float(rope.get("theta", 10000.0)),
+            rope_traditional=bool(rope.get("traditional", False)),
+            rope_scaling_factor=float(scale_factor) if scale_factor else None,
+            attention_bias=bool(misc.get("attention_bias", False)),
+            mlp_bias=bool(misc.get("mlp_bias", False)),
+            tie_word_embeddings=bool(misc.get("tie_word_embeddings", True)),
+            logit_scale=misc.get("logit_scale"),
+            attention_type=model_cfg.attention_type,
+            mask_type=str(att.get("mask_type", "causal")),
+            window_size=int(att.get("window_size", 512)),
+            prefix_len=int(att.get("prefix_len", 0)),
+            score_mod_type=att.get("score_mod"),
+            soft_cap=float(att.get("soft_cap", 50.0)),
+            num_local_experts=int(getattr(model_cfg, "moe", {}).get("num_local_experts", 0) or 0),
+            num_experts_per_tok=int(getattr(model_cfg, "moe", {}).get("num_experts_per_tok", 0) or 0),
+        )
+
+
+# -- init -------------------------------------------------------------------
+def init_params(rng: jax.Array, args: LlamaArgs, dtype=jnp.float32) -> Params:
+    """Initialize parameters: normal(0.02) embeddings/projections, residual
+    output projections scaled by 1/sqrt(2*num_layers) (GPT-2 style), ones for
+    norms."""
+    n_streams = 7 * args.num_layers + 2
+    keys = iter(jax.random.split(rng, n_streams))
+    std = 0.02
+    res_std = std / (2 * args.num_layers) ** 0.5
+    D, Dh = args.hidden_size, args.head_dim
+    Hq, Hkv, I = args.num_heads, args.num_kv_heads, args.intermediate_size
+
+    def dense(key, shape, s):
+        return (jax.random.normal(key, shape, jnp.float32) * s).astype(dtype)
+
+    layers = []
+    for _ in range(args.num_layers):
+        layer = {
+            "attention_norm": {"weight": jnp.ones((D,), dtype)},
+            "attention": {
+                "wq": {"weight": dense(next(keys), (D, Hq * Dh), std)},
+                "wk": {"weight": dense(next(keys), (D, Hkv * Dh), std)},
+                "wv": {"weight": dense(next(keys), (D, Hkv * Dh), std)},
+                "wo": {"weight": dense(next(keys), (Hq * Dh, D), res_std)},
+            },
+            "ffn_norm": {"weight": jnp.ones((D,), dtype)},
+            "feed_forward": {
+                "w_gate": {"weight": dense(next(keys), (D, I), std)},
+                "w_up": {"weight": dense(next(keys), (D, I), std)},
+                "w_down": {"weight": dense(next(keys), (I, D), res_std)},
+            },
+        }
+        if args.attention_bias:
+            for name, fan_out in (("wq", Hq * Dh), ("wk", Hkv * Dh), ("wv", Hkv * Dh), ("wo", D)):
+                layer["attention"][name]["bias"] = jnp.zeros((fan_out,), dtype)
+        if args.mlp_bias:
+            for name, fan_out in (("w_gate", I), ("w_up", I), ("w_down", D)):
+                layer["feed_forward"][name]["bias"] = jnp.zeros((fan_out,), dtype)
+        layers.append(layer)
+
+    params: Params = {
+        "tok_embeddings": {"weight": dense(next(keys), (args.vocab_size, D), std)},
+        "layers": layers,
+        "norm": {"weight": jnp.ones((D,), dtype)},
+    }
+    if not args.tie_word_embeddings:
+        params["output"] = {"weight": dense(next(keys), (D, args.vocab_size), std)}
+    return params
+
+
+def num_params(params: Params) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+
+# -- building blocks --------------------------------------------------------
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """fp32-internal RMSNorm (reference: models/llama.py:44-56)."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    xf = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * weight.astype(jnp.float32)).astype(dtype)
+
+
+def _linear(x: jnp.ndarray, p: Params) -> jnp.ndarray:
+    y = x @ p["weight"]
+    if "bias" in p:
+        y = y + p["bias"]
+    return y
+
+
+def rope_cos_sin(
+    positions: jnp.ndarray, head_dim: int, theta: float, scaling_factor: Optional[float] = None
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables for given positions [S] -> [S, head_dim//2], fp32.
+
+    Linear position scaling divides positions by the factor (reference:
+    models/llama.py:59-139 supports the same "linear" scaling)."""
+    pos = positions.astype(jnp.float32)
+    if scaling_factor:
+        pos = pos / scaling_factor
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = pos[:, None] * inv_freq[None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray, traditional: bool = False) -> jnp.ndarray:
+    """Rotate [B, S, H, D]. ``traditional`` = interleaved pairs; default =
+    half-split (llama) convention."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    if traditional:
+        x1 = xf[..., 0::2]
+        x2 = xf[..., 1::2]
+        r1 = x1 * c - x2 * s
+        r2 = x2 * c + x1 * s
+        out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+    else:
+        half = x.shape[-1] // 2
+        x1 = xf[..., :half]
+        x2 = xf[..., half:]
+        out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(dtype)
+
+
+def build_mask_mod(args: LlamaArgs) -> masks_lib.MaskMod:
+    if args.mask_type == "sliding_window":
+        return masks_lib.sliding_window(args.window_size)
+    if args.mask_type == "prefix_lm":
+        return masks_lib.prefix_lm(args.prefix_len)
+    return masks_lib.causal()
+
+
+def build_score_mod(args: LlamaArgs, head: Optional[int] = None):
+    """Score mod for the whole head dim (vectorized over heads where needed)."""
+    if args.score_mod_type == "alibi":
+        slopes = jnp.asarray(masks_lib.alibi_slopes(args.num_heads), jnp.float32)
+
+        def mod(scores, q_idx, k_idx):
+            # scores [B, Hkv, G, Sq, Skv]; recover absolute head index.
+            B, Hkv, G = scores.shape[0], scores.shape[1], scores.shape[2]
+            head_ids = jnp.arange(Hkv * G).reshape(Hkv, G)
+            slope = slopes[head_ids][None, :, :, None, None]
+            return scores - slope * jnp.abs(q_idx - k_idx)[None, None, None]
+
+        return mod
+    if args.score_mod_type == "soft_cap":
+        return lambda s, q, k: args.soft_cap * jnp.tanh(s / args.soft_cap)
+    return None
+
+
+def attention_block(
+    p: Params,
+    x: jnp.ndarray,
+    args: LlamaArgs,
+    positions: jnp.ndarray,
+    cache: Optional[Dict[str, jnp.ndarray]] = None,
+    attn_impl: Optional[str] = None,
+) -> Tuple[jnp.ndarray, Optional[Dict[str, jnp.ndarray]]]:
+    """Self-attention with RoPE, GQA and optional KV cache.
+
+    cache = {"k": [B, T, Hkv, Dh], "v": ..., "pos": scalar} with T =
+    max_position_embeddings; decode writes at ``pos`` via dynamic slice and
+    attends over the full buffer under a positional validity mask.
+    """
+    B, S, _ = x.shape
+    Hq, Hkv, Dh = args.num_heads, args.num_kv_heads, args.head_dim
+
+    q = _linear(x, p["wq"]).reshape(B, S, Hq, Dh)
+    k = _linear(x, p["wk"]).reshape(B, S, Hkv, Dh)
+    v = _linear(x, p["wv"]).reshape(B, S, Hkv, Dh)
+
+    cos, sin = rope_cos_sin(positions, Dh, args.rope_theta, args.rope_scaling_factor)
+    q = apply_rope(q, cos, sin, args.rope_traditional)
+    k = apply_rope(k, cos, sin, args.rope_traditional)
+
+    new_cache = None
+    if cache is not None:
+        pos = cache["pos"]
+        ck = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, pos, 0, 0))
+        new_cache = {"k": ck, "v": cv, "pos": pos + S}
+        k, v = ck, cv
+        T = k.shape[1]
+        q_abs = positions  # [S] absolute positions of the queries
+        k_idx = jnp.arange(T, dtype=jnp.int32)
+        explicit = (k_idx[None, :] <= q_abs[:, None]) & (k_idx[None, :] < pos + S)
+        out = reference_attention(q, k, v, explicit_mask=explicit)
+    else:
+        mask_mod = build_mask_mod(args)
+        score_mod = build_score_mod(args)
+        impl = attn_impl or args.attention_type
+        if impl == "flash" and score_mod is None:
+            from ..ops.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, mask_type=args.mask_type,
+                                  window_size=args.window_size, prefix_len=args.prefix_len)
+        elif impl == "flex":
+            from ..ops.flex_attention import flex_attention
+
+            out = flex_attention(q, k, v, mask_mod=mask_mod, score_mod=score_mod)
+        else:
+            out = reference_attention(q, k, v, mask_mod=mask_mod, score_mod=score_mod)
+
+    out = out.reshape(B, S, Hq * Dh)
+    return _linear(out, p["wo"]), new_cache
+
+
+def mlp_block(p: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """Canonical SwiGLU: ``down(silu(gate(x)) * up(x))``."""
+    return _linear(jax.nn.silu(_linear(x, p["w_gate"])) * _linear(x, p["w_up"]), p["w_down"])
+
+
+def transformer_block(
+    p: Params,
+    x: jnp.ndarray,
+    args: LlamaArgs,
+    positions: jnp.ndarray,
+    cache: Optional[Dict[str, jnp.ndarray]] = None,
+    attn_impl: Optional[str] = None,
+) -> Tuple[jnp.ndarray, Optional[Dict[str, jnp.ndarray]]]:
+    """Pre-norm residual block (reference: models/llama.py:298-319)."""
+    h, new_cache = attention_block(
+        p["attention"], rms_norm(x, p["attention_norm"]["weight"], args.rms_norm_eps),
+        args, positions, cache, attn_impl,
+    )
+    x = x + h
+    x = x + mlp_block(p["feed_forward"], rms_norm(x, p["ffn_norm"]["weight"], args.rms_norm_eps))
+    return x, new_cache
+
+
+# -- full model -------------------------------------------------------------
+def forward(
+    params: Params,
+    tokens: jnp.ndarray,
+    args: LlamaArgs,
+    cache: Optional[list] = None,
+    start_pos: Any = 0,
+    compute_dtype: jnp.dtype = jnp.float32,
+    remat: Optional[str] = None,
+    remat_ratio: float = 1.0,
+) -> Tuple[jnp.ndarray, Optional[list]]:
+    """tokens [B, S] int32 → (logits [B, S, V] fp32, new_cache | None).
+
+    ``remat``: None | "full" | "dots" — per-layer ``jax.checkpoint`` with the
+    corresponding policy; ``remat_ratio`` checkpoints only the first fraction
+    of layers (reference: system.gradient_checkpointing_ratio).
+    """
+    B, S = tokens.shape
+    x = params["tok_embeddings"]["weight"].astype(compute_dtype)[tokens]
+    positions = jnp.arange(S, dtype=jnp.int32) + start_pos
+
+    block = transformer_block
+    if remat == "full":
+        block = jax.checkpoint(transformer_block, static_argnums=(2, 5))
+    elif remat == "dots":
+        block = jax.checkpoint(
+            transformer_block,
+            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+            static_argnums=(2, 5),
+        )
+
+    cast = partial(jax.tree_util.tree_map, lambda a: a.astype(compute_dtype))
+    new_cache = [] if cache is not None else None
+    n_remat = int(round(args.num_layers * remat_ratio))
+    for i, layer in enumerate(params["layers"]):
+        blk = block if (remat and i < n_remat) else transformer_block
+        layer_cache = cache[i] if cache is not None else None
+        x, c = blk(cast(layer), x, args, positions, layer_cache, None)
+        if new_cache is not None:
+            new_cache.append(c)
+
+    x = rms_norm(x, params["norm"]["weight"], args.rms_norm_eps)
+    if args.tie_word_embeddings or "output" not in params:
+        logits = x @ params["tok_embeddings"]["weight"].astype(compute_dtype).T
+    else:
+        logits = _linear(x, cast(params["output"]))
+    logits = logits.astype(jnp.float32)
+    if args.logit_scale:
+        logits = logits * args.logit_scale
+    return logits, new_cache
+
+
+def init_cache(args: LlamaArgs, batch_size: int, max_len: Optional[int] = None, dtype=jnp.float32) -> list:
+    T = max_len or args.max_position_embeddings
+    return [
+        {
+            "k": jnp.zeros((batch_size, T, args.num_kv_heads, args.head_dim), dtype),
+            "v": jnp.zeros((batch_size, T, args.num_kv_heads, args.head_dim), dtype),
+            "pos": jnp.asarray(0, jnp.int32),
+        }
+        for _ in range(args.num_layers)
+    ]
+
+
+def loss_fn(
+    params: Params,
+    batch: Dict[str, jnp.ndarray],
+    args: LlamaArgs,
+    compute_dtype: jnp.dtype = jnp.float32,
+    remat: Optional[str] = None,
+    remat_ratio: float = 1.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Masked mean cross-entropy in fp32 (reference: core/training.py
+    compute_loss :1195-1260). Returns (loss, token_count)."""
+    logits, _ = forward(
+        params, batch["inputs"], args, compute_dtype=compute_dtype,
+        remat=remat, remat_ratio=remat_ratio,
+    )
+    targets = batch["targets"]
+    mask = batch["mask"].astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = (logz - gold) * mask
+    count = jnp.maximum(mask.sum(), 1.0)
+    return nll.sum() / count, mask.sum()

@@ -1,0 +1,4 @@
+from .attention import reference_attention
+from . import masks
+
+__all__ = ["reference_attention", "masks"]

@@ -1,18 +1,31 @@
-"""Test harness: force an 8-device virtual CPU platform BEFORE jax imports.
+"""Test harness: force a pure 8-device virtual CPU platform.
 
-This is the multi-device-without-a-pod strategy from SURVEY.md §4: DP/TP/SP
-sharding correctness is validated on a virtual CPU mesh
+Multi-device-without-a-pod strategy (SURVEY.md §4): DP/TP/SP sharding
+correctness is validated on a virtual CPU mesh
 (``--xla_force_host_platform_device_count=8``); the real TPU chip is only
-used by bench.py.
+touched by bench.py.
+
+The session environment activates the axon TPU plugin via sitecustomize and
+forces ``jax_platforms="axon,cpu"`` at the jax-config level, so setting the
+``JAX_PLATFORMS`` env var is not enough — tests must also reset the config
+and deregister the axon backend factory before any backend initializes,
+otherwise every test run dials the (single-client) TPU tunnel.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
-import sys
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+from jax._src import xla_bridge as _xb
+
+assert not _xb.backends_are_initialized(), "jax backends initialized before conftest"
+_xb._backend_factories.pop("axon", None)

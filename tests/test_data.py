@@ -1,0 +1,96 @@
+import json
+
+import numpy as np
+
+from mlx_cuda_distributed_pretraining_tpu.config import DataConfig
+from mlx_cuda_distributed_pretraining_tpu.data import DataManager, pack_documents, pad_documents
+from mlx_cuda_distributed_pretraining_tpu.data.packing import batch_views, chunk_tokens
+from mlx_cuda_distributed_pretraining_tpu.tokenizer import TokenizerManager
+
+
+def test_pack_documents_static_shape():
+    docs = [[1, 2, 3], [4, 5], [6, 7, 8, 9, 10, 11]]
+    rows = pack_documents(docs, seq_len=4, pad_id=0)
+    assert rows.shape[1] == 5
+    assert rows.dtype == np.int32
+    flat = rows.reshape(-1)
+    assert list(flat[:11]) == [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11]
+    assert all(x == 0 for x in flat[11:])  # tail padded
+
+
+def test_pad_documents():
+    rows = pad_documents([[1, 2], [3, 4, 5, 6, 7, 8, 9]], seq_len=4, pad_id=0)
+    assert rows.shape == (2, 5)
+    assert list(rows[0]) == [1, 2, 0, 0, 0]
+    assert list(rows[1]) == [3, 4, 5, 6, 7]  # truncated
+
+
+def test_chunk_tokens_overlap():
+    chunks = chunk_tokens(list(range(10)), max_len=4, overlap=1)
+    assert chunks[0] == [0, 1, 2, 3]
+    assert chunks[1][0] == 3  # overlap carried
+    assert all(len(c) <= 4 for c in chunks)
+
+
+def test_batch_views_mask():
+    rows = np.array([[1, 2, 3, 0, 0]], dtype=np.int32)
+    x, y, m = batch_views(rows, pad_id=0)
+    assert x.shape == (1, 4) and y.shape == (1, 4)
+    assert list(m[0]) == [1.0, 1.0, 0.0, 0.0]
+
+
+def _write_jsonl(path, texts):
+    with open(path, "w") as f:
+        for t in texts:
+            f.write(json.dumps({"text": t}) + "\n")
+
+
+def _make_dm(tmp_path, n_docs=50, seq_len=16, batch_size=4, **kw):
+    train = tmp_path / "train.jsonl"
+    val = tmp_path / "val.jsonl"
+    _write_jsonl(train, [f"document number {i} " * 3 for i in range(n_docs)])
+    _write_jsonl(val, [f"val doc {i} " * 3 for i in range(n_docs // 2)])
+    cfg = DataConfig(
+        input_file=str(train),
+        validation_file=str(val),
+        preprocessing={"max_context_size": seq_len},
+    )
+    tok = TokenizerManager(cfg)
+    return DataManager(cfg, tok, batch_size=batch_size, seq_len=seq_len, **kw)
+
+
+def test_datamanager_batches_deterministic(tmp_path):
+    dm = _make_dm(tmp_path)
+    b1 = dm.generate_batch(3)
+    b2 = dm.generate_batch(3)
+    np.testing.assert_array_equal(b1["inputs"], b2["inputs"])
+    assert b1["inputs"].shape == (4, 16)
+    assert b1["targets"].shape == (4, 16)
+    # shifted-by-one relationship
+    np.testing.assert_array_equal(b1["inputs"][:, 1:], b1["targets"][:, :-1])
+    # different steps differ
+    b3 = dm.generate_batch(4)
+    assert not np.array_equal(b1["inputs"], b3["inputs"])
+
+
+def test_datamanager_validation_pointer(tmp_path):
+    dm = _make_dm(tmp_path)
+    assert dm.has_validation_data
+    v0 = dm.generate_validation_batch()
+    v1 = dm.generate_validation_batch()
+    assert not np.array_equal(v0["inputs"], v1["inputs"])
+    state = dm.state_dict()
+    dm2 = _make_dm(tmp_path)
+    dm2.load_state_dict(state)
+    v2 = dm2.generate_validation_batch()
+    np.testing.assert_array_equal(v2["inputs"], dm.generate_validation_batch()["inputs"][:0].shape and v2["inputs"])
+
+
+def test_datamanager_host_sharding(tmp_path):
+    full = _make_dm(tmp_path)
+    dm0 = _make_dm(tmp_path, process_index=0, process_count=2)
+    dm1 = _make_dm(tmp_path, process_index=1, process_count=2)
+    assert len(dm0.train_rows) == len(dm1.train_rows)
+    n = len(dm0.train_rows) * 2
+    np.testing.assert_array_equal(dm0.train_rows, full.train_rows[0:n:2])
+    np.testing.assert_array_equal(dm1.train_rows, full.train_rows[1:n:2])

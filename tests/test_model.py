@@ -1,0 +1,136 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mlx_cuda_distributed_pretraining_tpu.models import llama
+from mlx_cuda_distributed_pretraining_tpu.models.llama import LlamaArgs
+from mlx_cuda_distributed_pretraining_tpu.ops import masks as M
+from mlx_cuda_distributed_pretraining_tpu.ops.attention import reference_attention
+
+ARGS = LlamaArgs(
+    vocab_size=64, hidden_size=32, intermediate_size=64, num_layers=2,
+    num_heads=4, num_kv_heads=2, head_dim=8, max_position_embeddings=32,
+)
+
+
+def test_forward_shapes_and_dtype():
+    params = llama.init_params(jax.random.PRNGKey(0), ARGS)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits, cache = llama.forward(params, tokens, ARGS)
+    assert logits.shape == (2, 16, 64)
+    assert logits.dtype == jnp.float32
+    assert cache is None
+
+
+def test_causality():
+    """Changing a future token must not change earlier logits."""
+    params = llama.init_params(jax.random.PRNGKey(0), ARGS)
+    t1 = jnp.array([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+    t2 = t1.at[0, 6].set(42)
+    l1, _ = llama.forward(params, t1, ARGS)
+    l2, _ = llama.forward(params, t2, ARGS)
+    np.testing.assert_allclose(l1[0, :6], l2[0, :6], atol=1e-5)
+    assert not np.allclose(l1[0, 6], l2[0, 6])
+
+
+def test_gqa_matches_repeated_mha():
+    """GQA via head groups == explicit KV repetition."""
+    rng = jax.random.PRNGKey(1)
+    B, S, Hq, Hkv, D = 2, 8, 4, 2, 16
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (B, S, Hq, D))
+    k = jax.random.normal(kk, (B, S, Hkv, D))
+    v = jax.random.normal(kv, (B, S, Hkv, D))
+    out = reference_attention(q, k, v, mask_mod=M.causal())
+    k_rep = jnp.repeat(k, Hq // Hkv, axis=2)
+    v_rep = jnp.repeat(v, Hq // Hkv, axis=2)
+    out_rep = reference_attention(q, k_rep, v_rep, mask_mod=M.causal())
+    np.testing.assert_allclose(out, out_rep, atol=1e-5)
+
+
+def test_mask_mods():
+    m = M.materialize_mask(M.sliding_window(2), 4, 4)
+    expected = np.array(
+        [[1, 0, 0, 0], [1, 1, 0, 0], [0, 1, 1, 0], [0, 0, 1, 1]], bool
+    )
+    np.testing.assert_array_equal(np.asarray(m), expected)
+    p = M.materialize_mask(M.prefix_lm(2), 4, 4)
+    assert p[0, 1] and not p[0, 3] and p[3, 0]
+
+
+def test_block_mask_map():
+    bm = M.block_mask_map(M.causal(), 8, 8, 4, 4)
+    assert bm[0, 0] == 1  # diagonal partial
+    assert bm[1, 0] == 2  # below diagonal dense
+    assert bm[0, 1] == 0  # above diagonal skipped
+
+
+def test_sliding_window_differs_from_causal():
+    """Reference test parity (tests/test_flex_attention.py:64-80)."""
+    args_sw = LlamaArgs(**{**ARGS.__dict__, "mask_type": "sliding_window", "window_size": 2})
+    params = llama.init_params(jax.random.PRNGKey(0), ARGS)
+    tokens = jnp.arange(16, dtype=jnp.int32)[None, :] % 60
+    l_causal, _ = llama.forward(params, tokens, ARGS)
+    l_sw, _ = llama.forward(params, tokens, args_sw)
+    assert not np.allclose(l_causal, l_sw)
+
+
+def test_alibi_score_mod_changes_output():
+    args_alibi = LlamaArgs(**{**ARGS.__dict__, "score_mod_type": "alibi"})
+    params = llama.init_params(jax.random.PRNGKey(0), ARGS)
+    tokens = jnp.arange(16, dtype=jnp.int32)[None, :] % 60
+    base, _ = llama.forward(params, tokens, ARGS)
+    ali, _ = llama.forward(params, tokens, args_alibi)
+    assert not np.allclose(base, ali)
+
+
+def test_kv_cache_decode_matches_full_forward():
+    """Incremental decode with KV cache == full-sequence forward."""
+    params = llama.init_params(jax.random.PRNGKey(0), ARGS)
+    tokens = jnp.array([[5, 9, 2, 7, 1, 3]], jnp.int32)
+    full_logits, _ = llama.forward(params, tokens, ARGS)
+
+    cache = llama.init_cache(ARGS, batch_size=1, max_len=16)
+    # prefill first 3, then decode one at a time
+    logits, cache = llama.forward(params, tokens[:, :3], ARGS, cache=cache, start_pos=0)
+    np.testing.assert_allclose(logits[0, -1], full_logits[0, 2], atol=1e-4)
+    for i in range(3, 6):
+        logits, cache = llama.forward(params, tokens[:, i : i + 1], ARGS, cache=cache, start_pos=i)
+        np.testing.assert_allclose(logits[0, -1], full_logits[0, i], atol=1e-4)
+
+
+def test_loss_decreases_tiny_overfit():
+    """Few SGD steps on one batch must reduce loss."""
+    params = llama.init_params(jax.random.PRNGKey(0), ARGS)
+    batch = {
+        "inputs": jnp.array([[1, 2, 3, 4, 5, 6, 7, 8]] * 2, jnp.int32),
+        "targets": jnp.array([[2, 3, 4, 5, 6, 7, 8, 9]] * 2, jnp.int32),
+        "mask": jnp.ones((2, 8), jnp.float32),
+    }
+    grad_fn = jax.jit(jax.value_and_grad(lambda p: llama.loss_fn(p, batch, ARGS)[0]))
+    loss0 = None
+    for _ in range(20):
+        loss, grads = grad_fn(params)
+        loss0 = loss if loss0 is None else loss0
+        params = jax.tree_util.tree_map(lambda p, g: p - 0.5 * g, params, grads)
+    assert float(loss) < float(loss0) * 0.7
+
+
+def test_remat_matches_no_remat():
+    params = llama.init_params(jax.random.PRNGKey(0), ARGS)
+    batch = {
+        "inputs": jnp.ones((1, 8), jnp.int32),
+        "targets": jnp.ones((1, 8), jnp.int32),
+        "mask": jnp.ones((1, 8), jnp.float32),
+    }
+    g1 = jax.grad(lambda p: llama.loss_fn(p, batch, ARGS)[0])(params)
+    g2 = jax.grad(lambda p: llama.loss_fn(p, batch, ARGS, remat="full")[0])(params)
+    jax.tree_util.tree_map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5), g1, g2)
+
+
+def test_tied_vs_untied_embeddings():
+    untied = LlamaArgs(**{**ARGS.__dict__, "tie_word_embeddings": False})
+    p = llama.init_params(jax.random.PRNGKey(0), untied)
+    assert "output" in p
+    logits, _ = llama.forward(p, jnp.ones((1, 4), jnp.int32), untied)
+    assert logits.shape == (1, 4, 64)
