@@ -131,8 +131,10 @@ class CheckpointManager:
                     v = flat[k]
                     if isinstance(ref, np.ndarray) and isinstance(v, np.ndarray):
                         rebuilt[k] = v.astype(ref.dtype).reshape(ref.shape)
+                    elif ref is None or v is None or isinstance(v, np.ndarray):
+                        rebuilt[k] = v
                     else:
-                        rebuilt[k] = type(ref)(v) if not isinstance(v, np.ndarray) else v
+                        rebuilt[k] = type(ref)(v)
                 else:
                     rebuilt[k] = ref
             nested = unflatten_dict(rebuilt)

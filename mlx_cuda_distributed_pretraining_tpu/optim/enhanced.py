@@ -1,0 +1,127 @@
+"""AdamW / Adam / SGD / Lion cores with the reference's "enhanced" features.
+
+Reference parity: optimizers/enhanced_optimizers.py — AdamWEnhanced
+(decoupled WD skipping bias/norm, global-norm clip, bias correction,
+AMSGrad, EMA), SGDEnhanced (nesterov, WD, clip, EMA), LionEnhanced
+(sign-momentum, WD, clip, EMA). Features compose as chained transforms
+(clip → core → weight decay → -lr), so each is a pure jit-able function.
+All second-moment/momentum state is fp32 regardless of param dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .base import (
+    Schedule,
+    Transform,
+    add_decayed_weights,
+    chain,
+    default_wd_mask,
+    maybe_clip,
+    scale_by_schedule,
+    trace_momentum,
+    tree_map,
+    with_ema,
+)
+
+
+def scale_by_adam(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8, amsgrad: bool = False) -> Transform:
+    def init(params):
+        zeros = lambda p: jnp.zeros_like(p, jnp.float32)
+        state = {
+            "count": jnp.zeros((), jnp.int32),
+            "mu": tree_map(zeros, params),
+            "nu": tree_map(zeros, params),
+        }
+        if amsgrad:
+            state["nu_max"] = tree_map(zeros, params)
+        return state
+
+    def update(grads, state, params):
+        count = state["count"] + 1
+        mu = tree_map(lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32), state["mu"], grads)
+        nu = tree_map(lambda v, g: b2 * v + (1 - b2) * jnp.square(g.astype(jnp.float32)), state["nu"], grads)
+        bc1 = 1 - b1 ** count.astype(jnp.float32)
+        bc2 = 1 - b2 ** count.astype(jnp.float32)
+        new_state = {"count": count, "mu": mu, "nu": nu}
+        denom_src = nu
+        if amsgrad:
+            nu_max = tree_map(jnp.maximum, state["nu_max"], nu)
+            new_state["nu_max"] = nu_max
+            denom_src = nu_max
+        updates = tree_map(
+            lambda m, v: (m / bc1) / (jnp.sqrt(v / bc2) + eps), mu, denom_src
+        )
+        return updates, new_state
+
+    return Transform(init, update)
+
+
+def scale_by_lion(b1: float = 0.9, b2: float = 0.99) -> Transform:
+    def init(params):
+        return {"mu": tree_map(lambda p: jnp.zeros_like(p, jnp.float32), params)}
+
+    def update(grads, state, params):
+        updates = tree_map(
+            lambda m, g: jnp.sign(b1 * m + (1 - b1) * g.astype(jnp.float32)), state["mu"], grads
+        )
+        mu = tree_map(lambda m, g: b2 * m + (1 - b2) * g.astype(jnp.float32), state["mu"], grads)
+        return updates, {"mu": mu}
+
+    return Transform(init, update)
+
+
+def _finish(
+    core: Transform,
+    schedule: Schedule,
+    weight_decay: float,
+    grad_clip: Optional[float],
+    ema_decay: Optional[float],
+) -> Transform:
+    t = chain(maybe_clip(grad_clip), core, add_decayed_weights(weight_decay, default_wd_mask),
+              scale_by_schedule(schedule))
+    return with_ema(t, ema_decay) if ema_decay else t
+
+
+def adamw(
+    schedule: Schedule,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    grad_clip: Optional[float] = None,
+    amsgrad: bool = False,
+    ema_decay: Optional[float] = None,
+) -> Transform:
+    return _finish(scale_by_adam(b1, b2, eps, amsgrad), schedule, weight_decay, grad_clip, ema_decay)
+
+
+def adam(schedule: Schedule, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+         grad_clip: Optional[float] = None) -> Transform:
+    return _finish(scale_by_adam(b1, b2, eps), schedule, 0.0, grad_clip, None)
+
+
+def sgd(
+    schedule: Schedule,
+    momentum: float = 0.0,
+    nesterov: bool = False,
+    weight_decay: float = 0.0,
+    grad_clip: Optional[float] = None,
+    ema_decay: Optional[float] = None,
+) -> Transform:
+    core = trace_momentum(momentum, nesterov) if momentum else Transform(lambda p: {}, lambda g, s, p: (g, s))
+    return _finish(core, schedule, weight_decay, grad_clip, ema_decay)
+
+
+def lion(
+    schedule: Schedule,
+    b1: float = 0.9,
+    b2: float = 0.99,
+    weight_decay: float = 0.0,
+    grad_clip: Optional[float] = None,
+    ema_decay: Optional[float] = None,
+) -> Transform:
+    return _finish(scale_by_lion(b1, b2), schedule, weight_decay, grad_clip, ema_decay)

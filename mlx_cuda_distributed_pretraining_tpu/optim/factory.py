@@ -1,0 +1,94 @@
+"""Optimizer factory: config name → Transform.
+
+Reference parity: core/training.py:764-896 (OptimizationManager) — names
+adam/adamw/sgd, adamw_enhanced/sgd_enhanced/lion, muon, shampoo, hybrid
+(recursive two-optimizer build :857-890).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .base import Schedule, Transform, partition
+from .enhanced import adam, adamw, lion, sgd
+from .muon import matrix_label_fn, muon
+from .schedules import build_schedule
+from .shampoo import shampoo
+
+
+def _hp(training_cfg: Any, key: str, default=None):
+    return (getattr(training_cfg, "hyperparameters", None) or {}).get(key, default)
+
+
+def _opt(training_cfg: Any, key: str, default=None):
+    return (getattr(training_cfg, "optimization", None) or {}).get(key, default)
+
+
+def build_optimizer(
+    training_cfg: Any,
+    total_steps: int,
+    name: Optional[str] = None,
+    schedule: Optional[Schedule] = None,
+) -> Transform:
+    name = (name or training_cfg.optimizer_name).lower()
+    schedule = schedule or build_schedule(training_cfg, total_steps)
+    wd = float(training_cfg.weight_decay)
+    clip = training_cfg.gradient_clip
+    betas = _opt(training_cfg, "betas", [0.9, 0.999])
+    eps = float(_opt(training_cfg, "eps", 1e-8))
+    ema_decay = _opt(training_cfg, "ema_decay")
+
+    if name in ("adamw", "adamw_enhanced"):
+        return adamw(
+            schedule, b1=float(betas[0]), b2=float(betas[1]), eps=eps, weight_decay=wd,
+            grad_clip=clip, amsgrad=bool(_opt(training_cfg, "amsgrad", False)),
+            ema_decay=ema_decay if name == "adamw_enhanced" else None,
+        )
+    if name == "adam":
+        return adam(schedule, b1=float(betas[0]), b2=float(betas[1]), eps=eps, grad_clip=clip)
+    if name in ("sgd", "sgd_enhanced"):
+        return sgd(
+            schedule, momentum=float(_opt(training_cfg, "momentum", 0.9)),
+            nesterov=bool(_opt(training_cfg, "nesterov", name == "sgd_enhanced")),
+            weight_decay=wd, grad_clip=clip,
+            ema_decay=ema_decay if name == "sgd_enhanced" else None,
+        )
+    if name in ("lion", "lion_enhanced"):
+        return lion(
+            schedule, b1=float(_opt(training_cfg, "betas", [0.9, 0.99])[0]),
+            b2=float(_opt(training_cfg, "betas", [0.9, 0.99])[1]),
+            weight_decay=wd, grad_clip=clip,
+            ema_decay=ema_decay if name == "lion_enhanced" else None,
+        )
+    if name == "muon":
+        return muon(
+            schedule, momentum=float(_opt(training_cfg, "momentum", 0.95)),
+            nesterov=bool(_opt(training_cfg, "nesterov", True)),
+            ns_steps=int(_opt(training_cfg, "ns_steps", 5)),
+            weight_decay=wd, grad_clip=clip,
+            adamw_lr_ratio=float(_opt(training_cfg, "adamw_lr_ratio", 1.0)),
+        )
+    if name == "shampoo":
+        return shampoo(
+            schedule, beta2=float(_opt(training_cfg, "beta2", 0.99)),
+            update_period=int(_opt(training_cfg, "update_period", 10)),
+            start_step=int(_opt(training_cfg, "start_preconditioning_step", 10)),
+            max_preconditioner_dim=int(_opt(training_cfg, "max_preconditioner_dim", 1024)),
+            momentum=float(_opt(training_cfg, "momentum", 0.9)),
+            graft_type=str(_opt(training_cfg, "graft_type", "adam")),
+            weight_decay=wd, grad_clip=clip,
+        )
+    if name == "hybrid":
+        # Two-optimizer partition: matrix params → matrix_optimizer, rest →
+        # non_matrix_optimizer (reference: core/training.py:857-890 +
+        # optimizers/hybrid_optimizer.py).
+        matrix_name = str(_opt(training_cfg, "matrix_optimizer", "muon"))
+        rest_name = str(_opt(training_cfg, "non_matrix_optimizer", "adamw"))
+        return partition(
+            matrix_label_fn,
+            {
+                "matrix": build_optimizer(training_cfg, total_steps, matrix_name, schedule),
+                "rest": build_optimizer(training_cfg, total_steps, rest_name, schedule),
+            },
+        )
+    raise ValueError(f"unknown optimizer {name!r}")

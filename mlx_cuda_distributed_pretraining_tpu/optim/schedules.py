@@ -1,0 +1,72 @@
+"""LR schedules as closed-form functions of the step (jit-safe).
+
+Capability parity with the reference schedules (reference:
+mlx_lm_utils.py:5-56 — linear_schedule, cosine_decay, join_schedules) and
+the trainer's builder (core/training.py:770-785 — cosine_with_warmup /
+cosine / linear with min_lr_ratio).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+
+from .base import Schedule
+
+
+def constant(value: float) -> Schedule:
+    return lambda step: jnp.asarray(value, jnp.float32)
+
+
+def linear_schedule(init_value: float, end_value: float, steps: int) -> Schedule:
+    def fn(step):
+        frac = jnp.clip(step / max(steps, 1), 0.0, 1.0)
+        return init_value + (end_value - init_value) * frac
+
+    return fn
+
+
+def cosine_decay(init_value: float, decay_steps: int, end_value: float = 0.0) -> Schedule:
+    def fn(step):
+        frac = jnp.clip(step / max(decay_steps, 1), 0.0, 1.0)
+        cos = 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+        return end_value + (init_value - end_value) * cos
+
+    return fn
+
+
+def join_schedules(schedules: Sequence[Schedule], boundaries: Sequence[int]) -> Schedule:
+    def fn(step):
+        step = jnp.asarray(step)
+        out = schedules[0](step)
+        for i, b in enumerate(boundaries):
+            out = jnp.where(step >= b, schedules[i + 1](step - b), out)
+        return out
+
+    return fn
+
+
+def warmup_cosine(peak: float, total_steps: int, warmup_steps: int, end_value: float = 0.0) -> Schedule:
+    return join_schedules(
+        [linear_schedule(0.0, peak, max(warmup_steps, 1)),
+         cosine_decay(peak, max(total_steps - warmup_steps, 1), end_value)],
+        [warmup_steps],
+    )
+
+
+def build_schedule(training_cfg: Any, total_steps: int) -> Schedule:
+    """From the config's ``training.scheduler`` section (reference:
+    core/training.py:770-785)."""
+    lr = training_cfg.learning_rate
+    sched = dict(getattr(training_cfg, "scheduler", None) or {})
+    kind = str(sched.get("type", "constant")).lower()
+    min_lr = lr * float(sched.get("min_lr_ratio", 0.0))
+    warmup = int(sched.get("warmup_steps", 0))
+    if kind == "cosine_with_warmup":
+        return warmup_cosine(lr, total_steps, warmup, min_lr)
+    if kind == "cosine":
+        return cosine_decay(lr, total_steps, min_lr)
+    if kind == "linear":
+        return linear_schedule(lr, min_lr, total_steps)
+    return constant(lr)

@@ -1,0 +1,164 @@
+"""Shampoo: Kronecker-factored second-order preconditioning.
+
+Reference parity: optimizers/shampoo.py — per-dimension statistics
+``G Gᵀ`` / ``Gᵀ G`` EMA (:229-255), inverse-pth-root preconditioners
+(:88-126), update-period + warmup gating (:210-227), Adam/SGD grafting via
+norm transplant (:297-312), ``max_preconditioner_dim`` cap (:30,198-199),
+decoupled weight decay.
+
+TPU-first design decisions:
+- the inverse 4th root uses fp32 ``eigh`` with trace normalization and
+  eigenvalue clamping instead of coupled Newton iteration — more robust
+  under jit, and the cost is amortized by the update period;
+- the update-period gate is ``lax.cond`` (not Python if) so the whole
+  optimizer jits into the train step;
+- dimensions above ``max_preconditioner_dim`` fall back to diagonal
+  statistics for that axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base import (
+    Schedule,
+    Transform,
+    add_decayed_weights,
+    chain,
+    default_wd_mask,
+    maybe_clip,
+    scale_by_schedule,
+    tree_map,
+)
+
+
+def inverse_pth_root(mat: jnp.ndarray, p: int, eps: float = 1e-6) -> jnp.ndarray:
+    """``mat^(-1/p)`` for a symmetric PSD fp32 matrix via eigendecomposition
+    with relative eigenvalue clamping."""
+    dim = mat.shape[0]
+    # Trace normalization keeps eigh well-conditioned across loss scales
+    # (the reference normalizes similarly: shampoo.py:108-124).
+    tr = jnp.trace(mat) / dim
+    scale = jnp.maximum(tr, eps)
+    lam, vec = jnp.linalg.eigh(mat / scale)
+    lam = jnp.maximum(lam, eps * jnp.max(lam))
+    root = (vec * (lam ** (-1.0 / p))[None, :]) @ vec.T
+    return root * (scale ** (-1.0 / p))
+
+
+def shampoo_core(
+    beta2: float = 0.99,
+    update_period: int = 10,
+    start_step: int = 10,
+    max_preconditioner_dim: int = 1024,
+    momentum: float = 0.9,
+    graft_type: str = "adam",
+    eps: float = 1e-12,
+) -> Transform:
+    """Preconditions 2-D gradients; other ranks pass through to the grafting
+    direction only."""
+
+    def _sides(p):
+        m, n = (p.shape + (1, 1))[:2] if p.ndim >= 2 else (0, 0)
+        return (
+            p.ndim == 2 and m <= max_preconditioner_dim,
+            p.ndim == 2 and n <= max_preconditioner_dim,
+        )
+
+    def init(params):
+        def per_param(p):
+            st = {}
+            if p.ndim == 2:
+                use_l, use_r = _sides(p)
+                m, n = p.shape
+                st["stats_l"] = jnp.zeros((m, m), jnp.float32) if use_l else jnp.zeros((m,), jnp.float32)
+                st["stats_r"] = jnp.zeros((n, n), jnp.float32) if use_r else jnp.zeros((n,), jnp.float32)
+                st["prec_l"] = jnp.eye(m, dtype=jnp.float32) if use_l else jnp.ones((m,), jnp.float32)
+                st["prec_r"] = jnp.eye(n, dtype=jnp.float32) if use_r else jnp.ones((n,), jnp.float32)
+            # grafting (adam) state
+            st["g_mu"] = jnp.zeros_like(p, jnp.float32)
+            st["g_nu"] = jnp.zeros_like(p, jnp.float32)
+            st["mom"] = jnp.zeros_like(p, jnp.float32)
+            return st
+
+        return {
+            "count": jnp.zeros((), jnp.int32),
+            "per_param": tree_map(lambda p: per_param(p), params),
+        }
+
+    def update(grads, state, params):
+        count = state["count"] + 1
+        refresh = (count % update_period == 0) | (count == start_step)
+        active = count >= start_step
+
+        def per_param(g, st):
+            g32 = g.astype(jnp.float32)
+            new = dict(st)
+            # grafting direction (adam by default; "sgd" grafts the raw grad)
+            mu = 0.9 * st["g_mu"] + 0.1 * g32
+            nu = 0.999 * st["g_nu"] + 0.001 * jnp.square(g32)
+            bc1 = 1 - 0.9 ** count.astype(jnp.float32)
+            bc2 = 1 - 0.999 ** count.astype(jnp.float32)
+            new["g_mu"], new["g_nu"] = mu, nu
+            graft_dir = (mu / bc1) / (jnp.sqrt(nu / bc2) + 1e-8) if graft_type == "adam" else g32
+
+            if g.ndim != 2:
+                direction = graft_dir
+            else:
+                use_l, use_r = _sides(g)
+                sl = st["stats_l"]
+                sr = st["stats_r"]
+                sl = beta2 * sl + (1 - beta2) * ((g32 @ g32.T) if use_l else jnp.sum(g32 * g32, axis=1))
+                sr = beta2 * sr + (1 - beta2) * ((g32.T @ g32) if use_r else jnp.sum(g32 * g32, axis=0))
+                new["stats_l"], new["stats_r"] = sl, sr
+
+                def recompute(_):
+                    pl = inverse_pth_root(sl, 4) if use_l else (sl + eps) ** -0.25
+                    pr = inverse_pth_root(sr, 4) if use_r else (sr + eps) ** -0.25
+                    return pl, pr
+
+                pl, pr = jax.lax.cond(refresh, recompute, lambda _: (st["prec_l"], st["prec_r"]), None)
+                new["prec_l"], new["prec_r"] = pl, pr
+
+                pg = (pl @ g32) if use_l else (pl[:, None] * g32)
+                pg = (pg @ pr) if use_r else (pg * pr[None, :])
+                # norm-transplant grafting (reference: shampoo.py:297-312)
+                pg_norm = jnp.linalg.norm(pg)
+                graft_norm = jnp.linalg.norm(graft_dir)
+                pg = pg * (graft_norm / jnp.maximum(pg_norm, eps))
+                direction = jnp.where(active, pg, graft_dir)
+
+            mom = momentum * st["mom"] + direction
+            new["mom"] = mom
+            return mom, new
+
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_s = treedef.flatten_up_to(state["per_param"])
+        outs = [per_param(g, s) for g, s in zip(flat_g, flat_s)]
+        updates = treedef.unflatten([o[0] for o in outs])
+        new_pp = treedef.unflatten([o[1] for o in outs])
+        return updates, {"count": count, "per_param": new_pp}
+
+    return Transform(init, update)
+
+
+def shampoo(
+    schedule: Schedule,
+    beta2: float = 0.99,
+    update_period: int = 10,
+    start_step: int = 10,
+    max_preconditioner_dim: int = 1024,
+    momentum: float = 0.9,
+    graft_type: str = "adam",
+    weight_decay: float = 0.0,
+    grad_clip: Optional[float] = None,
+) -> Transform:
+    return chain(
+        maybe_clip(grad_clip),
+        shampoo_core(beta2, update_period, start_step, max_preconditioner_dim, momentum, graft_type),
+        add_decayed_weights(weight_decay, default_wd_mask),
+        scale_by_schedule(schedule),
+    )

@@ -1,0 +1,191 @@
+"""Optimizer numerical tests: closed-form/NumPy references, convergence,
+and jit-ability (SURVEY.md §4 test plan item b)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlx_cuda_distributed_pretraining_tpu.config import TrainingConfig
+from mlx_cuda_distributed_pretraining_tpu.optim import (
+    adamw,
+    apply_updates,
+    build_optimizer,
+    build_schedule,
+    ema_params,
+    global_norm,
+    inverse_pth_root,
+    newton_schulz5,
+)
+from mlx_cuda_distributed_pretraining_tpu.optim.schedules import (
+    cosine_decay,
+    linear_schedule,
+    warmup_cosine,
+)
+
+
+def _quadratic_params():
+    return {"w": jnp.array([[2.0, -3.0], [1.5, 0.5]]), "b": jnp.array([1.0, -1.0])}
+
+
+def _run_steps(opt, params, grad_fn, n=50):
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state):
+        g = grad_fn(params)
+        u, state = opt.update(g, state, params)
+        return apply_updates(params, u), state
+
+    for _ in range(n):
+        params, state = step(params, state)
+    return params, state
+
+
+@pytest.mark.parametrize(
+    "name,opts",
+    [
+        ("adamw", {}),
+        ("adam", {}),
+        ("sgd", {"momentum": 0.9}),
+        ("lion", {"lr": 0.01, "n": 400}),
+        ("muon", {"lr": 0.02, "n": 300}),
+        ("shampoo", {"update_period": 5, "start_preconditioning_step": 5, "lr": 0.01, "n": 300}),
+        ("hybrid", {"matrix_optimizer": "muon", "non_matrix_optimizer": "adamw", "lr": 0.02, "n": 300}),
+        ("adamw_enhanced", {"amsgrad": True, "ema_decay": 0.99}),
+    ],
+)
+def test_optimizers_minimize_quadratic(name, opts):
+    opts = dict(opts)
+    lr = opts.pop("lr", 0.05)
+    n = opts.pop("n", 80)
+    cfg = TrainingConfig(
+        hyperparameters={"learning_rate": lr, "weight_decay": 0.0, "gradient_clip": 1.0},
+        scheduler={"type": "constant"},
+        optimization={"optimizer": name, **opts},
+    )
+    opt = build_optimizer(cfg, total_steps=100)
+    grad_fn = jax.grad(lambda p: jnp.sum(p["w"] ** 2) + jnp.sum(p["b"] ** 2))
+    params, _ = _run_steps(opt, _quadratic_params(), grad_fn, n=n)
+    final = float(jnp.sum(params["w"] ** 2) + jnp.sum(params["b"] ** 2))
+    assert final < 0.5, f"{name} failed to minimize: {final}"
+
+
+def test_adamw_matches_numpy_reference():
+    """One AdamW step vs a hand-computed reference."""
+    lr, b1, b2, eps = 0.1, 0.9, 0.999, 1e-8
+    opt = adamw(lambda s: jnp.float32(lr), b1=b1, b2=b2, eps=eps)
+    params = {"w": jnp.array([[1.0, 2.0]])}
+    grads = {"w": jnp.array([[0.5, -0.25]])}
+    state = opt.init(params)
+    u, state = opt.update(grads, state, params)
+    g = np.array([[0.5, -0.25]])
+    mu = (1 - b1) * g
+    nu = (1 - b2) * g**2
+    mhat = mu / (1 - b1)
+    vhat = nu / (1 - b2)
+    expected = -lr * mhat / (np.sqrt(vhat) + eps)
+    np.testing.assert_allclose(np.asarray(u["w"]), expected, rtol=1e-5)
+
+
+def test_weight_decay_skips_vectors():
+    opt = adamw(lambda s: jnp.float32(0.1), weight_decay=0.1)
+    params = {"w": jnp.ones((2, 2)), "b": jnp.ones((2,))}
+    zero_g = {"w": jnp.zeros((2, 2)), "b": jnp.zeros((2,))}
+    state = opt.init(params)
+    u, _ = opt.update(zero_g, state, params)
+    assert float(jnp.abs(u["w"]).sum()) > 0  # decayed
+    np.testing.assert_allclose(np.asarray(u["b"]), 0.0, atol=1e-7)  # skipped
+
+
+def test_newton_schulz_orthogonalizes():
+    """NS5 with Muon's quintic coefficients drives singular values into a
+    band around 1 (it is an approximate orthogonalizer by design)."""
+    rng = np.random.default_rng(0)
+    m = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+    o = newton_schulz5(m, steps=10)
+    sv = np.linalg.svd(np.asarray(o), compute_uv=False)
+    assert o.shape == (16, 8)
+    assert sv.max() < 1.6 and sv.min() > 0.4, sv
+    # and the update direction preserves the row/column space
+    sv_in = np.linalg.svd(np.asarray(m), compute_uv=False)
+    assert sv_in.max() / sv_in.min() > 2  # input was NOT orthogonal
+
+
+def test_inverse_pth_root():
+    rng = np.random.default_rng(1)
+    a = rng.normal(size=(6, 6)).astype(np.float32)
+    spd = a @ a.T + 0.5 * np.eye(6, dtype=np.float32)
+    root = np.asarray(inverse_pth_root(jnp.asarray(spd), 4))
+    # root^4 @ spd ≈ I
+    approx = root @ root @ root @ root @ spd
+    np.testing.assert_allclose(approx, np.eye(6), atol=2e-2)
+
+
+def test_grad_clip():
+    opt = adamw(lambda s: jnp.float32(1.0), grad_clip=1.0)
+    params = {"w": jnp.ones((4, 4))}
+    big = {"w": 100.0 * jnp.ones((4, 4))}
+    state = opt.init(params)
+    # after clipping, the global norm of what adam sees is <= 1
+    from mlx_cuda_distributed_pretraining_tpu.optim.base import clip_by_global_norm
+
+    clipped, _ = clip_by_global_norm(1.0).update(big, {}, params)
+    assert abs(float(global_norm(clipped)) - 1.0) < 1e-4
+
+
+def test_ema_shadow_tracks():
+    opt = adamw(lambda s: jnp.float32(0.1), ema_decay=0.5)
+    params = {"w": jnp.ones((2, 2))}
+    state = opt.init(params)
+    g = {"w": jnp.ones((2, 2))}
+    u, state = opt.update(g, state, params)
+    new_params = apply_updates(params, u)
+    shadow = ema_params(state)
+    expected = 0.5 * np.ones((2, 2)) + 0.5 * np.asarray(new_params["w"])
+    np.testing.assert_allclose(np.asarray(shadow["w"]), expected, rtol=1e-5)
+
+
+def test_schedules():
+    lin = linear_schedule(1.0, 0.0, 10)
+    assert abs(float(lin(0)) - 1.0) < 1e-6
+    assert abs(float(lin(5)) - 0.5) < 1e-6
+    assert abs(float(lin(20)) - 0.0) < 1e-6
+    cos = cosine_decay(1.0, 10, end_value=0.1)
+    assert abs(float(cos(0)) - 1.0) < 1e-6
+    assert abs(float(cos(10)) - 0.1) < 1e-6
+    wc = warmup_cosine(1.0, 100, 10)
+    assert float(wc(5)) < 1.0
+    assert abs(float(wc(10)) - 1.0) < 1e-5
+    assert float(wc(100)) < 0.01
+
+
+def test_build_schedule_from_config():
+    cfg = TrainingConfig(
+        hyperparameters={"learning_rate": 0.01},
+        scheduler={"type": "cosine_with_warmup", "warmup_steps": 10, "min_lr_ratio": 0.1},
+    )
+    s = build_schedule(cfg, total_steps=100)
+    assert abs(float(s(10)) - 0.01) < 1e-6
+    assert float(s(100)) >= 0.001 - 1e-6
+
+
+def test_optimizer_state_checkpoint_roundtrip(tmp_path):
+    """Optimizer state survives safetensors round-trip (SURVEY §4c)."""
+    from mlx_cuda_distributed_pretraining_tpu.checkpoint import CheckpointManager
+
+    cfg = TrainingConfig(
+        hyperparameters={"learning_rate": 0.05},
+        optimization={"optimizer": "hybrid", "matrix_optimizer": "muon", "non_matrix_optimizer": "adamw"},
+    )
+    opt = build_optimizer(cfg, total_steps=100)
+    params = _quadratic_params()
+    grad_fn = jax.grad(lambda p: jnp.sum(p["w"] ** 2) + jnp.sum(p["b"] ** 2))
+    params2, state = _run_steps(opt, params, grad_fn, n=3)
+
+    run_dir = CheckpointManager.setup_run_directory(str(tmp_path), "opt")
+    mgr = CheckpointManager(run_dir)
+    mgr.save(3, params2, state, {"step": 3})
+    _, state2, _ = mgr.load(3, like_params=params2, like_opt_state=state)
+    for a, b in zip(jax.tree_util.tree_leaves(state), jax.tree_util.tree_leaves(state2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
