@@ -1,0 +1,4 @@
+from .samplers import make_sampler, make_logits_processors
+from .generate import generate_lite, generate_text, beam_search
+
+__all__ = ["make_sampler", "make_logits_processors", "generate_lite", "generate_text", "beam_search"]

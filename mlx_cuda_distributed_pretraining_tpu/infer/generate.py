@@ -1,0 +1,257 @@
+"""KV-cached generation: chunk-bucketed prefill, jitted decode, beam search.
+
+Reference parity: core/generation_lite.py — ``generate_step`` decode
+generator with prompt cache + chunked prefill (:96-176), ``generate_lite``
+wrapper with stop tokens and tok/s + logprob stats (:183-291),
+``beam_search`` (:293-378).
+
+TPU-first: the per-token step is ONE jitted function (model fwd + logits
+processors + sampler fused); the KV cache is a static-shape buffer written
+with dynamic slices, so decode never recompiles; prompt lengths are
+bucketed (padded prefill writes junk past the true length, which decode
+provably overwrites before it ever becomes attendable).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import llama
+from .samplers import Sampler, greedy, make_sampler
+
+_STEP_CACHE: Dict[Any, Any] = {}
+
+
+def _round_up(n: int, mult: int) -> int:
+    return ((n + mult - 1) // mult) * mult
+
+
+def _decode_step(args: llama.LlamaArgs, with_processors: bool):
+    """Compiled once per (args, cache-size bucket) — cached."""
+    key = (args, with_processors)
+    if key in _STEP_CACHE:
+        return _STEP_CACHE[key]
+
+    @partial(jax.jit, static_argnames=("sampler", "processors"))
+    def step(params, cache, token, pos, rng, history, sampler, processors):
+        logits, cache = llama.forward(params, token[:, None], args, cache=cache, start_pos=pos)
+        logits = logits[:, -1, :]
+        for proc in processors or ():
+            logits = proc(history, logits)
+        rng, sub = jax.random.split(rng)
+        next_token = sampler(sub, logits)
+        logprob = jax.nn.log_softmax(logits, axis=-1)
+        lp = jnp.take_along_axis(logprob, next_token[:, None], axis=-1)[:, 0]
+        history = jnp.concatenate([history[:, 1:], next_token[:, None]], axis=1)
+        return cache, next_token, lp, rng, history
+
+    _STEP_CACHE[key] = step
+    return step
+
+
+def prefill(params, args: llama.LlamaArgs, tokens: np.ndarray, cache_len: int,
+            prefill_step_size: int = 512, cache_dtype=jnp.float32):
+    """Build a KV cache for ``tokens [B, P]``; returns (cache, last_logits).
+
+    The prompt is padded up to a multiple of ``prefill_step_size`` (one
+    compile per bucket); the cache position is then rewound to the true
+    length so decode overwrites the junk tail before it can be attended."""
+    B, P = tokens.shape
+    step = max(min(prefill_step_size, cache_len), 1)
+    bucket = min(max(_round_up(P, step), step), cache_len)
+    if bucket < P:
+        raise ValueError(f"prompt length {P} exceeds cache length {cache_len}")
+    padded = np.zeros((B, bucket), np.int32)
+    padded[:, :P] = tokens
+    cache = llama.init_cache(args, B, max_len=cache_len, dtype=cache_dtype)
+    logits, cache = llama.forward(params, jnp.asarray(padded), args, cache=cache, start_pos=0)
+    for layer in cache:
+        layer["pos"] = jnp.asarray(P, jnp.int32)
+    return cache, logits[:, P - 1, :]
+
+
+def generate_step(
+    params,
+    args: llama.LlamaArgs,
+    prompt_tokens: Sequence[int],
+    max_tokens: int = 128,
+    sampler: Optional[Sampler] = None,
+    logits_processors: Optional[Sequence] = None,
+    prefill_step_size: int = 512,
+    seed: int = 0,
+    rep_context: int = 64,
+) -> Iterator[Tuple[int, float]]:
+    """Yield ``(token, logprob)`` pairs, KV-cached (reference:
+    generation_lite.py:96-176)."""
+    sampler = sampler or greedy()
+    processors = tuple(logits_processors or ())
+    tokens = np.asarray(prompt_tokens, np.int32)[None, :]
+    P = tokens.shape[1]
+    cache_len = min(_round_up(P + max_tokens, 128), max(args.max_position_embeddings, P + max_tokens))
+    cache, last_logits = prefill(params, args, tokens, cache_len, prefill_step_size)
+
+    rng = jax.random.PRNGKey(seed)
+    rng, sub = jax.random.split(rng)
+    history = jnp.asarray(tokens[:, -rep_context:], jnp.int32)
+    pad = rep_context - history.shape[1]
+    if pad > 0:
+        history = jnp.concatenate([jnp.full((1, pad), -1, jnp.int32), history], axis=1)
+
+    for proc in processors:
+        last_logits = proc(history, last_logits)
+    lp0 = jax.nn.log_softmax(last_logits, axis=-1)
+    tok = sampler(sub, last_logits)
+    lp = jnp.take_along_axis(lp0, tok[:, None], axis=-1)[:, 0]
+    step = _decode_step(args, bool(processors))
+
+    pos = P
+    for i in range(max_tokens):
+        t_host = int(tok[0])
+        yield t_host, float(lp[0])
+        if i == max_tokens - 1:
+            break
+        history = jnp.concatenate([history[:, 1:], tok[:, None]], axis=1)
+        cache, tok, lp, rng, history = step(
+            params, cache, tok, jnp.asarray(pos, jnp.int32), rng, history,
+            sampler=sampler, processors=processors,
+        )
+        pos += 1
+
+
+def generate_lite(
+    params,
+    args: llama.LlamaArgs,
+    prompt_tokens: Sequence[int],
+    max_tokens: int = 128,
+    sampler: Optional[Sampler] = None,
+    logits_processors: Optional[Sequence] = None,
+    stop_tokens: Optional[Sequence[int]] = None,
+    prefill_step_size: int = 512,
+    seed: int = 0,
+    verbose: bool = False,
+) -> Tuple[List[int], Dict[str, float]]:
+    """Generate with stop tokens and throughput stats (reference:
+    generation_lite.py:183-291). Returns (tokens, stats)."""
+    stop = set(stop_tokens or ())
+    t0 = time.perf_counter()
+    out: List[int] = []
+    logprobs: List[float] = []
+    for tok, lp in generate_step(
+        params, args, prompt_tokens, max_tokens, sampler, logits_processors,
+        prefill_step_size, seed,
+    ):
+        if tok in stop:
+            break
+        out.append(tok)
+        logprobs.append(lp)
+    dt = max(time.perf_counter() - t0, 1e-9)
+    stats = {
+        "generation_tokens": float(len(out)),
+        "generation_tps": len(out) / dt,
+        "mean_logprob": float(np.mean(logprobs)) if logprobs else 0.0,
+        "prompt_tokens": float(len(prompt_tokens)),
+    }
+    if verbose:
+        print(f"[generate] {len(out)} tokens at {stats['generation_tps']:.1f} tok/s")
+    return out, stats
+
+
+def generate_text(
+    params,
+    args: llama.LlamaArgs,
+    tokenizer,
+    prompt: str,
+    max_new_tokens: int = 64,
+    temperature: float = 0.0,
+    top_p: float = 0.0,
+    min_p: float = 0.0,
+    repetition_penalty: Optional[float] = None,
+    seed: int = 0,
+) -> str:
+    """Convenience: str → str with EOS stop."""
+    from .samplers import make_logits_processors
+
+    ids = [tokenizer.bos_id] + tokenizer.tokenize(prompt)
+    sampler = make_sampler(temp=temperature, top_p=top_p, min_p=min_p)
+    toks, _ = generate_lite(
+        params, args, ids, max_tokens=max_new_tokens, sampler=sampler,
+        logits_processors=make_logits_processors(repetition_penalty),
+        stop_tokens=[tokenizer.eos_id], seed=seed,
+    )
+    return tokenizer.detokenize(toks)
+
+
+def beam_search(
+    params,
+    args: llama.LlamaArgs,
+    prompt_tokens: Sequence[int],
+    num_beams: int = 4,
+    max_tokens: int = 64,
+    eos_id: Optional[int] = None,
+    length_penalty: float = 1.0,
+    prefill_step_size: int = 512,
+) -> Tuple[List[int], float]:
+    """Batched beam decode with EOS beam retirement and length-normalized
+    scores (reference: generation_lite.py:293-378). Beams ride the batch
+    axis of one KV cache; beam reordering is a gather on axis 0 inside the
+    jitted step."""
+    tokens = np.asarray(prompt_tokens, np.int32)[None, :]
+    P = tokens.shape[1]
+    cache_len = min(_round_up(P + max_tokens, 128), max(args.max_position_embeddings, P + max_tokens))
+    cache, last_logits = prefill(params, args, np.repeat(tokens, num_beams, axis=0),
+                                 cache_len, prefill_step_size)
+
+    @jax.jit
+    def expand(cache, toks, pos, scores, alive):
+        logits, cache = llama.forward(params, toks[:, None], args, cache=cache, start_pos=pos)
+        lp = jax.nn.log_softmax(logits[:, -1, :], axis=-1)  # [k, V]
+        V = lp.shape[-1]
+        # finished beams may only extend with EOS at zero cost
+        if eos_id is not None:
+            frozen = jnp.full((V,), -jnp.inf).at[eos_id].set(0.0)
+            lp = jnp.where(alive[:, None], lp, frozen[None, :])
+        total = scores[:, None] + lp  # [k, V]
+        flat = total.reshape(-1)
+        top_scores, top_idx = jax.lax.top_k(flat, num_beams)
+        beam_origin = top_idx // V
+        new_tok = (top_idx % V).astype(jnp.int32)
+        cache = jax.tree_util.tree_map(
+            lambda a: jnp.take(a, beam_origin, axis=0) if jnp.ndim(a) == 4 else a, cache
+        )
+        new_alive = jnp.take(alive, beam_origin) & (new_tok != (eos_id if eos_id is not None else -1))
+        return cache, new_tok, top_scores, new_alive, beam_origin
+
+    # first expansion from prompt logits (all beams identical -> take row 0)
+    lp0 = jax.nn.log_softmax(last_logits[0], axis=-1)
+    top_scores, top_idx = jax.lax.top_k(lp0, num_beams)
+    toks = top_idx.astype(jnp.int32)
+    scores = top_scores
+    alive = toks != (eos_id if eos_id is not None else -1)
+    seqs = [[int(t)] for t in np.asarray(toks)]
+
+    pos = P
+    for _ in range(max_tokens - 1):
+        if not bool(np.any(np.asarray(alive))):
+            break
+        cache, toks, scores, alive, origin = expand(
+            cache, toks, jnp.asarray(pos, jnp.int32), scores, alive)
+        origin = np.asarray(origin)
+        toks_h = np.asarray(toks)
+        seqs = [seqs[origin[i]] + [int(toks_h[i])] for i in range(num_beams)]
+        pos += 1
+
+    scores_h = np.asarray(scores)
+    lengths = np.array([len(s) if eos_id is None else (s.index(eos_id) + 1 if eos_id in s else len(s))
+                        for s in seqs])
+    norm = scores_h / (lengths ** length_penalty)
+    best = int(np.argmax(norm))
+    seq = seqs[best]
+    if eos_id is not None and eos_id in seq:
+        seq = seq[: seq.index(eos_id)]
+    return seq, float(norm[best])

@@ -1,0 +1,119 @@
+"""Samplers and logits processors.
+
+Reference parity: mlx_lm_utils.py:58-146 — temperature, top-p, min-p
+samplers and repetition-penalty processor. All are pure functions on
+``logits [B, V]`` so they jit into the decode step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Sampler = Callable[[jax.Array, jnp.ndarray], jnp.ndarray]  # (key, logits[B,V]) -> [B]
+LogitsProcessor = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]  # (history[B,T], logits[B,V]) -> [B,V]
+
+
+def greedy() -> Sampler:
+    return lambda key, logits: jnp.argmax(logits, axis=-1)
+
+
+def temperature_sampler(temp: float) -> Sampler:
+    def sample(key, logits):
+        return jax.random.categorical(key, logits / max(temp, 1e-6), axis=-1)
+
+    return sample
+
+
+def top_p_sampler(temp: float, top_p: float) -> Sampler:
+    """Nucleus sampling: keep the smallest prefix of sorted probs whose mass
+    reaches ``top_p``."""
+
+    def sample(key, logits):
+        logits = logits / max(temp, 1e-6)
+        sorted_idx = jnp.argsort(-logits, axis=-1)
+        sorted_logits = jnp.take_along_axis(logits, sorted_idx, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs < top_p  # always keep the top token
+        masked = jnp.where(keep, sorted_logits, -jnp.inf)
+        choice = jax.random.categorical(key, masked, axis=-1)
+        return jnp.take_along_axis(sorted_idx, choice[:, None], axis=-1)[:, 0]
+
+    return sample
+
+
+def min_p_sampler(temp: float, min_p: float) -> Sampler:
+    """Keep tokens whose prob >= min_p * max_prob."""
+
+    def sample(key, logits):
+        logits = logits / max(temp, 1e-6)
+        probs = jax.nn.softmax(logits, axis=-1)
+        cutoff = min_p * jnp.max(probs, axis=-1, keepdims=True)
+        masked = jnp.where(probs >= cutoff, logits, -jnp.inf)
+        return jax.random.categorical(key, masked, axis=-1)
+
+    return sample
+
+
+def top_k_sampler(temp: float, top_k: int) -> Sampler:
+    def sample(key, logits):
+        logits = logits / max(temp, 1e-6)
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        masked = jnp.where(logits >= kth, logits, -jnp.inf)
+        return jax.random.categorical(key, masked, axis=-1)
+
+    return sample
+
+
+from functools import lru_cache
+
+
+@lru_cache(maxsize=64)
+def make_sampler(
+    temp: float = 0.0,
+    top_p: float = 0.0,
+    min_p: float = 0.0,
+    top_k: int = 0,
+) -> Sampler:
+    """Dispatch mirroring the reference's make_sampler precedence.
+
+    Cached so repeated calls return the identical function object — the
+    decode step jit treats the sampler as a static argument, so identity
+    equals zero recompiles."""
+    if temp == 0.0:
+        return greedy()
+    if min_p and min_p > 0.0:
+        return min_p_sampler(temp, min_p)
+    if top_p and 0.0 < top_p < 1.0:
+        return top_p_sampler(temp, top_p)
+    if top_k and top_k > 0:
+        return top_k_sampler(temp, top_k)
+    return temperature_sampler(temp)
+
+
+def repetition_penalty_processor(penalty: float, context_size: int = 64) -> LogitsProcessor:
+    """Divide (multiply for negatives) logits of recently-generated tokens
+    (reference: mlx_lm_utils.py repetition penalty). ``history`` is the fixed
+    -size ring of recent token ids, padded with -1."""
+
+    def process(history, logits):
+        hist = history[:, -context_size:]
+        B, V = logits.shape
+        one_hot = jax.nn.one_hot(jnp.where(hist < 0, 0, hist), V, dtype=bool)
+        seen = jnp.any(one_hot & (hist >= 0)[..., None], axis=1)  # [B, V]
+        penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+        return jnp.where(seen, penalized, logits)
+
+    return process
+
+
+@lru_cache(maxsize=64)
+def make_logits_processors(repetition_penalty: Optional[float] = None,
+                           repetition_context_size: int = 64) -> tuple:
+    out: List[LogitsProcessor] = []
+    if repetition_penalty and repetition_penalty != 1.0:
+        out.append(repetition_penalty_processor(repetition_penalty, repetition_context_size))
+    return tuple(out)

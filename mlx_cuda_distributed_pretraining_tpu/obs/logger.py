@@ -1,0 +1,124 @@
+"""Run logger: console + ``log.txt`` line protocol + optional TB/W&B.
+
+The line protocol is an API (reference: core/training.py:197-321 writes it;
+utils/plotting.py:27-47 and monitor_training.py:112-117 parse it):
+
+    Step <N>: loss=<f> | ppl=<f> | lr=<e> | tok/s=<f> | toks=<int>
+    Step <N> validation: val_loss=<f>
+
+TensorBoard (torch.utils.tensorboard) and W&B are optional and gated.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Any, Dict, Optional
+
+
+class Logger:
+    def __init__(self, run_dir: str, config: Optional[Any] = None, quiet: bool = False,
+                 write_files: bool = True):
+        """``write_files=False`` (non-zero processes on multi-host runs)
+        disables log.txt/TB/W&B output entirely so hosts sharing a
+        filesystem don't interleave duplicate protocol lines."""
+        self.run_dir = run_dir
+        self.quiet = quiet
+        self.log_path = os.path.join(run_dir, "log.txt")
+        os.makedirs(run_dir, exist_ok=True)
+        self._file = open(self.log_path if write_files else os.devnull, "a", buffering=1)
+        if not write_files:
+            config = None
+        self._tb = None
+        self._wandb = None
+        log_cfg = getattr(config, "logging", None) if config is not None else None
+
+        if log_cfg is not None and getattr(log_cfg, "tensorboard", False):
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(os.path.join(run_dir, "tensorboard"))
+            except ImportError:
+                self.log("tensorboard requested but torch.utils.tensorboard unavailable")
+        if log_cfg is not None and getattr(log_cfg, "wandb", False):
+            try:
+                import wandb
+
+                self._wandb = wandb
+                wandb.init(
+                    project=getattr(log_cfg, "wandb_project", None) or "tpu-pretrain",
+                    entity=getattr(log_cfg, "wandb_entity", None),
+                    name=os.path.basename(run_dir),
+                    config=config.to_dict() if hasattr(config, "to_dict") else None,
+                )
+            except Exception:
+                self._wandb = None
+                self.log("wandb requested but unavailable; continuing without it")
+
+    # -- plain lines --------------------------------------------------------
+    def log(self, message: str) -> None:
+        stamp = time.strftime("%Y-%m-%d %H:%M:%S")
+        line = f"[{stamp}] {message}"
+        if not self.quiet:
+            print(line, file=sys.stderr)
+        self._file.write(line + "\n")
+
+    def _raw(self, line: str) -> None:
+        if not self.quiet:
+            print(line)
+        self._file.write(line + "\n")
+
+    # -- metric protocol ----------------------------------------------------
+    def log_metrics(self, step: int, metrics: Dict[str, Any]) -> None:
+        parts = []
+        order = ["loss", "ppl", "lr", "grad_norm", "tok/s", "toks"]
+        keys = [k for k in order if k in metrics] + [k for k in metrics if k not in order]
+        for k in keys:
+            v = metrics[k]
+            if k == "lr":
+                parts.append(f"lr={v:.3e}")
+            elif k == "toks":
+                parts.append(f"toks={int(v)}")
+            elif isinstance(v, float):
+                parts.append(f"{k}={v:.4f}")
+            else:
+                parts.append(f"{k}={v}")
+        self._raw(f"Step {step}: " + " | ".join(parts))
+        if self._tb is not None:
+            for k, v in metrics.items():
+                if isinstance(v, (int, float)):
+                    self._tb.add_scalar(k.replace("/", "_per_"), v, step)
+        if self._wandb is not None:
+            self._wandb.log({k: v for k, v in metrics.items() if isinstance(v, (int, float))}, step=step)
+
+    def log_validation(self, step: int, val_loss: float, extra: Optional[Dict[str, float]] = None) -> None:
+        tail = "".join(f" {k}={v:.4f}" for k, v in (extra or {}).items())
+        self._raw(f"Step {step} validation: val_loss={val_loss:.4f}{tail}")
+        if self._tb is not None:
+            self._tb.add_scalar("val_loss", val_loss, step)
+        if self._wandb is not None:
+            self._wandb.log({"val_loss": val_loss}, step=step)
+
+    def log_model_summary(self, n_params: int, args: Any) -> None:
+        self.log(f"Model: {n_params:,} parameters ({n_params/1e6:.2f}M)")
+        self.log(f"Model args: {args}")
+
+    def log_sample(self, step: int, prompt: str, text: str) -> None:
+        self._raw(f"Step {step} sample: {prompt!r} -> {text!r}")
+
+    def log_memory(self) -> None:
+        try:
+            import psutil
+
+            mem = psutil.Process().memory_info().rss / 1e9
+            self.log(f"Host memory: {mem:.2f} GB")
+        except ImportError:
+            pass
+
+    def close(self) -> None:
+        if self._tb is not None:
+            self._tb.close()
+        if self._wandb is not None:
+            self._wandb.finish()
+        self._file.close()

@@ -1,0 +1,7 @@
+from .mesh import build_mesh, mesh_axis_sizes
+from .sharding_rules import batch_pspec, param_pspec, state_sharding, tree_pspecs
+
+__all__ = [
+    "build_mesh", "mesh_axis_sizes", "batch_pspec", "param_pspec",
+    "state_sharding", "tree_pspecs",
+]

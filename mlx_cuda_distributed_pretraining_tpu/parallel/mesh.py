@@ -1,0 +1,63 @@
+"""Device mesh construction.
+
+The communication backend of this framework IS the mesh: XLA emits
+psum/all-gather/reduce-scatter/ppermute over ICI from sharding annotations.
+This replaces the reference's entire thread-queue + JSON/HTTP/Modal RPC
+data plane (reference: distributed/utils.py DeviceManager,
+distributed/hybrid_distributed.py HybridDeviceManager, distributed/worker.py).
+
+Axes (any subset, in this order):
+- ``dp``  — data parallel (batch split; gradient psum)
+- ``fsdp``— fully-sharded data parallel (params/opt-state sharded; batch
+            also split along it)
+- ``sp``  — sequence/context parallel (ring attention over ``ppermute``)
+- ``tp``  — tensor parallel (attention heads / MLP columns)
+
+``-1`` on one axis means "all remaining devices".
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+AXIS_ORDER = ("dp", "fsdp", "sp", "tp")
+
+
+def mesh_axis_sizes(system_cfg: Any, n_devices: Optional[int] = None) -> Dict[str, int]:
+    n = n_devices if n_devices is not None else jax.device_count()
+    sizes = {k: int(v) for k, v in (getattr(system_cfg, "mesh", None) or {}).items()}
+    if not sizes:
+        # Legacy flags: model_parallel -> tp axis (reference config keys
+        # system.model_parallel/model_parallel_size, core/training.py:119-120).
+        if getattr(system_cfg, "model_parallel", False):
+            tp = max(1, int(getattr(system_cfg, "model_parallel_size", 1)))
+            sizes = {"dp": -1, "tp": tp}
+        else:
+            sizes = {"dp": -1}
+    unknown = set(sizes) - set(AXIS_ORDER)
+    if unknown:
+        raise ValueError(f"unknown mesh axes {unknown}; valid: {AXIS_ORDER}")
+    fixed = int(np.prod([v for v in sizes.values() if v > 0])) if sizes else 1
+    for k, v in sizes.items():
+        if v == -1:
+            if n % fixed != 0:
+                raise ValueError(f"device count {n} not divisible by fixed axes {fixed}")
+            sizes[k] = n // fixed
+    total = int(np.prod(list(sizes.values())))
+    if total != n:
+        raise ValueError(f"mesh {sizes} covers {total} devices, have {n}")
+    return {a: sizes.get(a, 1) for a in AXIS_ORDER if sizes.get(a, 1) > 1 or a in sizes}
+
+
+def build_mesh(system_cfg: Any, devices: Optional[List] = None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    sizes = mesh_axis_sizes(system_cfg, len(devices))
+    names = tuple(sizes.keys())
+    shape = tuple(sizes.values())
+    dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    return Mesh(dev_array, names)

@@ -1,0 +1,142 @@
+"""Parameter/batch partition rules → NamedSharding.
+
+Megatron-style tensor parallelism expressed as sharding annotations (the
+reference's ``model_parallel`` flag is a placeholder — core/training.py:
+1186-1193; here it is real): column-parallel up-projections shard their
+output dim over ``tp``, row-parallel down-projections shard their input dim,
+embeddings are vocab-parallel. XLA inserts the all-reduces.
+
+ZeRO-1 (reference's ``zero_optimization_level`` — core/training.py:121,
+chunked optimizer update modal/modal_cuda_utils.py:399-517): optimizer-state
+leaves inherit their param's spec, then shard the first still-replicated
+dim over the ``dp`` axis when divisible.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# (path regex, spec builder). fsdp shards the non-tp dim of every matrix.
+_RULES = [
+    (r"tok_embeddings\.weight$", ("tp", "fsdp")),  # [V, D] vocab-parallel
+    (r"output\.weight$", ("fsdp", "tp")),          # [D, V]
+    (r"attention\.w[qkv]\.weight$", ("fsdp", "tp")),  # [D, H*Dh] column
+    (r"attention\.wo\.weight$", ("tp", "fsdp")),      # [H*Dh, D] row
+    (r"feed_forward\.w_(gate|up)\.weight$", ("fsdp", "tp")),  # [D, I] column
+    (r"feed_forward\.w_down\.weight$", ("tp", "fsdp")),       # [I, D] row
+    (r"\.bias$", (None,)),
+    (r"norm\.weight$", (None,)),
+]
+
+
+def _axis(mesh: Mesh, name: Optional[str]) -> Optional[str]:
+    return name if (name is not None and name in mesh.axis_names and mesh.shape[name] > 1) else None
+
+
+def param_pspec(path: str, shape, mesh: Mesh) -> P:
+    for pattern, dims in _RULES:
+        if re.search(pattern, path):
+            out = []
+            for i, d in enumerate(dims[: len(shape)]):
+                ax = _axis(mesh, d)
+                if ax is not None and shape[i] % mesh.shape[ax] == 0:
+                    out.append(ax)
+                else:
+                    out.append(None)
+            out += [None] * (len(shape) - len(out))
+            return P(*out)
+    return P()  # replicated default (1-D norms etc.)
+
+
+def batch_pspec(mesh: Mesh) -> P:
+    """Batch dim over dp×fsdp; sequence dim over sp (context parallel)."""
+    data_axes = tuple(a for a in ("dp", "fsdp") if _axis(mesh, a))
+    seq_axis = _axis(mesh, "sp")
+    return P(data_axes if data_axes else None, seq_axis)
+
+
+def tree_pspecs(params: Any, mesh: Mesh) -> Any:
+    """PartitionSpec tree for a param pytree (paths joined with '.')."""
+    from ..utils.tree import flatten_dict, unflatten_dict
+
+    flat = flatten_dict(params)
+    specs = {k: param_pspec(k, np.shape(v), mesh) for k, v in flat.items()}
+    nested = unflatten_dict(specs)
+    return _match_structure(params, nested)
+
+
+def _match_structure(like: Any, nested: Any) -> Any:
+    if isinstance(like, dict):
+        return {k: _match_structure(v, nested[k]) for k, v in like.items()}
+    if isinstance(like, (list, tuple)):
+        vals = [_match_structure(v, nested[str(i)]) for i, v in enumerate(like)]
+        return type(like)(vals) if isinstance(like, tuple) else vals
+    return nested
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return ".".join(parts)
+
+
+def state_sharding(state: Any, mesh: Mesh, zero_level: int = 0) -> Any:
+    """Shardings for {params, opt_state, step}-style train state.
+
+    Optimizer-state leaves are matched to their parameter **by path
+    suffix** (e.g. ``1.mu.layers.0.attention.wq.weight`` matches param
+    ``layers.0.attention.wq.weight``) — shape-based matching would collide
+    for same-shape params with transposed specs (wq vs wo when
+    num_heads*head_dim == hidden_size). With ``zero_level >= 1`` a
+    still-unsharded axis of each matched leaf is additionally sharded over
+    ``dp`` when divisible (optimizer-state partitioning à la ZeRO-1).
+    """
+    dp = _axis(mesh, "dp")
+
+    param_specs: dict = {}
+    param_shapes: dict = {}
+
+    def record(path, leaf):
+        k = _path_str(path)
+        param_specs[k] = param_pspec(k, np.shape(leaf), mesh)
+        param_shapes[k] = np.shape(leaf)
+        return NamedSharding(mesh, param_specs[k])
+
+    params_shardings = jax.tree_util.tree_map_with_path(record, state["params"])
+    # longest param paths first so the most specific suffix wins
+    ordered_paths = sorted(param_specs, key=len, reverse=True)
+
+    def opt_leaf(path, leaf):
+        k = _path_str(path)
+        shape = np.shape(leaf)
+        spec = P()
+        if len(shape) > 0:
+            for p in ordered_paths:
+                if (k == p or k.endswith("." + p)) and param_shapes[p] == shape:
+                    spec = param_specs[p]
+                    break
+            if zero_level >= 1 and dp is not None:
+                dims = list(spec) + [None] * (len(shape) - len(spec))
+                for i, d in enumerate(dims):
+                    if d is None and shape[i] % mesh.shape[dp] == 0 and shape[i] > 1:
+                        dims[i] = dp
+                        break
+                spec = P(*dims)
+        return NamedSharding(mesh, spec)
+
+    return {
+        "params": params_shardings,
+        "opt_state": jax.tree_util.tree_map_with_path(opt_leaf, state["opt_state"]),
+        "step": NamedSharding(mesh, P()),
+    }

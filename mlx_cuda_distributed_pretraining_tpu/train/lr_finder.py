@@ -1,0 +1,111 @@
+"""Learning-rate finder: exponential LR sweep with divergence stop.
+
+Reference parity: core/training.py:671-761 + runner :1480-1532 — sweep
+``min_lr → max_lr`` over N steps, stop when loss > 4x best, suggest the LR
+at the steepest descent of the smoothed curve, dump CSV (matplotlib plot
+when available).
+"""
+
+from __future__ import annotations
+
+import csv
+import math
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..optim.base import apply_updates
+
+
+def run_lr_finder(
+    params: Any,
+    loss_fn: Callable,
+    batch_iter: Callable[[int], Dict],
+    min_lr: float = 1e-7,
+    max_lr: float = 1.0,
+    num_steps: int = 100,
+    smoothing: float = 0.05,
+    diverge_factor: float = 4.0,
+    out_dir: Optional[str] = None,
+) -> Tuple[float, List[float], List[float]]:
+    """Returns (suggested_lr, lrs, losses). Uses momentum SGD like the
+    reference (:1520). ``batch_iter(i)`` supplies the batch for step i."""
+    gamma = (max_lr / min_lr) ** (1.0 / max(num_steps - 1, 1))
+
+    # Inline momentum-SGD so the LR can be a traced jit argument (one
+    # compile for the whole sweep).
+    def opt_init(params):
+        return jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+
+    @jax.jit
+    def step(params, trace, batch, lr):
+        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+        new_trace = jax.tree_util.tree_map(lambda t, g: 0.9 * t + g.astype(jnp.float32), trace, grads)
+        updates = jax.tree_util.tree_map(lambda t: -lr * t, new_trace)
+        return apply_updates(params, updates), new_trace, loss
+
+    state = opt_init(params)
+    lrs: List[float] = []
+    losses: List[float] = []
+    smooth = None
+    best = math.inf
+    lr = min_lr
+    for i in range(num_steps):
+        batch = batch_iter(i)
+        params, state, loss = step(params, state, batch, jnp.float32(lr))
+        loss = float(loss)
+        smooth = loss if smooth is None else smoothing * loss + (1 - smoothing) * smooth
+        lrs.append(lr)
+        losses.append(smooth)
+        best = min(best, smooth)
+        if not math.isfinite(smooth) or smooth > diverge_factor * best:
+            break
+        lr *= gamma
+
+    suggested = suggest_lr(lrs, losses)
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, "lr_finder.csv"), "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["lr", "smoothed_loss"])
+            w.writerows(zip(lrs, losses))
+        _maybe_plot(lrs, losses, suggested, os.path.join(out_dir, "lr_finder.png"))
+    return suggested, lrs, losses
+
+
+def suggest_lr(lrs: List[float], losses: List[float]) -> float:
+    """LR at the steepest descent of loss w.r.t. log(lr); falls back to
+    best/10."""
+    if len(lrs) < 4:
+        return lrs[len(lrs) // 2] if lrs else 1e-3
+    best_slope, best_idx = 0.0, None
+    for i in range(1, len(lrs) - 1):
+        dlog = math.log(lrs[i + 1]) - math.log(lrs[i - 1])
+        slope = (losses[i + 1] - losses[i - 1]) / dlog if dlog else 0.0
+        if slope < best_slope:
+            best_slope, best_idx = slope, i
+    if best_idx is not None:
+        return lrs[best_idx]
+    return lrs[losses.index(min(losses))] / 10.0
+
+
+def _maybe_plot(lrs, losses, suggested, path):
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        return
+    fig, ax = plt.subplots(figsize=(7, 4))
+    ax.plot(lrs, losses)
+    ax.set_xscale("log")
+    ax.axvline(suggested, color="tab:red", linestyle="--", label=f"suggested={suggested:.2e}")
+    ax.set_xlabel("learning rate")
+    ax.set_ylabel("smoothed loss")
+    ax.legend()
+    fig.tight_layout()
+    fig.savefig(path)
+    plt.close(fig)

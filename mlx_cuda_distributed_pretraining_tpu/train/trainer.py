@@ -1,0 +1,448 @@
+"""Trainer — the training runtime spine.
+
+Capability parity with the reference Trainer (reference:
+core/training.py:898-2082): config → run dir → tokenizer → model → data →
+optimizer → train loop with validation / early stopping / LR finder /
+sample generation / checkpoint-resume, plus the ``log.txt`` metric protocol.
+
+TPU-native structure: the hot path is ONE jitted, buffer-donated,
+mesh-sharded XLA program (train_step.py); the Python loop only feeds numpy
+batches and reads back scalar metrics every ``logging_interval`` steps.
+Multi-host SPMD replaces the reference's device-thread + remote-worker
+coordinator (hybrid_distributed.py): every host runs this same class;
+per-host data sharding comes from ``jax.process_index()``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..checkpoint import CheckpointManager
+from ..config import Config, apply_overrides
+from ..data import DataManager
+from ..models.llama import LlamaArgs
+from ..models import llama as llama_mod
+from ..models.registry import resolve_architecture
+from ..obs import Logger
+from ..optim import build_optimizer, build_schedule
+from ..parallel import build_mesh
+from ..tokenizer import TokenizerManager
+from .early_stopping import EarlyStoppingMonitor
+from .lr_finder import run_lr_finder
+from .train_step import init_train_state, make_eval_step, make_train_step
+
+
+class Trainer:
+    def __init__(
+        self,
+        config: Any,
+        for_training: bool = True,
+        runs_root: str = "runs",
+        quiet: bool = False,
+    ):
+        self.config: Config = config if isinstance(config, Config) else Config.from_yaml(config)
+        cfg = self.config
+        self.for_training = for_training
+        self.runs_root = runs_root
+
+        # -- system: seeds, mesh (reference setup_system :964-1016) ---------
+        self.rng = jax.random.PRNGKey(cfg.system.seed)
+        np.random.seed(cfg.system.seed)
+        self.mesh = None
+        explicit_mesh = bool(getattr(cfg.system, "mesh", None)) or cfg.system.model_parallel
+        if explicit_mesh:
+            self.mesh = build_mesh(cfg.system)
+        elif jax.device_count() > 1 and for_training:
+            # Implicit pure-DP mesh over all devices — but only when the
+            # global batch divides evenly; otherwise stay single-program on
+            # device 0 (the reference likewise falls back to one device when
+            # distribution isn't configured: core/training.py:964-1016).
+            if cfg.training.batch_size % jax.device_count() == 0:
+                self.mesh = build_mesh(cfg.system)
+
+        # -- run dir ---------------------------------------------------------
+        resume = cfg.resume is not None and bool(cfg.resume.checkpoint)
+        run_dir = os.path.join(runs_root, cfg.name)
+        if for_training and not resume and jax.process_index() == 0:
+            run_dir = CheckpointManager.setup_run_directory(runs_root, cfg.name, cfg.overwrite)
+        self.run_dir = run_dir
+        os.makedirs(run_dir, exist_ok=True)
+        self.checkpoints = CheckpointManager(run_dir)
+        is_chief = jax.process_index() == 0
+        self.logger = Logger(run_dir, cfg, quiet=quiet or not is_chief, write_files=is_chief)
+        if for_training and not resume and is_chief:
+            cfg.to_yaml(os.path.join(run_dir, "config.yaml"))
+
+        # -- tokenizer -------------------------------------------------------
+        self.tokenizer = TokenizerManager(cfg.data, run_dir=run_dir if for_training else None)
+
+        # -- model -----------------------------------------------------------
+        arch = resolve_architecture(cfg.model.architecture)
+        self.arch = arch
+        args = LlamaArgs.from_config(cfg.model, self.tokenizer.vocab_size)
+        if arch.force_attention:
+            args = args.__class__(**{**args.__dict__, "attention_type": arch.force_attention})
+        self.model_args = args
+        self.rng, init_key = jax.random.split(self.rng)
+        self.params = arch.init_params(init_key, args)
+        self.logger.log_model_summary(llama_mod.num_params(self.params), args)
+
+        self.compute_dtype = jnp.bfloat16 if cfg.system.compute_dtype == "bfloat16" else jnp.float32
+        remat = cfg.system.remat
+        if remat is None and cfg.system.gradient_checkpointing:
+            remat = "full"
+        self.remat = remat
+        self.remat_ratio = float(cfg.system.gradient_checkpointing_ratio)
+
+        def loss_fn(params, batch):
+            return arch.loss_fn(
+                params, batch, args, compute_dtype=self.compute_dtype,
+                remat=self.remat, remat_ratio=self.remat_ratio,
+            )
+
+        self.loss_fn = loss_fn
+
+        # -- data ------------------------------------------------------------
+        self.data: Optional[DataManager] = None
+        if for_training:
+            self.data = DataManager(
+                cfg.data,
+                self.tokenizer,
+                batch_size=cfg.training.batch_size,
+                seq_len=cfg.data.max_context_size,
+                seed=cfg.system.seed,
+                process_index=jax.process_index(),
+                process_count=jax.process_count(),
+            )
+
+        # -- steps / optimizer (reference setup_training :1093-1133) --------
+        self.total_steps = 0
+        if for_training:
+            if cfg.training.iters:
+                self.total_steps = cfg.training.iters
+            elif cfg.training.epochs:
+                self.total_steps = cfg.training.epochs * self.data.batches_per_epoch
+            else:
+                self.total_steps = self.data.batches_per_epoch
+        self.schedule = build_schedule(cfg.training, max(self.total_steps, 1))
+        self.optimizer = build_optimizer(cfg.training, max(self.total_steps, 1), schedule=self.schedule)
+        self.accum_steps = cfg.training.gradient_accumulation_steps
+
+        self.train_step, self.state_shardings = make_train_step(
+            self.loss_fn, self.optimizer,
+            accum_steps=self.accum_steps,
+            mesh=self.mesh,
+            zero_level=cfg.system.zero_optimization_level,
+            log_grad_norm=cfg.logging.log_gradient_norm,
+            params_like=self.params,
+        )
+        self.eval_step = make_eval_step(self.loss_fn, self.mesh, self.state_shardings)
+
+        self.state = init_train_state(self.params, self.optimizer)
+        if self.mesh is not None and self.state_shardings is not None:
+            self.state = jax.device_put(self.state, self.state_shardings)
+
+        self.early_stopping = EarlyStoppingMonitor.from_config(cfg.training)
+        self.total_tokens = 0
+        self.start_step = 0
+        self.val_history: Dict[str, list] = {"steps": [], "losses": []}
+
+        if resume and for_training:
+            self._resume()
+
+    # -- checkpointing ------------------------------------------------------
+    def save_checkpoint(self, step) -> None:
+        if jax.process_index() != 0:
+            return
+        training_state = {
+            "step": int(self.state["step"]),
+            "total_tokens": int(self.total_tokens),
+            "val_ptr": self.data.val_ptr if self.data else 0,
+            "validation": self.val_history,
+            "early_stopping": self.early_stopping.state_dict(),
+        }
+        self.checkpoints.save(
+            step, self.state["params"], self.state["opt_state"], training_state,
+            metadata_extra={"total_tokens": int(self.total_tokens)},
+        )
+        self._write_metadata_summary()
+        self.logger.log(f"Saved checkpoint at step {step}")
+
+    def _write_metadata_summary(self) -> None:
+        meta_path = os.path.join(self.run_dir, "metadata.json")
+        ledger = {}
+        if os.path.exists(meta_path):
+            try:
+                with open(meta_path) as f:
+                    ledger = json.load(f)
+            except (json.JSONDecodeError, OSError):
+                ledger = {}
+        ledger["validation"] = self.val_history
+        ledger["total_tokens"] = int(self.total_tokens)
+        with open(meta_path, "w") as f:
+            json.dump(ledger, f, indent=2)
+
+    def _resume(self) -> None:
+        """Resume from ``resume.checkpoint`` (reference: :1545-1564 with
+        reset_optimizer / reset_training_state flags :124-127)."""
+        rc = self.config.resume
+        tag = rc.checkpoint
+        if tag in ("latest", ""):
+            tag = self.checkpoints.latest_step() or "final"
+        params, opt_state, tstate = self.checkpoints.load(
+            tag, like_params=self.state["params"],
+            like_opt_state=None if rc.reset_optimizer else self.state["opt_state"],
+        )
+        step = 0 if rc.reset_training_state else int(tstate.get("step", 0))
+        self.state = {
+            "params": jax.tree_util.tree_map(jnp.asarray, params),
+            "opt_state": self.state["opt_state"] if rc.reset_optimizer or opt_state is None
+            else jax.tree_util.tree_map(jnp.asarray, opt_state),
+            "step": jnp.asarray(step, jnp.int32),
+        }
+        if self.mesh is not None and self.state_shardings is not None:
+            self.state = jax.device_put(self.state, self.state_shardings)
+        if not rc.reset_training_state:
+            self.start_step = step
+            self.total_tokens = int(tstate.get("total_tokens", 0))
+            self.val_history = tstate.get("validation", self.val_history)
+            if self.data:
+                self.data.load_state_dict(tstate)
+            self.early_stopping.load_state_dict(tstate.get("early_stopping", {}))
+        self.logger.log(f"Resumed from checkpoint {tag} at step {self.start_step}")
+
+    # -- validation ---------------------------------------------------------
+    def validate(self, cap: int = 50) -> Optional[float]:
+        if self.data is None or not self.data.has_validation_data:
+            return None
+        total_nll, total_toks = 0.0, 0.0
+        for batch in self.data.iter_validation(cap):
+            loss, toks = self.eval_step(self.state["params"], _device_batch(batch))
+            total_nll += float(loss) * float(toks)
+            total_toks += float(toks)
+        return total_nll / max(total_toks, 1.0)
+
+    # -- sample generation (reference: :1818-1904) --------------------------
+    def generate_samples(self, step: int, prompts=None, max_new_tokens: int = 48) -> None:
+        try:
+            from ..infer.generate import generate_text
+        except ImportError:
+            return
+        prompts = prompts or ["Once upon a time"]
+        count = int(self.config.logging.log_samples_count or 1)
+        for prompt in prompts[:count]:
+            try:
+                text = generate_text(
+                    self.state["params"], self.model_args, self.tokenizer, prompt,
+                    max_new_tokens=max_new_tokens, temperature=0.0,
+                )
+                self.logger.log_sample(step, prompt, text)
+            except Exception as e:  # sampling must never kill training
+                self.logger.log(f"sample generation failed: {e}")
+                return
+
+    # -- LR finder ----------------------------------------------------------
+    def maybe_run_lr_finder(self) -> Optional[float]:
+        """Run the sweep and ADOPT the suggested LR (reference:
+        core/training.py:1569-1576 rebuilds the optimizer with it). Skipped
+        on resume, as the reference does."""
+        lf = dict(self.config.training.lr_finder or {})
+        if not lf.get("enabled") or self.start_step > 0:
+            return None
+        self.logger.log("Running LR finder sweep")
+        suggested, _, _ = run_lr_finder(
+            self.state["params"], self.loss_fn,
+            lambda i: _device_batch(self.data.generate_batch(i)),
+            min_lr=float(lf.get("min_lr", 1e-7)),
+            max_lr=float(lf.get("max_lr", 1.0)),
+            num_steps=int(lf.get("num_steps", 100)),
+            out_dir=self.run_dir,
+        )
+        self.logger.log(f"LR finder suggestion: {suggested:.3e}; rebuilding optimizer with it")
+        self.config.training.hyperparameters["learning_rate"] = float(suggested)
+        self.schedule = build_schedule(self.config.training, max(self.total_steps, 1))
+        self.optimizer = build_optimizer(
+            self.config.training, max(self.total_steps, 1), schedule=self.schedule)
+        self.train_step, self.state_shardings = make_train_step(
+            self.loss_fn, self.optimizer,
+            accum_steps=self.accum_steps,
+            mesh=self.mesh,
+            zero_level=self.config.system.zero_optimization_level,
+            log_grad_norm=self.config.logging.log_gradient_norm,
+            params_like=self.params,
+        )
+        self.state = init_train_state(self.state["params"], self.optimizer)
+        if self.mesh is not None and self.state_shardings is not None:
+            self.state = jax.device_put(self.state, self.state_shardings)
+        return suggested
+
+    # -- the loop -----------------------------------------------------------
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        log_int = max(1, cfg.logging.logging_interval)
+        ckpt_int = cfg.logging.checkpoint_interval
+        val_int = cfg.logging.validation_interval
+        self.maybe_run_lr_finder()
+
+        if self.start_step == 0 and val_int:
+            v = self.validate()
+            if v is not None:
+                self.logger.log_validation(0, v)
+                self.val_history["steps"].append(0)
+                self.val_history["losses"].append(v)
+
+        window_tokens = 0
+        window_start = time.perf_counter()
+        last_loss = float("nan")
+        stopped_early = False
+
+        for step in range(self.start_step + 1, self.total_steps + 1):
+            batch = self.data.generate_batch(step - 1)
+            # Host-side token count (non-pad targets) so tok/s stays correct
+            # even when device metrics are only read every log_int steps.
+            step_tokens = int(batch["mask"].sum()) * jax.process_count()
+            window_tokens += step_tokens
+            self.total_tokens += step_tokens
+            self.state, metrics = self.train_step(self.state, _device_batch(batch))
+
+            if step % log_int == 0 or step == self.total_steps:
+                loss = float(metrics["loss"])  # device sync point
+                last_loss = loss
+                elapsed = max(time.perf_counter() - window_start, 1e-9)
+                line = {
+                    "loss": loss,
+                    "ppl": float(math.exp(min(loss, 30.0))),
+                    "lr": float(self.schedule(jnp.asarray(step))),
+                    "tok/s": window_tokens / elapsed,
+                    "toks": int(window_tokens),
+                }
+                if "grad_norm" in metrics:
+                    line["grad_norm"] = float(metrics["grad_norm"])
+                if int(metrics["nonfinite"]):
+                    self.logger.log(f"WARNING: non-finite loss at step {step}")
+                self.logger.log_metrics(step, line)
+                window_tokens = 0
+                window_start = time.perf_counter()
+
+            if val_int and step % val_int == 0:
+                v = self.validate()
+                if v is not None:
+                    self.logger.log_validation(step, v)
+                    self.val_history["steps"].append(step)
+                    self.val_history["losses"].append(v)
+                    if self.early_stopping.update(v):
+                        self.logger.log(f"Early stopping triggered at step {step}")
+                        stopped_early = True
+
+            if cfg.logging.log_samples and val_int and step % val_int == 0:
+                self.generate_samples(step)
+
+            if ckpt_int and step % ckpt_int == 0:
+                self.save_checkpoint(step)
+
+            if stopped_early:
+                break
+
+        step = int(self.state["step"])
+        if self.val_history["steps"] and self.val_history["steps"][-1] == step:
+            final_val = self.val_history["losses"][-1]  # just validated at this step
+        else:
+            final_val = self.validate()
+            if final_val is not None:
+                self.logger.log_validation(step, final_val)
+                self.val_history["steps"].append(step)
+                self.val_history["losses"].append(final_val)
+        self.save_checkpoint("final")
+        self.logger.log("Training complete")
+        self.logger.close()
+        return {"final_loss": last_loss, "final_val_loss": final_val, "steps": step}
+
+
+def _device_batch(batch: Dict[str, np.ndarray]) -> Dict[str, jnp.ndarray]:
+    return {k: jnp.asarray(v) for k, v in batch.items()}
+
+
+def load_trained(run_name_or_dir: str, runs_root: str = "runs"):
+    """Load a finished run for inference: (params, args, tokenizer, config).
+    Mirrors ``Trainer(for_training=False)`` + final-checkpoint load
+    (reference: core/generation.py:33-43)."""
+    run_dir = run_name_or_dir if os.path.isdir(run_name_or_dir) else os.path.join(runs_root, run_name_or_dir)
+    cfg = Config.from_yaml(os.path.join(run_dir, "config.yaml"))
+    tok = TokenizerManager.from_run_dir(run_dir)
+    args = LlamaArgs.from_config(cfg.model, tok.vocab_size)
+    ckpts = CheckpointManager(run_dir)
+    tag = ckpts.latest_step()
+    if tag is None:
+        raise FileNotFoundError(f"no checkpoints in {run_dir}")
+    model_path, _, _ = ckpts.paths_for_step(tag)
+    ref = resolve_architecture(cfg.model.architecture)
+    params0 = jax.eval_shape(lambda: ref.init_params(jax.random.PRNGKey(0), args))
+    from ..checkpoint.safetensors_io import load_safetensors
+    from ..utils.tree import unflatten_dict
+
+    arrays, _ = load_safetensors(model_path)
+    nested = unflatten_dict({k: jnp.asarray(v) for k, v in arrays.items()})
+    params = _restructure(params0, nested)
+    return params, args, tok, cfg
+
+
+def _restructure(like, nested):
+    if isinstance(like, dict):
+        return {k: _restructure(v, nested[k]) for k, v in like.items()}
+    if isinstance(like, (list, tuple)):
+        vals = [_restructure(v, nested[str(i)]) for i, v in enumerate(like)]
+        return vals if isinstance(like, list) else type(like)(vals)
+    return nested
+
+
+def main(argv=None) -> Dict[str, Any]:
+    """CLI: ``python -m mlx_cuda_distributed_pretraining_tpu.train --config C``
+    with dotted overrides (reference: core/training.py:1907-2013 materializes
+    a temp YAML; here overrides apply in-memory)."""
+    parser = argparse.ArgumentParser(description="TPU-native LLM pretraining")
+    parser.add_argument("--config", required=True)
+    parser.add_argument("--runs-root", default="runs")
+    parser.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
+                        help="dotted config override, e.g. training.hyperparameters.batch_size=8")
+    parser.add_argument("--iters", type=int, default=None)
+    parser.add_argument("--batch-size", type=int, default=None)
+    parser.add_argument("--learning-rate", type=float, default=None)
+    parser.add_argument("--run-name", default=None)
+    args = parser.parse_args(argv)
+
+    import yaml
+
+    with open(args.config) as f:
+        raw = yaml.safe_load(f)
+    overrides: Dict[str, Any] = {}
+    for kv in args.set:
+        key, _, value = kv.partition("=")
+        try:
+            value = json.loads(value)
+        except json.JSONDecodeError:
+            pass
+        overrides[key] = value
+    if args.iters is not None:
+        overrides["training.hyperparameters.iters"] = args.iters
+    if args.batch_size is not None:
+        overrides["training.hyperparameters.batch_size"] = args.batch_size
+    if args.learning_rate is not None:
+        overrides["training.hyperparameters.learning_rate"] = args.learning_rate
+    if args.run_name:
+        overrides["name"] = args.run_name
+    cfg = Config.from_dict(apply_overrides(raw, overrides))
+    trainer = Trainer(cfg, runs_root=args.runs_root)
+    return trainer.train()
+
+
+if __name__ == "__main__":
+    main()

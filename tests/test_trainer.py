@@ -1,0 +1,186 @@
+"""End-to-end training tests: loss decreases, checkpoints round-trip,
+resume continues, log protocol parses (SURVEY.md §4 items c, e)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mlx_cuda_distributed_pretraining_tpu.config import Config
+from mlx_cuda_distributed_pretraining_tpu.train.trainer import Trainer, load_trained
+
+
+def _write_jsonl(path, texts):
+    with open(path, "w") as f:
+        for t in texts:
+            f.write(json.dumps({"text": t}) + "\n")
+
+
+def _tiny_config(tmp_path, name="tiny", iters=30, **extra):
+    train = tmp_path / "train.jsonl"
+    val = tmp_path / "val.jsonl"
+    corpus = ["the quick brown fox jumps over the lazy dog " * 4] * 40
+    _write_jsonl(train, corpus)
+    _write_jsonl(val, corpus[:10])
+    d = {
+        "name": name,
+        "overwrite": True,
+        "data": {
+            "input_file": str(train),
+            "validation_file": str(val),
+            "preprocessing": {"max_context_size": 64},
+            "tokenizer": {"normal_vocab_size": 256},
+        },
+        "model": {
+            "architecture": "llama",
+            "dimensions": {"hidden_size": 32, "intermediate_size": 64, "num_layers": 2},
+            "attention": {"num_heads": 4, "num_kv_heads": 2, "head_dim": 8},
+        },
+        "training": {
+            "hyperparameters": {"batch_size": 4, "learning_rate": 1e-2, "iters": iters},
+            "scheduler": {"type": "cosine", "min_lr_ratio": 0.1},
+            "optimization": {"optimizer": "adamw"},
+        },
+        "logging": {
+            "log_dir": "logs",
+            "checkpoint_dir": "checkpoints",
+            "steps": {"logging_interval": 5, "checkpoint_interval": 15, "validation_interval": 10},
+        },
+        "system": {"seed": 0, "device": "cpu"},
+    }
+    for k, v in extra.items():
+        node = d
+        parts = k.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return Config.from_dict(d)
+
+
+def test_train_loss_decreases_and_logs(tmp_path):
+    cfg = _tiny_config(tmp_path)
+    tr = Trainer(cfg, runs_root=str(tmp_path / "runs"), quiet=True)
+    result = tr.train()
+    assert result["steps"] == 30
+    # loss must drop substantially on this trivially learnable corpus
+    log = open(os.path.join(tr.run_dir, "log.txt")).read()
+    first_loss = None
+    for line in log.splitlines():
+        if line.startswith("Step") and "loss=" in line and "validation" not in line:
+            loss = float(line.split("loss=")[1].split(" |")[0])
+            if first_loss is None:
+                first_loss = loss
+    assert first_loss is not None
+    assert result["final_loss"] < first_loss * 0.7
+
+    # log protocol parses the reference way (utils/plotting.py:27-47)
+    steps = []
+    for line in log.splitlines():
+        if line.startswith("Step") and "validation:" not in line and "loss=" in line:
+            steps.append(int(line.split()[1][:-1]))
+            assert "toks=" in line
+    assert steps and steps[-1] == 30
+    assert "validation: val_loss=" in log
+
+    # run dir layout (reference: core/training.py:169-195)
+    assert os.path.isfile(os.path.join(tr.run_dir, "config.yaml"))
+    assert os.path.isfile(os.path.join(tr.run_dir, "metadata.json"))
+    assert os.path.isdir(os.path.join(tr.run_dir, "tokenizer"))
+    ckpts = os.listdir(os.path.join(tr.run_dir, "checkpoints"))
+    assert "step_final_model.safetensors" in ckpts
+    assert "step_15_state.json" in ckpts
+
+
+def test_resume_continues(tmp_path):
+    cfg = _tiny_config(tmp_path, name="resumable", iters=15)
+    tr = Trainer(cfg, runs_root=str(tmp_path / "runs"), quiet=True)
+    tr.train()
+
+    cfg2 = _tiny_config(tmp_path, name="resumable", iters=25)
+    cfg2_dict = cfg2.to_dict()
+    cfg2_dict["overwrite"] = False
+    cfg2_dict["resume"] = {"checkpoint": "15"}
+    cfg2 = Config.from_dict(cfg2_dict)
+    tr2 = Trainer(cfg2, runs_root=str(tmp_path / "runs"), quiet=True)
+    assert tr2.start_step == 15
+    result = tr2.train()
+    assert result["steps"] == 25
+
+    # resumed params differ from a fresh init (training continued)
+    log = open(os.path.join(tr2.run_dir, "log.txt")).read()
+    assert "Resumed from checkpoint 15" in log
+
+
+def test_resume_reset_optimizer(tmp_path):
+    cfg = _tiny_config(tmp_path, name="reset", iters=10)
+    Trainer(cfg, runs_root=str(tmp_path / "runs"), quiet=True).train()
+    d = cfg.to_dict()
+    d["overwrite"] = False
+    d["resume"] = {"checkpoint": "final", "reset_optimizer": True, "reset_training_state": True}
+    d["training"]["hyperparameters"]["iters"] = 5
+    tr = Trainer(Config.from_dict(d), runs_root=str(tmp_path / "runs"), quiet=True)
+    assert tr.start_step == 0
+    tr.train()
+
+
+def test_load_trained_and_generate(tmp_path):
+    cfg = _tiny_config(tmp_path, name="gen", iters=25)
+    tr = Trainer(cfg, runs_root=str(tmp_path / "runs"), quiet=True)
+    tr.train()
+    params, args, tok, _ = load_trained("gen", runs_root=str(tmp_path / "runs"))
+    from mlx_cuda_distributed_pretraining_tpu.infer.generate import generate_text
+
+    text = generate_text(params, args, tok, "the quick brown", max_new_tokens=8)
+    assert isinstance(text, str)
+
+
+def test_grad_accumulation_equivalence(tmp_path):
+    """accum=2 with bs=4 must match accum=1 with bs=4 on the same data
+    (same total batch, scan-accumulated grads averaged)."""
+    cfg_a = _tiny_config(tmp_path, name="acc1", iters=3)
+    tr_a = Trainer(cfg_a, runs_root=str(tmp_path / "runs"), quiet=True)
+    cfg_b = _tiny_config(
+        tmp_path, name="acc2", iters=3,
+        **{"training.hyperparameters.gradient_accumulation_steps": 2},
+    )
+    tr_b = Trainer(cfg_b, runs_root=str(tmp_path / "runs"), quiet=True)
+    tr_a.train()
+    tr_b.train()
+    pa = tr_a.state["params"]["layers"][0]["attention"]["wq"]["weight"]
+    pb = tr_b.state["params"]["layers"][0]["attention"]["wq"]["weight"]
+    np.testing.assert_allclose(np.asarray(pa), np.asarray(pb), atol=2e-4)
+
+
+def test_early_stopping(tmp_path):
+    cfg = _tiny_config(
+        tmp_path, name="es", iters=40,
+        **{
+            "training.early_stopping": {"enabled": True, "patience": 1, "min_delta": 10.0},
+            "logging.steps": {"logging_interval": 5, "checkpoint_interval": 0, "validation_interval": 5},
+        },
+    )
+    tr = Trainer(cfg, runs_root=str(tmp_path / "runs"), quiet=True)
+    result = tr.train()
+    # min_delta=10 means "never improves" -> stops after patience*interval
+    assert result["steps"] < 40
+
+
+def test_mixed_precision_and_remat_run(tmp_path):
+    cfg = _tiny_config(
+        tmp_path, name="bf16", iters=5,
+        **{"system.mixed_precision": True, "system.gradient_checkpointing": True},
+    )
+    tr = Trainer(cfg, runs_root=str(tmp_path / "runs"), quiet=True)
+    result = tr.train()
+    assert np.isfinite(result["final_loss"])
+
+
+def test_lr_finder(tmp_path):
+    cfg = _tiny_config(
+        tmp_path, name="lrf", iters=3,
+        **{"training.lr_finder": {"enabled": True, "min_lr": 1e-5, "max_lr": 1.0, "num_steps": 15}},
+    )
+    tr = Trainer(cfg, runs_root=str(tmp_path / "runs"), quiet=True)
+    tr.train()
+    assert os.path.isfile(os.path.join(tr.run_dir, "lr_finder.csv"))
