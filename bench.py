@@ -1,0 +1,111 @@
+"""Benchmark harness: pretrain tokens/sec on the real TPU chip.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": N}
+
+Baseline (BASELINE.md): the reference's only throughput anchor is the
+Llama-2M run on an Apple M3 Max — ~200M FineWeb-Edu tokens in ~2h ≈ 27.5K
+tok/s. We measure the same 2M-parameter model shape doing full training
+steps (fwd+bwd+AdamW update, bf16 compute) on one TPU chip.
+
+Env knobs: BENCH_MODEL (2m|40m|100m), BENCH_BATCH, BENCH_SEQ, BENCH_STEPS,
+BENCH_OPT.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_TOKS_PER_SEC = 27500.0
+
+MODELS = {
+    "2m": dict(hidden_size=128, intermediate_size=256, num_layers=4,
+               num_heads=8, num_kv_heads=8, head_dim=16),
+    "40m": dict(hidden_size=512, intermediate_size=1536, num_layers=12,
+                num_heads=8, num_kv_heads=8, head_dim=64),
+    "100m": dict(hidden_size=768, intermediate_size=2048, num_layers=12,
+                 num_heads=12, num_kv_heads=12, head_dim=64),
+}
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlx_cuda_distributed_pretraining_tpu.config import TrainingConfig
+    from mlx_cuda_distributed_pretraining_tpu.models import llama
+    from mlx_cuda_distributed_pretraining_tpu.optim import build_optimizer
+    from mlx_cuda_distributed_pretraining_tpu.train.train_step import (
+        init_train_state,
+        make_train_step,
+    )
+
+    model_key = os.environ.get("BENCH_MODEL", "2m")
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    seq = int(os.environ.get("BENCH_SEQ", "1024"))
+    steps = int(os.environ.get("BENCH_STEPS", "30"))
+    opt_name = os.environ.get("BENCH_OPT", "adamw")
+    vocab = int(os.environ.get("BENCH_VOCAB", "512"))
+
+    shape = MODELS[model_key]
+    args = llama.LlamaArgs(
+        vocab_size=vocab, max_position_embeddings=seq,
+        attention_type=os.environ.get("BENCH_ATTN", "simple"), **shape,
+    )
+    params = llama.init_params(jax.random.PRNGKey(0), args)
+    n_params = llama.num_params(params)
+
+    tr_cfg = TrainingConfig(
+        hyperparameters={"learning_rate": 1e-3, "weight_decay": 0.01, "gradient_clip": 1.0},
+        scheduler={"type": "cosine", "min_lr_ratio": 0.1},
+        optimization={"optimizer": opt_name},
+    )
+    opt = build_optimizer(tr_cfg, 1000)
+
+    def loss_fn(p, b):
+        return llama.loss_fn(p, b, args, compute_dtype=jnp.bfloat16)
+
+    step, _ = make_train_step(loss_fn, opt)
+    state = init_train_state(params, opt)
+
+    rng = np.random.default_rng(0)
+    x = rng.integers(1, vocab - 4, size=(batch, seq + 1)).astype(np.int32)
+    b = {
+        "inputs": jnp.asarray(x[:, :-1]),
+        "targets": jnp.asarray(x[:, 1:]),
+        "mask": jnp.ones((batch, seq), jnp.float32),
+    }
+
+    # warmup/compile
+    state, metrics = step(state, b)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, b)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    toks_per_step = batch * seq
+    value = steps * toks_per_step / dt
+    device = jax.devices()[0]
+    print(json.dumps({
+        "metric": f"pretrain_tokens_per_sec_per_chip_llama_{model_key}"
+                  f"_{n_params/1e6:.1f}Mparams_bs{batch}_seq{seq}_{opt_name}",
+        "value": round(value, 1),
+        "unit": "tok/s",
+        "vs_baseline": round(value / BASELINE_TOKS_PER_SEC, 3),
+        "device": str(device),
+        "steps_timed": steps,
+        "step_ms": round(1000 * dt / steps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

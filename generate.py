@@ -1,0 +1,12 @@
+#!/usr/bin/env python
+"""Root CLI shim: ``python generate.py --run <name> --prompt "..."``."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from mlx_cuda_distributed_pretraining_tpu.infer.cli import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Generation CLI (reference: core/generation.py — load run, final
+checkpoint, sample with temperature/top-p/min-p/repetition penalty; plus
+beam search)."""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None) -> str:
+    parser = argparse.ArgumentParser(description="Generate from a trained run")
+    parser.add_argument("--run", required=True, help="run name or directory")
+    parser.add_argument("--runs-root", default="runs")
+    parser.add_argument("--prompt", default="")
+    parser.add_argument("--max-tokens", type=int, default=128)
+    parser.add_argument("--temperature", type=float, default=0.7)
+    parser.add_argument("--top-p", type=float, default=0.0)
+    parser.add_argument("--min-p", type=float, default=0.0)
+    parser.add_argument("--repetition-penalty", type=float, default=None)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--beams", type=int, default=0, help=">0 switches to beam search")
+    args = parser.parse_args(argv)
+
+    from ..train.trainer import load_trained
+    from .generate import beam_search, generate_text
+
+    params, margs, tok, _ = load_trained(args.run, runs_root=args.runs_root)
+    if args.beams > 0:
+        ids = [tok.bos_id] + tok.tokenize(args.prompt)
+        seq, score = beam_search(params, margs, ids, num_beams=args.beams,
+                                 max_tokens=args.max_tokens, eos_id=tok.eos_id)
+        text = tok.detokenize(seq)
+        print(f"[beam score {score:.3f}] {args.prompt}{text}")
+        return text
+    text = generate_text(
+        params, margs, tok, args.prompt,
+        max_new_tokens=args.max_tokens, temperature=args.temperature,
+        top_p=args.top_p, min_p=args.min_p,
+        repetition_penalty=args.repetition_penalty, seed=args.seed,
+    )
+    print(args.prompt + text)
+    return text
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""Shipped configs load; graft entry points run on the CPU mesh."""
+
+import glob
+import os
+
+from mlx_cuda_distributed_pretraining_tpu.config import Config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_all_shipped_configs_load():
+    paths = glob.glob(os.path.join(REPO, "configs", "*.yaml"))
+    assert len(paths) >= 6
+    for p in paths:
+        cfg = Config.from_yaml(p)
+        assert cfg.name
+        assert cfg.model.hidden_size > 0
+        assert cfg.training.batch_size > 0
+
+
+def test_dryrun_multichip_8():
+    import __graft_entry__
+
+    __graft_entry__.dryrun_multichip(8)
+
+
+def test_entry_compiles():
+    import jax
+
+    import __graft_entry__
+
+    fn, args = __graft_entry__.entry()
+    out = jax.jit(fn).lower(*args).compile()
+    assert out is not None

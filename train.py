@@ -1,0 +1,13 @@
+#!/usr/bin/env python
+"""Root CLI shim: ``python train.py --config configs/model-config-sample.yaml``
+(reference keeps the same entry point at its repo root)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from mlx_cuda_distributed_pretraining_tpu.train.trainer import main
+
+if __name__ == "__main__":
+    main()
