@@ -271,19 +271,21 @@ def attention_block(
         out = reference_attention(q, k, v, explicit_mask=explicit)
     else:
         mask_mod = build_mask_mod(args)
-        score_mod = build_score_mod(args)
         impl = attn_impl or args.attention_type
-        if impl == "flash" and score_mod is None:
+        if impl == "flash" and args.score_mod_type is None:
             from ..ops.flash_attention import flash_attention
 
             out = flash_attention(q, k, v, mask_type=args.mask_type,
                                   window_size=args.window_size, prefix_len=args.prefix_len)
         elif impl == "flex":
-            from ..ops.flex_attention import flex_attention
+            from ..ops.flex_attention import flex_attention, kernel_score_mod
 
-            out = flex_attention(q, k, v, mask_mod=mask_mod, score_mod=score_mod)
+            out = flex_attention(
+                q, k, v, mask_mod=mask_mod,
+                score_mod=kernel_score_mod(args.score_mod_type, args.num_heads, args.soft_cap),
+            )
         else:
-            out = reference_attention(q, k, v, mask_mod=mask_mod, score_mod=score_mod)
+            out = reference_attention(q, k, v, mask_mod=mask_mod, score_mod=build_score_mod(args))
 
     out = out.reshape(B, S, Hq * Dh)
     return _linear(out, p["wo"]), new_cache

@@ -1,0 +1,398 @@
+"""Tiled flash attention — Pallas TPU kernels, forward + backward.
+
+The reference's "FlashAttention" materializes the full [B,H,S,S] score
+matrix ("Simple approach without tiling for now", reference:
+models/attention/flash_attention.py:100,134-151). This is the real thing:
+
+- forward: online-softmax accumulation over KV tiles in VMEM; scores never
+  exist beyond one [block_q, block_kv] tile; fp32 accumulators; MXU matmuls
+  via ``dot_general(..., preferred_element_type=f32)``;
+- block sparsity: per-mask-type KV tile ranges (causal skips the upper
+  triangle, sliding-window skips everything outside the band) — skipped
+  tiles cost nothing;
+- backward: recomputation-based (saves only O and the logsumexp), split
+  into a dQ kernel (grid over Q tiles) and a dK/dV kernel (grid over KV
+  tiles), the standard flash-attention-2 decomposition;
+- GQA: native — each query head reads its KV group's tile; dK/dV are
+  accumulated per query head and group-reduced outside the kernel;
+- masks/score mods are traceable index-lattice functions (ops/masks.py)
+  traced INTO the kernel, which is what makes flex_attention.py a thin
+  wrapper over the same machinery.
+
+Runs in Pallas interpret mode off-TPU, so the same code path is exercised
+by the CPU test suite.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .masks import NEG_INF, MaskMod, ScoreMod
+
+try:  # pltpu only resolves on TPU-enabled jaxlib builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _vmem_spec(block_shape=None, index_map=None):
+    kwargs = {}
+    if _VMEM is not None and not _interpret():
+        kwargs["memory_space"] = _VMEM
+    if block_shape is None:
+        return pl.BlockSpec(**kwargs)
+    return pl.BlockSpec(block_shape, index_map, **kwargs)
+
+
+# -- tile-range planners (block sparsity per mask type) ----------------------
+def _kv_range(mask_type: str, window: int, prefix_len: int, block_q: int, block_kv: int,
+              num_kv_blocks: int):
+    """(qi -> lo, qi -> hi) KV-tile bounds for a given query tile."""
+
+    def lo(qi):
+        if mask_type == "sliding_window":
+            # row_min = qi*bq; cols >= row_min - window + 1 can contribute,
+            # but the prefix region [0, prefix) never applies here.
+            return jnp.maximum((qi * block_q - window + 1) // block_kv, 0)
+        return jnp.int32(0)
+
+    def hi(qi):
+        if mask_type in ("causal", "sliding_window"):
+            return jnp.minimum(pl.cdiv(qi * block_q + block_q, block_kv), num_kv_blocks)
+        if mask_type == "prefix_lm":
+            causal_hi = pl.cdiv(qi * block_q + block_q, block_kv)
+            return jnp.minimum(jnp.maximum(causal_hi, pl.cdiv(prefix_len, block_kv)), num_kv_blocks)
+        return jnp.int32(num_kv_blocks)
+
+    return lo, hi
+
+
+def _q_range(mask_type: str, window: int, prefix_len: int, block_q: int, block_kv: int,
+             num_q_blocks: int):
+    """(ki -> lo, ki -> hi) Q-tile bounds for a given KV tile (backward)."""
+
+    def lo(ki):
+        if mask_type in ("causal", "sliding_window"):
+            # first q row that can see this kv tile is its own diagonal row
+            return (ki * block_kv) // block_q
+        # full / prefix_lm: every q tile can reach every kv tile
+        return jnp.int32(0)
+
+    def hi(ki):
+        if mask_type == "sliding_window":
+            # rows < col_max + window
+            return jnp.minimum(pl.cdiv(ki * block_kv + block_kv - 1 + window, block_q) + 1,
+                               num_q_blocks)
+        return jnp.int32(num_q_blocks)
+
+    return lo, hi
+
+
+# -- forward kernel ----------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_kv,
+                mask_fn, score_fn, kv_lo, kv_hi):
+    qi = pl.program_id(2)
+    h = pl.program_id(1)
+    q = q_ref[0, 0].astype(jnp.float32)
+    bq, d = q.shape
+    row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_kv), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        col = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, (bq, block_kv), 1)
+        if score_fn is not None:
+            s = score_fn(s, row, col, h)
+        if mask_fn is not None:
+            s = jnp.where(mask_fn(row, col), s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(kv_lo(qi), kv_hi(qi), body, (m0, l0, acc0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(l_safe)).astype(lse_ref.dtype)
+
+
+# -- backward kernels --------------------------------------------------------
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   scale, block_kv, mask_fn, score_fn, kv_lo, kv_hi):
+    qi = pl.program_id(2)
+    h = pl.program_id(1)
+    q = q_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0].astype(jnp.float32)
+    delta = delta_ref[0, 0].astype(jnp.float32)
+    bq, d = q.shape
+    row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_kv), 0)
+
+    def body(j, dq):
+        k = k_ref[0, 0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        s_raw = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * scale
+        col = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, (bq, block_kv), 1)
+        s = score_fn(s_raw, row, col, h) if score_fn is not None else s_raw
+        if mask_fn is not None:
+            s = jnp.where(mask_fn(row, col), s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        d_mod = getattr(score_fn, "_d_score", None) if score_fn is not None else None
+        if d_mod is not None:  # non-additive score mod: chain through its Jacobian
+            ds = ds * d_mod(s_raw, row, col, h)
+        ds = ds * scale
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(kv_lo(qi), kv_hi(qi), body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
+                    scale, block_q, mask_fn, score_fn, q_lo, q_hi):
+    ki = pl.program_id(2)
+    h = pl.program_id(1)
+    k = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    bkv, d = k.shape
+    col = ki * bkv + jax.lax.broadcasted_iota(jnp.int32, (block_q, bkv), 1)
+
+    def body(j, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, 0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(j * block_q, block_q)].astype(jnp.float32)
+        delta = delta_ref[0, 0, pl.ds(j * block_q, block_q)].astype(jnp.float32)
+        s_raw = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * scale
+        row = j * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bkv), 0)
+        s = score_fn(s_raw, row, col, h) if score_fn is not None else s_raw
+        if mask_fn is not None:
+            s = jnp.where(mask_fn(row, col), s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        d_mod = getattr(score_fn, "_d_score", None) if score_fn is not None else None
+        if d_mod is not None:
+            ds = ds * d_mod(s_raw, row, col, h)
+        ds = ds * scale
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((bkv, d), jnp.float32)
+    dv0 = jnp.zeros((bkv, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(q_lo(ki), q_hi(ki), body, (dk0, dv0))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+# -- host-side wrapper -------------------------------------------------------
+def _attention_core(
+    mask_fn, score_fn, mask_type: str, window: int, prefix_len: int,
+    block_q: int, block_kv: int, scale: float,
+):
+    """Build the custom-vjp flash attention for a fixed mask/score program.
+
+    Inputs (to the returned fn): q [B, Hq, Sq, D], k/v [B, Hkv, Skv, D].
+    Output: o [B, Hq, Sq, D]. ``scale`` is baked in (nondiff).
+    """
+
+    @jax.custom_vjp
+    def attn(q, k, v):
+        o, _ = _fwd(q, k, v)
+        return o
+
+    def _fwd(q, k, v):
+        B, Hq, Sq, D = q.shape
+        _, Hkv, Skv, _ = k.shape
+        G = Hq // Hkv
+        bq = min(block_q, Sq)
+        bkv = min(block_kv, Skv)
+        nq = Sq // bq
+        nkv = Skv // bkv
+        kv_lo, kv_hi = _kv_range(mask_type, window, prefix_len, bq, bkv, nkv)
+        kernel = functools.partial(
+            _fwd_kernel, scale=scale, block_kv=bkv, mask_fn=mask_fn,
+            score_fn=score_fn, kv_lo=kv_lo, kv_hi=kv_hi)
+        o, lse = pl.pallas_call(
+            kernel,
+            grid=(B, Hq, nq),
+            in_specs=[
+                _vmem_spec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+                _vmem_spec((1, 1, Skv, D), lambda b, h, i: (b, h // G, 0, 0)),
+                _vmem_spec((1, 1, Skv, D), lambda b, h, i: (b, h // G, 0, 0)),
+            ],
+            out_specs=[
+                _vmem_spec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+                _vmem_spec((1, 1, bq), lambda b, h, i: (b, h, i)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B, Hq, Sq, D), q.dtype),
+                jax.ShapeDtypeStruct((B, Hq, Sq), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(q, k, v)
+        return o, (q, k, v, o, lse)
+
+    def _bwd(res, g):
+        q, k, v, o, lse = res
+        B, Hq, Sq, D = q.shape
+        _, Hkv, Skv, _ = k.shape
+        G = Hq // Hkv
+        bq = min(block_q, Sq)
+        bkv = min(block_kv, Skv)
+        nq = Sq // bq
+        nkv = Skv // bkv
+        delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [B,Hq,Sq]
+
+        kv_lo, kv_hi = _kv_range(mask_type, window, prefix_len, bq, bkv, nkv)
+        dq = pl.pallas_call(
+            functools.partial(_bwd_dq_kernel, scale=scale, block_kv=bkv,
+                              mask_fn=mask_fn, score_fn=score_fn,
+                              kv_lo=kv_lo, kv_hi=kv_hi),
+            grid=(B, Hq, nq),
+            in_specs=[
+                _vmem_spec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+                _vmem_spec((1, 1, Skv, D), lambda b, h, i: (b, h // G, 0, 0)),
+                _vmem_spec((1, 1, Skv, D), lambda b, h, i: (b, h // G, 0, 0)),
+                _vmem_spec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+                _vmem_spec((1, 1, bq), lambda b, h, i: (b, h, i)),
+                _vmem_spec((1, 1, bq), lambda b, h, i: (b, h, i)),
+            ],
+            out_specs=_vmem_spec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((B, Hq, Sq, D), q.dtype),
+            interpret=_interpret(),
+        )(q, k, v, g, lse, delta)
+
+        q_lo, q_hi = _q_range(mask_type, window, prefix_len, bq, bkv, nq)
+        dk_h, dv_h = pl.pallas_call(
+            functools.partial(_bwd_dkv_kernel, scale=scale, block_q=bq,
+                              mask_fn=mask_fn, score_fn=score_fn,
+                              q_lo=q_lo, q_hi=q_hi),
+            grid=(B, Hq, nkv),
+            in_specs=[
+                _vmem_spec((1, 1, Sq, D), lambda b, h, i: (b, h, 0, 0)),
+                _vmem_spec((1, 1, bkv, D), lambda b, h, i: (b, h // G, i, 0)),
+                _vmem_spec((1, 1, bkv, D), lambda b, h, i: (b, h // G, i, 0)),
+                _vmem_spec((1, 1, Sq, D), lambda b, h, i: (b, h, 0, 0)),
+                _vmem_spec((1, 1, Sq), lambda b, h, i: (b, h, 0)),
+                _vmem_spec((1, 1, Sq), lambda b, h, i: (b, h, 0)),
+            ],
+            out_specs=[
+                _vmem_spec((1, 1, bkv, D), lambda b, h, i: (b, h, i, 0)),
+                _vmem_spec((1, 1, bkv, D), lambda b, h, i: (b, h, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B, Hq, Skv, D), k.dtype),
+                jax.ShapeDtypeStruct((B, Hq, Skv, D), v.dtype),
+            ],
+            interpret=_interpret(),
+        )(q, k, v, g, lse, delta)
+
+        # GQA: reduce per-query-head dK/dV over each group
+        if G > 1:
+            dk = dk_h.reshape(B, Hkv, G, Skv, D).sum(axis=2).astype(k.dtype)
+            dv = dv_h.reshape(B, Hkv, G, Skv, D).sum(axis=2).astype(v.dtype)
+        else:
+            dk, dv = dk_h, dv_h
+        return dq, dk, dv
+
+    attn.defvjp(_fwd, _bwd)
+    return attn
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_core(mask_fn, score_fn, mask_type, window, prefix_len, block_q, block_kv, scale):
+    return _attention_core(mask_fn, score_fn, mask_type, window, prefix_len, block_q, block_kv, scale)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask_type: str = "causal",
+    window_size: int = 512,
+    prefix_len: int = 0,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_kv: int = 128,
+    mask_fn: Optional[Callable] = None,
+    score_fn: Optional[Callable] = None,
+) -> jnp.ndarray:
+    """Flash attention on [B, S, H, D] layout (framework convention).
+
+    ``mask_type`` selects the block-sparsity plan (causal / sliding_window /
+    prefix_lm / full); ``mask_fn``/``score_fn`` override the in-tile
+    predicate (flex path): ``mask_fn(row, col) -> bool``,
+    ``score_fn(scores, row, col, head) -> scores``.
+    """
+    B, Sq, Hq, D = q.shape
+    _, Skv, Hkv, _ = k.shape
+    scale = (D ** -0.5) if scale is None else scale
+
+    from . import masks as M
+
+    if mask_fn is None:
+        mask_fn = {
+            "causal": M.causal(),
+            "sliding_window": M.sliding_window(window_size),
+            "prefix_lm": M.prefix_lm(prefix_len),
+            "full": None,
+        }[mask_type]
+
+    bq = min(block_q, Sq)
+    bkv = min(block_kv, Skv)
+    if Sq % bq or Skv % bkv or Hq % Hkv:
+        # Odd sizes: reference path with the SAME mask and score program
+        # (kernel-style score_fn adapted to the [B, Hkv, G, Sq, Skv] layout).
+        from .attention import reference_attention
+
+        ref_score = None
+        if score_fn is not None:
+            G = max(Hq // max(Hkv, 1), 1)
+            head_grid = jnp.arange(Hkv * G).reshape(Hkv, G)
+
+            def ref_score(s, q_idx, k_idx):
+                return score_fn(s, q_idx[None, None, None],
+                                k_idx[None, None, None],
+                                head_grid[None, :, :, None, None])
+
+        return reference_attention(q, k, v, mask_mod=mask_fn, score_mod=ref_score, scale=scale)
+
+    core = _cached_core(mask_fn, score_fn, mask_type, window_size, prefix_len,
+                        block_q, block_kv, float(scale))
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    o = core(qt, kt, vt)
+    return o.transpose(0, 2, 1, 3)

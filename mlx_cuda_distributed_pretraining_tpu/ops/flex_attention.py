@@ -1,0 +1,94 @@
+"""Flex attention: programmable masks/score mods on the flash kernel.
+
+The reference's FlexAttention applies ``score_mod``/``mask_mod`` via
+quadruple-nested Python loops over (batch, head, q, kv) (reference:
+models/attention/flex_attention.py:220-275 — O(B·H·S²) Python calls), and
+builds block masks by sampling block midpoints (:90-138). Here:
+
+- mods are **traceable functions of index lattices** traced directly into
+  the Pallas flash kernel (ops/flash_attention.py) — same tiling, online
+  softmax and custom VJP as the named fast paths;
+- named mask types (causal / sliding_window / prefix_lm) get exact
+  block-sparsity plans; arbitrary mask mods run the full tile grid with the
+  mask applied in-tile (always exact, never sampled).
+
+Kernel-style score mods have signature ``(scores, q_idx, kv_idx, head) ->
+scores``; builders below cover ALiBi and tanh soft-capping.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from . import masks as M
+from .flash_attention import flash_attention
+
+KernelScoreMod = Callable  # (scores[bq,bkv], row, col, head) -> scores
+
+
+@lru_cache(maxsize=None)
+def alibi_score_fn(num_heads: int) -> KernelScoreMod:
+    def fn(s, row, col, head):
+        # slope_h = 2^(-8(h+1)/H) computed arithmetically — Pallas kernels
+        # cannot capture constant arrays, and this matches M.alibi_slopes.
+        slope = jnp.exp2(-8.0 * (jnp.asarray(head, jnp.float32) + 1.0) / num_heads)
+        return s - slope * jnp.abs(row - col).astype(jnp.float32)
+
+    fn._d_score = None  # additive: d(mod)/ds == 1
+    return fn
+
+
+@lru_cache(maxsize=None)
+def soft_cap_score_fn(cap: float) -> KernelScoreMod:
+    def fn(s, row, col, head):
+        return cap * jnp.tanh(s / cap)
+
+    def d_score(s, row, col, head):
+        t = jnp.tanh(s / cap)
+        return 1.0 - t * t
+
+    fn._d_score = d_score  # non-additive: backward needs the Jacobian
+    return fn
+
+
+def kernel_score_mod(kind: Optional[str], num_heads: int, soft_cap: float) -> Optional[KernelScoreMod]:
+    """Single dispatch point for config-named score mods (used by
+    models/llama.py's flex path)."""
+    if kind == "alibi":
+        return alibi_score_fn(num_heads)
+    if kind == "soft_cap":
+        return soft_cap_score_fn(float(soft_cap))
+    return None
+
+
+def _plan_for(mask_mod) -> tuple:
+    """Exact block-sparsity plan: named builders (ops/masks.py) carry a
+    ``_plan`` tag; arbitrary mods run the full tile grid (exact, in-tile
+    masking)."""
+    if mask_mod is None:
+        return ("full", 0, 0)
+    return getattr(mask_mod, "_plan", ("full", 0, 0))
+
+
+def flex_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask_mod: Optional[Callable] = None,
+    score_mod: Optional[KernelScoreMod] = None,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_kv: int = 128,
+) -> jnp.ndarray:
+    """[B, S, H, D] layout. ``mask_mod(q_idx, kv_idx) -> bool`` (True =
+    attend); ``score_mod(scores, q_idx, kv_idx, head)``."""
+    mask_type, window, prefix = _plan_for(mask_mod)
+    return flash_attention(
+        q, k, v,
+        mask_type=mask_type, window_size=window, prefix_len=prefix,
+        scale=scale, block_q=block_q, block_kv=block_kv,
+        mask_fn=mask_mod, score_fn=score_mod,
+    )

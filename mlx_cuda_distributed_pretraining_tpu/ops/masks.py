@@ -15,6 +15,7 @@ window, prefix-LM, document/padding masks, ALiBi and soft-capping.
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import Callable, Optional
 
 import jax.numpy as jnp
@@ -25,16 +26,35 @@ ScoreMod = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
 
 NEG_INF = -1e30  # large-but-finite: keeps softmax well-defined on fully-masked rows
 
+# Builders are lru_cached so identical arguments return the identical
+# function object — kernel caches (flash_attention._cached_core, jit static
+# args) key on function identity.
+
 
 # -- mask mods --------------------------------------------------------------
+# Each named builder tags its mod with ``_plan = (mask_type, window, prefix)``
+# so the flash kernel can recover the exact block-sparsity plan.
+
+
+@lru_cache(maxsize=None)
 def causal() -> MaskMod:
-    return lambda q, k: q >= k
+    def mod(q, k):
+        return q >= k
+
+    mod._plan = ("causal", 0, 0)
+    return mod
 
 
+@lru_cache(maxsize=None)
 def full() -> MaskMod:
-    return lambda q, k: jnp.ones(jnp.broadcast_shapes(jnp.shape(q), jnp.shape(k)), bool)
+    def mod(q, k):
+        return jnp.ones(jnp.broadcast_shapes(jnp.shape(q), jnp.shape(k)), bool)
+
+    mod._plan = ("full", 0, 0)
+    return mod
 
 
+@lru_cache(maxsize=None)
 def sliding_window(window: int, causal_: bool = True) -> MaskMod:
     """Attend to the last ``window`` positions (reference flex tests use this:
     tests/test_flex_attention.py:64-80)."""
@@ -45,15 +65,19 @@ def sliding_window(window: int, causal_: bool = True) -> MaskMod:
             return (q >= k) & near
         return jnp.abs(q - k) < window
 
+    if causal_:
+        mod._plan = ("sliding_window", window, 0)
     return mod
 
 
+@lru_cache(maxsize=None)
 def prefix_lm(prefix_len: int) -> MaskMod:
     """Bidirectional over the first ``prefix_len`` tokens, causal after."""
 
     def mod(q, k):
         return (q >= k) | (k < prefix_len)
 
+    mod._plan = ("prefix_lm", 0, prefix_len)
     return mod
 
 
