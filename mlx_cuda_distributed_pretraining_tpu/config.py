@@ -84,8 +84,11 @@ class ModelConfig:
 
     @property
     def attention_type(self) -> str:
-        """"simple" | "flash" | "flex" — dispatch mirrors reference
-        models/llama.py:181-209 (flex > flash > simple)."""
+        """"simple" | "flash" | "flex" | "ring" — dispatch mirrors reference
+        models/llama.py:181-209 (flex > flash > simple); "ring" (sequence
+        parallel over the sp mesh axis) is a TPU addition."""
+        if _get(self.attention, "use_ring_attention", False):
+            return "ring"
         if _get(self.attention, "use_flex_attention", False):
             return "flex"
         if _get(self.attention, "use_flash_attention", False):

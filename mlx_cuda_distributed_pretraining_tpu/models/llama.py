@@ -277,6 +277,17 @@ def attention_block(
 
             out = flash_attention(q, k, v, mask_type=args.mask_type,
                                   window_size=args.window_size, prefix_len=args.prefix_len)
+        elif impl == "ring":
+            # Sequence/context parallelism: exact causal attention with KV
+            # shards rotating over the sp mesh axis (ops/ring_attention.py).
+            from ..ops.ring_attention import make_ring_attention
+            from ..parallel.context import current_mesh
+
+            mesh = current_mesh()
+            if mesh is None or "sp" not in mesh.axis_names or mesh.shape["sp"] == 1:
+                out = reference_attention(q, k, v, mask_mod=mask_mod)
+            else:
+                out = make_ring_attention(mesh, mask_mod=mask_mod)(q, k, v)
         elif impl == "flex":
             from ..ops.flex_attention import flex_attention, kernel_score_mod
 

@@ -1,0 +1,104 @@
+"""Ring attention: exact causal attention over a sequence-sharded mesh axis.
+
+The reference has no sequence/context parallelism at all (SURVEY.md §2.4 —
+longest context 4096, sliding-window masks only). This implements
+blockwise ring attention (Liu et al.): the sequence dim is sharded over the
+``sp`` mesh axis; each device keeps its Q shard and rotates KV shards
+around the ring with ``jax.lax.ppermute`` over ICI, accumulating an online
+softmax. Attention memory per chip is O(S_local²) and the KV transfer
+overlaps with compute under XLA's async collective scheduling.
+
+Differentiable by construction (pure jnp inside a ``lax.scan``; wrap in
+``jax.checkpoint`` upstream for long sequences). Exact — the chunk-level
+mask uses global positions, so causality across shards is preserved.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .masks import NEG_INF, MaskMod
+
+
+def _chunk_scores(q, k, scale):
+    """q [B, Sq, Hkv, G, D] x k [B, Skv, Hkv, D] -> [B, Hkv, G, Sq, Skv] f32."""
+    return jnp.einsum("bqhgd,bkhd->bhgqk", q, k).astype(jnp.float32) * scale
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str = "sp",
+    mask_mod: Optional[MaskMod] = None,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Runs INSIDE shard_map. q/k/v: local shards [B, S_local, H, D] with the
+    global sequence laid out contiguously across the axis. ``mask_mod``
+    takes GLOBAL (q_idx, kv_idx). Default mask is causal."""
+    B, Sl, Hq, D = q.shape
+    _, _, Hkv, _ = k.shape
+    G = Hq // Hkv
+    scale = (D ** -0.5) if scale is None else scale
+    sp = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    if mask_mod is None:
+        from . import masks as M
+
+        mask_mod = M.causal()
+
+    qg = q.reshape(B, Sl, Hkv, G, D)
+    q_idx = my * Sl + jnp.arange(Sl, dtype=jnp.int32)
+
+    def step(carry, i):
+        k_cur, v_cur, m, l, acc = carry
+        # chunk i holds the shard originally owned by device (my - i) % sp
+        src = (my - i) % sp
+        kv_idx = src * Sl + jnp.arange(Sl, dtype=jnp.int32)
+        s = _chunk_scores(qg, k_cur, scale)  # [B, Hkv, G, Sl, Sl]
+        mask = mask_mod(q_idx[:, None], kv_idx[None, :])
+        s = jnp.where(mask[None, None, None], s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhgqk,bkhd->bhgqd", p, v_cur.astype(jnp.float32))
+
+        # rotate KV around the ring (device d sends to d+1)
+        perm = [(j, (j + 1) % sp) for j in range(sp)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+
+    m0 = jnp.full((B, Hkv, G, Sl), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, Hkv, G, Sl), jnp.float32)
+    acc0 = jnp.zeros((B, Hkv, G, Sl, D), jnp.float32)
+    (_, _, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(sp, dtype=jnp.int32))
+
+    l_safe = jnp.maximum(l, 1e-30)
+    out = acc / l_safe[..., None]  # [B, Hkv, G, Sl, D]
+    out = out.transpose(0, 3, 1, 2, 4).reshape(B, Sl, Hq, D)
+    return out.astype(q.dtype)
+
+
+def make_ring_attention(mesh, axis_name: str = "sp", mask_mod: Optional[MaskMod] = None,
+                        batch_axes=("dp", "fsdp")):
+    """shard_map wrapper: [B, S_global, H, D] (sharded batch over dp/fsdp,
+    sequence over sp) -> same. Heads/D replicated across sp."""
+    from jax.sharding import PartitionSpec as P
+
+    data = tuple(a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1)
+    data_spec = data if data else None
+    spec = P(data_spec, axis_name, None, None)
+
+    fn = partial(ring_attention, axis_name=axis_name, mask_mod=mask_mod)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False,
+    )

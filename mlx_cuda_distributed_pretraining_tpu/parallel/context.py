@@ -1,0 +1,32 @@
+"""Current-mesh context: lets deeply-nested model code (ring attention)
+reach the mesh that the Trainer built, without threading a non-hashable
+Mesh through frozen model args."""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+from jax.sharding import Mesh
+
+_CURRENT: list = []
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _CURRENT[-1] if _CURRENT else None
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Optional[Mesh]):
+    _CURRENT.append(mesh)
+    try:
+        yield mesh
+    finally:
+        _CURRENT.pop()
+
+
+def set_mesh(mesh: Optional[Mesh]) -> None:
+    """Non-scoped variant for long-lived Trainer ownership."""
+    _CURRENT.clear()
+    if mesh is not None:
+        _CURRENT.append(mesh)

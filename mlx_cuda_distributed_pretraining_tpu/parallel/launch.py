@@ -1,0 +1,74 @@
+"""Multi-host SPMD launcher.
+
+Replaces the reference's coordinator/worker/heartbeat data plane
+(reference: distributed/worker.py node agent with /register /get_task
+/heartbeat polling; hybrid_distributed.py remote connectors) with the
+TPU-native model: every host runs THE SAME program;
+``jax.distributed.initialize`` performs the DCN rendezvous; data is sharded
+per host by ``process_index``; XLA moves all tensor traffic over ICI.
+
+Usage on each host of a pod (or with TPU env auto-detection, no args):
+
+    python -m mlx_cuda_distributed_pretraining_tpu.parallel.launch \
+        --config configs/model-config-1b.yaml \
+        [--coordinator host:port --num-processes N --process-id I]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Optional
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Best-effort ``jax.distributed.initialize``. On TPU pods all arguments
+    auto-detect from the metadata server; explicit args support CPU/GPU
+    clusters and tests. Returns True when multi-process mode is active."""
+    import jax
+
+    explicit = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    try:
+        if explicit:
+            jax.distributed.initialize(
+                coordinator_address=explicit,
+                num_processes=num_processes or int(os.environ.get("JAX_NUM_PROCESSES", "1")),
+                process_id=process_id if process_id is not None
+                else int(os.environ.get("JAX_PROCESS_ID", "0")),
+            )
+        else:
+            jax.distributed.initialize()  # TPU pod auto-detection
+    except (ValueError, RuntimeError) as e:
+        # single-host fallback: not an error for 1-process runs
+        if jax.process_count() == 1:
+            return False
+        raise e
+    return jax.process_count() > 1
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="Multi-host SPMD training launcher")
+    parser.add_argument("--config", required=True)
+    parser.add_argument("--runs-root", default="runs")
+    parser.add_argument("--coordinator", default=None, help="host:port of process 0")
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
+    args, extra = parser.parse_known_args(argv)
+
+    initialize_distributed(args.coordinator, args.num_processes, args.process_id)
+
+    import jax
+
+    from ..train.trainer import main as train_main
+
+    print(f"[launch] process {jax.process_index()}/{jax.process_count()} "
+          f"with {jax.local_device_count()} local / {jax.device_count()} global devices")
+    return train_main(["--config", args.config, "--runs-root", args.runs_root, *extra])
+
+
+if __name__ == "__main__":
+    main()

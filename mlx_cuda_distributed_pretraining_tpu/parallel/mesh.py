@@ -49,15 +49,22 @@ def mesh_axis_sizes(system_cfg: Any, n_devices: Optional[int] = None) -> Dict[st
                 raise ValueError(f"device count {n} not divisible by fixed axes {fixed}")
             sizes[k] = n // fixed
     total = int(np.prod(list(sizes.values())))
-    if total != n:
-        raise ValueError(f"mesh {sizes} covers {total} devices, have {n}")
+    if total > n:
+        raise ValueError(f"mesh {sizes} needs {total} devices, have {n}")
     return {a: sizes.get(a, 1) for a in AXIS_ORDER if sizes.get(a, 1) > 1 or a in sizes}
 
 
+def mesh_device_count(sizes: Dict[str, int]) -> int:
+    return int(np.prod(list(sizes.values()))) if sizes else 1
+
+
 def build_mesh(system_cfg: Any, devices: Optional[List] = None) -> Mesh:
+    """Build the mesh; an explicit config covering fewer devices than
+    available uses a prefix of the device list."""
     devices = devices if devices is not None else jax.devices()
     sizes = mesh_axis_sizes(system_cfg, len(devices))
     names = tuple(sizes.keys())
     shape = tuple(sizes.values())
+    devices = devices[: mesh_device_count(sizes)]
     dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
     return Mesh(dev_array, names)

@@ -57,6 +57,8 @@ class Trainer:
         # -- system: seeds, mesh (reference setup_system :964-1016) ---------
         self.rng = jax.random.PRNGKey(cfg.system.seed)
         np.random.seed(cfg.system.seed)
+        from ..parallel.context import set_mesh
+
         self.mesh = None
         explicit_mesh = bool(getattr(cfg.system, "mesh", None)) or cfg.system.model_parallel
         if explicit_mesh:
@@ -68,6 +70,7 @@ class Trainer:
             # distribution isn't configured: core/training.py:964-1016).
             if cfg.training.batch_size % jax.device_count() == 0:
                 self.mesh = build_mesh(cfg.system)
+        set_mesh(self.mesh)
 
         # -- run dir ---------------------------------------------------------
         resume = cfg.resume is not None and bool(cfg.resume.checkpoint)

@@ -56,8 +56,12 @@ def _batch(bs=8, seq=16, seed=0):
 def test_mesh_axis_sizes():
     sizes = mesh_axis_sizes(SystemConfig(seed=0, device="cpu", mesh={"dp": -1, "tp": 2}), 8)
     assert sizes["dp"] == 4 and sizes["tp"] == 2
+    # explicit sub-device mesh is allowed (prefix of devices)
+    assert mesh_axis_sizes(SystemConfig(seed=0, device="cpu", mesh={"dp": 3}), 8)["dp"] == 3
     with pytest.raises(ValueError):
-        mesh_axis_sizes(SystemConfig(seed=0, device="cpu", mesh={"dp": 3}), 8)
+        mesh_axis_sizes(SystemConfig(seed=0, device="cpu", mesh={"dp": 16}), 8)
+    with pytest.raises(ValueError):  # -1 with non-divisible fixed axis
+        mesh_axis_sizes(SystemConfig(seed=0, device="cpu", mesh={"dp": -1, "tp": 3}), 8)
 
 
 def test_dp_matches_single_device():

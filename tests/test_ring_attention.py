@@ -1,0 +1,115 @@
+"""Ring attention (sequence/context parallelism) on the virtual 8-CPU mesh:
+exact parity with single-device attention, gradients included."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mlx_cuda_distributed_pretraining_tpu.config import SystemConfig
+from mlx_cuda_distributed_pretraining_tpu.ops import masks as M
+from mlx_cuda_distributed_pretraining_tpu.ops.attention import reference_attention
+from mlx_cuda_distributed_pretraining_tpu.ops.ring_attention import make_ring_attention
+from mlx_cuda_distributed_pretraining_tpu.parallel import build_mesh
+
+
+def _mesh(cfg):
+    return build_mesh(SystemConfig(seed=0, device="cpu", mesh=cfg))
+
+
+def _qkv(hq=4, hkv=4, b=2, s=64, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(
+        jnp.asarray(rng.normal(size=(b, s, h, d)).astype(np.float32))
+        for h in (hq, hkv, hkv)
+    )
+
+
+def test_ring_matches_reference_causal():
+    mesh = _mesh({"sp": 8})
+    q, k, v = _qkv()
+    ring = make_ring_attention(mesh, mask_mod=M.causal())
+    out = jax.jit(ring)(q, k, v)
+    ref = reference_attention(q, k, v, mask_mod=M.causal())
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_ring_gqa_and_dp_axis():
+    mesh = _mesh({"dp": 2, "sp": 4})
+    q, k, v = _qkv(hq=4, hkv=2)
+    ring = make_ring_attention(mesh, mask_mod=M.causal())
+    out = jax.jit(ring)(q, k, v)
+    ref = reference_attention(q, k, v, mask_mod=M.causal())
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_ring_sliding_window():
+    mesh = _mesh({"sp": 4})
+    q, k, v = _qkv(s=64)
+    ring = make_ring_attention(mesh, mask_mod=M.sliding_window(24))
+    out = jax.jit(ring)(q, k, v)
+    ref = reference_attention(q, k, v, mask_mod=M.sliding_window(24))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_ring_gradients_match():
+    mesh = _mesh({"sp": 4})
+    q, k, v = _qkv(s=32)
+    ring = make_ring_attention(mesh, mask_mod=M.causal())
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring(q, k, v) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, mask_mod=M.causal()) ** 2)
+
+    gr = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    gf = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+
+def test_ring_model_level_end_to_end():
+    """Full model with attention_type='ring' on an sp mesh == simple
+    attention single device, and a sharded train step executes."""
+    from mlx_cuda_distributed_pretraining_tpu.config import TrainingConfig
+    from mlx_cuda_distributed_pretraining_tpu.models import llama
+    from mlx_cuda_distributed_pretraining_tpu.models.llama import LlamaArgs
+    from mlx_cuda_distributed_pretraining_tpu.optim import build_optimizer
+    from mlx_cuda_distributed_pretraining_tpu.parallel.context import use_mesh
+    from mlx_cuda_distributed_pretraining_tpu.train.train_step import (
+        init_train_state,
+        make_train_step,
+    )
+
+    base = LlamaArgs(vocab_size=64, hidden_size=32, intermediate_size=64,
+                     num_layers=2, num_heads=4, num_kv_heads=2, head_dim=8,
+                     max_position_embeddings=64)
+    ring_args = LlamaArgs(**{**base.__dict__, "attention_type": "ring"})
+    params = llama.init_params(jax.random.PRNGKey(0), base)
+    tokens = jnp.asarray(np.random.default_rng(0).integers(1, 60, (4, 32)), jnp.int32)
+
+    mesh = _mesh({"dp": 2, "sp": 4})
+    with use_mesh(mesh):
+        logits_ring, _ = jax.jit(
+            lambda p, t: llama.forward(p, t, ring_args))(params, tokens)
+    logits_ref, _ = llama.forward(params, tokens, base)
+    np.testing.assert_allclose(np.asarray(logits_ring), np.asarray(logits_ref),
+                               atol=2e-4, rtol=2e-4)
+
+    # full sharded train step with sp axis
+    tr_cfg = TrainingConfig(hyperparameters={"learning_rate": 1e-2},
+                            optimization={"optimizer": "adamw"})
+    opt = build_optimizer(tr_cfg, 10)
+    with use_mesh(mesh):
+        step, shardings = make_train_step(
+            lambda p, b: llama.loss_fn(p, b, ring_args), opt,
+            mesh=mesh, params_like=params)
+        state = jax.device_put(init_train_state(params, opt), shardings)
+        batch = {
+            "inputs": tokens,
+            "targets": jnp.roll(tokens, -1, axis=1),
+            "mask": jnp.ones((4, 32), jnp.float32),
+        }
+        state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
