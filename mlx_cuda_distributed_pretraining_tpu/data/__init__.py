@@ -1,4 +1,12 @@
 from .packing import pack_documents, pad_documents
 from .memory import DataManager
+from .streaming import DiskSpaceManager, StreamingDataManager, build_data_manager
 
-__all__ = ["pack_documents", "pad_documents", "DataManager"]
+__all__ = [
+    "pack_documents",
+    "pad_documents",
+    "DataManager",
+    "DiskSpaceManager",
+    "StreamingDataManager",
+    "build_data_manager",
+]

@@ -54,8 +54,7 @@ def ring_attention(
     qg = q.reshape(B, Sl, Hkv, G, D)
     q_idx = my * Sl + jnp.arange(Sl, dtype=jnp.int32)
 
-    def step(carry, i):
-        k_cur, v_cur, m, l, acc = carry
+    def accumulate(m, l, acc, k_cur, v_cur, i):
         # chunk i holds the shard originally owned by device (my - i) % sp
         src = (my - i) % sp
         kv_idx = src * Sl + jnp.arange(Sl, dtype=jnp.int32)
@@ -69,18 +68,25 @@ def ring_attention(
         l_new = alpha * l + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[..., None] + jnp.einsum(
             "bhgqk,bkhd->bhgqd", p, v_cur.astype(jnp.float32))
+        return m_new, l_new, acc_new
 
+    def step(carry, i):
+        k_cur, v_cur, m, l, acc = carry
+        m, l, acc = accumulate(m, l, acc, k_cur, v_cur, i)
         # rotate KV around the ring (device d sends to d+1)
         perm = [(j, (j + 1) % sp) for j in range(sp)]
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+        return (k_nxt, v_nxt, m, l, acc), None
 
     m0 = jnp.full((B, Hkv, G, Sl), NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, Hkv, G, Sl), jnp.float32)
     acc0 = jnp.zeros((B, Hkv, G, Sl, D), jnp.float32)
-    (_, _, m, l, acc), _ = jax.lax.scan(
-        step, (k, v, m0, l0, acc0), jnp.arange(sp, dtype=jnp.int32))
+    # Only sp-1 rotations are needed: the last chunk's accumulation happens
+    # outside the scan so its (otherwise discarded) ppermute is never issued.
+    (k_last, v_last, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(sp - 1, dtype=jnp.int32))
+    m, l, acc = accumulate(m, l, acc, k_last, v_last, sp - 1)
 
     l_safe = jnp.maximum(l, 1e-30)
     out = acc / l_safe[..., None]  # [B, Hkv, G, Sl, D]

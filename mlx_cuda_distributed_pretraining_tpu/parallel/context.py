@@ -1,6 +1,13 @@
 """Current-mesh context: lets deeply-nested model code (ring attention)
 reach the mesh that the Trainer built, without threading a non-hashable
-Mesh through frozen model args."""
+Mesh through frozen model args.
+
+Two layers: a long-lived *base* slot owned by whoever calls ``set_mesh``
+(the Trainer), and a scoped stack pushed by ``use_mesh``. Scoped entries
+shadow the base; ``set_mesh`` never touches the scoped stack, so a Trainer
+constructed inside a ``use_mesh`` block neither corrupts the stack nor
+loses its own mesh when the block exits.
+"""
 
 from __future__ import annotations
 
@@ -9,24 +16,23 @@ from typing import Optional
 
 from jax.sharding import Mesh
 
-_CURRENT: list = []
+_BASE: list = [None]
+_SCOPED: list = []
 
 
 def current_mesh() -> Optional[Mesh]:
-    return _CURRENT[-1] if _CURRENT else None
+    return _SCOPED[-1] if _SCOPED else _BASE[0]
 
 
 @contextlib.contextmanager
 def use_mesh(mesh: Optional[Mesh]):
-    _CURRENT.append(mesh)
+    _SCOPED.append(mesh)
     try:
         yield mesh
     finally:
-        _CURRENT.pop()
+        _SCOPED.pop()
 
 
 def set_mesh(mesh: Optional[Mesh]) -> None:
     """Non-scoped variant for long-lived Trainer ownership."""
-    _CURRENT.clear()
-    if mesh is not None:
-        _CURRENT.append(mesh)
+    _BASE[0] = mesh

@@ -32,21 +32,21 @@ def initialize_distributed(
     import jax
 
     explicit = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if explicit:
+        # An explicitly requested multi-process rendezvous must fail FAST on
+        # error — falling back to N independent single-host runs would have
+        # every host train solo and clobber the same run dir.
+        jax.distributed.initialize(
+            coordinator_address=explicit,
+            num_processes=num_processes or int(os.environ.get("JAX_NUM_PROCESSES", "1")),
+            process_id=process_id if process_id is not None
+            else int(os.environ.get("JAX_PROCESS_ID", "0")),
+        )
+        return jax.process_count() > 1
     try:
-        if explicit:
-            jax.distributed.initialize(
-                coordinator_address=explicit,
-                num_processes=num_processes or int(os.environ.get("JAX_NUM_PROCESSES", "1")),
-                process_id=process_id if process_id is not None
-                else int(os.environ.get("JAX_PROCESS_ID", "0")),
-            )
-        else:
-            jax.distributed.initialize()  # TPU pod auto-detection
-    except (ValueError, RuntimeError) as e:
-        # single-host fallback: not an error for 1-process runs
-        if jax.process_count() == 1:
-            return False
-        raise e
+        jax.distributed.initialize()  # TPU pod auto-detection
+    except (ValueError, RuntimeError):
+        return False  # single-host fallback: not an error for 1-process runs
     return jax.process_count() > 1
 
 

@@ -29,6 +29,7 @@ import numpy as np
 from ..checkpoint import CheckpointManager
 from ..config import Config, apply_overrides
 from ..data import DataManager
+from ..data.streaming import build_data_manager
 from ..models.llama import LlamaArgs
 from ..models import llama as llama_mod
 from ..models.registry import resolve_architecture
@@ -117,8 +118,8 @@ class Trainer:
         # -- data ------------------------------------------------------------
         self.data: Optional[DataManager] = None
         if for_training:
-            self.data = DataManager(
-                cfg.data,
+            self.data = build_data_manager(
+                cfg,
                 self.tokenizer,
                 batch_size=cfg.training.batch_size,
                 seq_len=cfg.data.max_context_size,
@@ -132,10 +133,11 @@ class Trainer:
         if for_training:
             if cfg.training.iters:
                 self.total_steps = cfg.training.iters
-            elif cfg.training.epochs:
-                self.total_steps = cfg.training.epochs * self.data.batches_per_epoch
+            elif hasattr(self.data, "batches_per_epoch"):
+                epochs = cfg.training.epochs or 1
+                self.total_steps = epochs * self.data.batches_per_epoch
             else:
-                self.total_steps = self.data.batches_per_epoch
+                raise ValueError("streaming data sources require training.iters")
         self.schedule = build_schedule(cfg.training, max(self.total_steps, 1))
         self.optimizer = build_optimizer(cfg.training, max(self.total_steps, 1), schedule=self.schedule)
         self.accum_steps = cfg.training.gradient_accumulation_steps
@@ -169,7 +171,7 @@ class Trainer:
         training_state = {
             "step": int(self.state["step"]),
             "total_tokens": int(self.total_tokens),
-            "val_ptr": self.data.val_ptr if self.data else 0,
+            **(self.data.state_dict() if self.data else {"val_ptr": 0}),
             "validation": self.val_history,
             "early_stopping": self.early_stopping.state_dict(),
         }
@@ -309,7 +311,11 @@ class Trainer:
         stopped_early = False
 
         for step in range(self.start_step + 1, self.total_steps + 1):
-            batch = self.data.generate_batch(step - 1)
+            try:
+                batch = self.data.generate_batch(step - 1)
+            except StopIteration:  # finite stream ran dry (streaming sources)
+                self.logger.log(f"Data stream exhausted before step {step}; stopping")
+                break
             # Host-side token count (non-pad targets) so tok/s stays correct
             # even when device metrics are only read every log_int steps.
             step_tokens = int(batch["mask"].sum()) * jax.process_count()
@@ -365,6 +371,8 @@ class Trainer:
                 self.val_history["steps"].append(step)
                 self.val_history["losses"].append(final_val)
         self.save_checkpoint("final")
+        if hasattr(self.data, "stop"):
+            self.data.stop()  # streaming sources run a prefetch thread
         self.logger.log("Training complete")
         self.logger.close()
         return {"final_loss": last_loss, "final_val_loss": final_val, "steps": step}

@@ -1,0 +1,182 @@
+"""Streaming data pipeline tests (reference capability: fineweb_stream*.py)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mlx_cuda_distributed_pretraining_tpu.config import Config, DataConfig
+from mlx_cuda_distributed_pretraining_tpu.data import (
+    DataManager,
+    DiskSpaceManager,
+    StreamingDataManager,
+    build_data_manager,
+)
+from mlx_cuda_distributed_pretraining_tpu.data.streaming import (
+    iter_jsonl_shards,
+    iter_synthetic,
+    sharded,
+    shuffled,
+)
+from mlx_cuda_distributed_pretraining_tpu.tokenizer import TokenizerManager
+
+
+def _write_shard(path, n_docs, prefix="doc"):
+    with open(path, "w") as f:
+        for i in range(n_docs):
+            f.write(json.dumps({"text": f"{prefix} {i} " + "hello world " * 20}) + "\n")
+
+
+def _tokenizer(tmp_path, ctx=64):
+    dc = DataConfig(preprocessing={"max_context_size": ctx}, tokenizer={"type": "byte"})
+    return TokenizerManager(dc)
+
+
+def _streaming_cfg(tmp_path, shards, ctx=64, **extra):
+    return DataConfig(
+        preprocessing={"max_context_size": ctx},
+        tokenizer={"type": "byte"},
+        source="jsonl",
+        streaming={"shards": shards, "shuffle_buffer": 8, **extra},
+    )
+
+
+def test_iter_jsonl_shards_norepeat(tmp_path):
+    p = str(tmp_path / "s0.jsonl")
+    _write_shard(p, 5)
+    docs = list(iter_jsonl_shards([p], repeat=False))
+    assert len(docs) == 5
+    assert docs[0].startswith("doc 0")
+
+
+def test_sharded_disjoint():
+    items = list(range(10))
+    a = list(sharded(iter(items), 0, 2))
+    b = list(sharded(iter(items), 1, 2))
+    assert a == [0, 2, 4, 6, 8] and b == [1, 3, 5, 7, 9]
+
+
+def test_shuffled_is_permutation():
+    items = [str(i) for i in range(100)]
+    out = list(shuffled(iter(items), buffer_size=16, seed=0))
+    assert sorted(out, key=int) == items and out != items
+
+
+def test_streaming_batches_static_shape(tmp_path):
+    p = str(tmp_path / "s0.jsonl")
+    _write_shard(p, 40)
+    tok = _tokenizer(tmp_path)
+    cfg = _streaming_cfg(tmp_path, [p])
+    mgr = StreamingDataManager(cfg, tok, batch_size=4, seq_len=32)
+    try:
+        for step in range(5):
+            b = mgr.generate_batch(step)
+            assert b["inputs"].shape == (4, 32)
+            assert b["targets"].shape == (4, 32)
+            assert b["mask"].shape == (4, 32)
+            assert b["inputs"].dtype == np.int32
+    finally:
+        mgr.stop()
+
+
+def test_streaming_finite_stream_raises(tmp_path):
+    p = str(tmp_path / "s0.jsonl")
+    _write_shard(p, 2)
+    tok = _tokenizer(tmp_path)
+    cfg = _streaming_cfg(tmp_path, [p], repeat=False)
+    mgr = StreamingDataManager(cfg, tok, batch_size=4, seq_len=4096)
+    with pytest.raises(StopIteration):
+        for _ in range(100):
+            mgr.generate_batch(0)
+    mgr.stop()
+
+
+def test_streaming_resume_skips_consumed(tmp_path):
+    p = str(tmp_path / "s0.jsonl")
+    _write_shard(p, 50)
+    tok = _tokenizer(tmp_path)
+    cfg = _streaming_cfg(tmp_path, [p])
+    mgr = StreamingDataManager(cfg, tok, batch_size=2, seq_len=32)
+    mgr.generate_batch(0)
+    state = mgr.state_dict()
+    mgr.stop()
+    assert state["docs_consumed"] > 0
+
+    mgr2 = StreamingDataManager(cfg, tok, batch_size=2, seq_len=32)
+    mgr2.load_state_dict(state)
+    b = mgr2.generate_batch(0)
+    assert b["inputs"].shape == (2, 32)
+    mgr2.stop()
+
+
+def test_synthetic_source_deterministic():
+    a = [next_doc for _, next_doc in zip(range(5), iter_synthetic(seed=3))]
+    b = [next_doc for _, next_doc in zip(range(5), iter_synthetic(seed=3))]
+    assert a == b
+
+
+def test_disk_space_manager_lru_cleanup(tmp_path):
+    cache = str(tmp_path / "cache")
+    mgr = DiskSpaceManager(cache, max_gb=2e-6)  # ~2 KB cap
+    for i in range(6):
+        with open(os.path.join(cache, f"f{i}.bin"), "wb") as f:
+            f.write(b"x" * 1024)
+        os.utime(os.path.join(cache, f"f{i}.bin"), (i + 1, i + 1))
+    assert mgr.usage_bytes() == 6 * 1024
+    removed = mgr.cleanup()
+    assert removed >= 4
+    assert mgr.usage_bytes() <= mgr.max_bytes
+    # Oldest files went first.
+    assert not os.path.exists(os.path.join(cache, "f0.bin"))
+    assert os.path.exists(os.path.join(cache, "f5.bin"))
+
+
+def test_build_data_manager_dispatch(tmp_path):
+    train = str(tmp_path / "train.jsonl")
+    _write_shard(train, 10)
+    # In-memory path
+    dc = DataConfig(input_file=train, preprocessing={"max_context_size": 32},
+                    tokenizer={"type": "byte"})
+    tok = TokenizerManager(dc)
+    m1 = build_data_manager(dc, tok, batch_size=2, seq_len=32)
+    assert isinstance(m1, DataManager)
+    # Streaming path
+    dc2 = _streaming_cfg(tmp_path, [train])
+    m2 = build_data_manager(dc2, tok, batch_size=2, seq_len=32)
+    assert isinstance(m2, StreamingDataManager)
+    m2.stop()
+
+
+def test_trainer_with_streaming_source(tmp_path):
+    from mlx_cuda_distributed_pretraining_tpu.train.trainer import Trainer
+
+    train = str(tmp_path / "train.jsonl")
+    _write_shard(train, 60)
+    cfg = Config.from_dict({
+        "name": "stream-tiny",
+        "overwrite": True,
+        "data": {
+            "source": "jsonl",
+            "streaming": {"shards": [train], "shuffle_buffer": 8},
+            "preprocessing": {"max_context_size": 32},
+            "tokenizer": {"normal_vocab_size": 256},
+        },
+        "model": {
+            "architecture": "llama",
+            "dimensions": {"hidden_size": 32, "intermediate_size": 64, "num_layers": 2},
+            "attention": {"num_heads": 4, "num_kv_heads": 2, "head_dim": 8},
+        },
+        "training": {
+            "hyperparameters": {"batch_size": 4, "learning_rate": 1e-2, "iters": 8},
+            "optimization": {"optimizer": "adamw"},
+        },
+        "logging": {
+            "steps": {"logging_interval": 4, "checkpoint_interval": 0, "validation_interval": 0},
+        },
+        "system": {"seed": 0},
+    })
+    tr = Trainer(cfg, runs_root=str(tmp_path / "runs"), quiet=True)
+    result = tr.train()
+    assert result["steps"] == 8
+    assert np.isfinite(result["final_loss"])
