@@ -87,8 +87,13 @@ class DataManager:
     def _load_split(self, path: Optional[str]) -> np.ndarray:
         if not path or not os.path.exists(path):
             return np.zeros((0, self.seq_len + 1), np.int32)
+        texts = load_jsonl_texts(path)
+        if self.packing:
+            rows = self._native_pack(texts)
+            if rows is not None:
+                return rows
         docs: List[List[int]] = []
-        for text in load_jsonl_texts(path):
+        for text in texts:
             ids = self.tokenizer.tokenize_doc(text, max_length=10**9)
             # Long docs are chunked at token level with overlap carried over.
             for chunk in chunk_tokens(ids, self.seq_len + 1, self.chunk_overlap):
@@ -96,6 +101,25 @@ class DataManager:
         if self.packing:
             return pack_documents(docs, self.seq_len, self.pad_id)
         return pad_documents(docs, self.seq_len, self.pad_id)
+
+    def _native_pack(self, texts: List[str]) -> Optional[np.ndarray]:
+        """C++ fast path for byte tokenizers (native/dataplane.cpp) — exact
+        same rows as the Python tokenize→chunk→pack pipeline."""
+        from ..tokenizer import ByteTokenizer
+        from .. import native
+
+        byte_tok = getattr(self.tokenizer, "tokenizer", None)
+        if not isinstance(byte_tok, ByteTokenizer):
+            return None
+        return native.byte_pack_docs(
+            texts,
+            normal_vocab=byte_tok.normal_vocab_size,
+            bos=byte_tok.bos_id,
+            eos=byte_tok.eos_id,
+            pad=byte_tok.pad_id,
+            row_len=self.seq_len + 1,
+            overlap=self.chunk_overlap,
+        )
 
     # -- batches ------------------------------------------------------------
     @property

@@ -1,0 +1,106 @@
+"""ctypes bindings for the native C++ data-plane (dataplane.cpp).
+
+Compiles the shared library on first use with g++ (cached next to the
+source, rebuilt when the source is newer). Every entry point degrades to
+the pure-Python path when the toolchain is unavailable — callers check
+``available()`` or just get ``None`` from ``byte_pack_docs``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "dataplane.cpp")
+_LIB_PATH = os.path.join(_HERE, "_dataplane.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", _LIB_PATH, _SRC],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        stale = (not os.path.exists(_LIB_PATH)
+                 or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC))
+        if stale and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64 = ctypes.c_int64
+        i32 = ctypes.c_int32
+        lib.byte_pack_count.argtypes = [u8p, i64p, i64, i32, i64, i64, i64]
+        lib.byte_pack_count.restype = i64
+        lib.byte_pack_fill.argtypes = [u8p, i64p, i64, i32, i64, i64, i64,
+                                       i32, i32, i32, i32p, i64]
+        lib.byte_pack_fill.restype = i64
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def byte_pack_docs(
+    texts: List[str],
+    normal_vocab: int,
+    bos: int,
+    eos: int,
+    pad: int,
+    row_len: int,
+    overlap: int = 0,
+    max_doc_tokens: int = 10**9,
+) -> Optional[np.ndarray]:
+    """Byte-tokenize + chunk + pack documents into ``[N, row_len]`` int32
+    rows. Returns None when the native library is unavailable (callers fall
+    back to the Python path in data/memory.py)."""
+    lib = _load()
+    if lib is None:
+        return None
+    blobs = [t.encode("utf-8") for t in texts]
+    data = b"".join(blobs)
+    offsets = np.zeros(len(blobs) + 1, np.int64)
+    np.cumsum([len(b) for b in blobs], out=offsets[1:])
+    buf = np.frombuffer(data, np.uint8) if data else np.zeros(0, np.uint8)
+    buf = np.ascontiguousarray(buf)
+
+    u8p = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    offp = offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    n_tokens = lib.byte_pack_count(
+        u8p, offp, len(blobs), normal_vocab, max_doc_tokens, row_len, overlap)
+    n_rows = (n_tokens + row_len - 1) // row_len
+    out = np.empty(max(n_rows, 0) * row_len, np.int32)
+    written = lib.byte_pack_fill(
+        u8p, offp, len(blobs), normal_vocab, max_doc_tokens, row_len, overlap,
+        bos, eos, pad,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), out.size)
+    if written < 0:
+        return None  # capacity mismatch — should not happen; fall back
+    return out[:written].reshape(-1, row_len)

@@ -1,0 +1,80 @@
+"""Native C++ data-plane parity tests: the ctypes packer must produce
+byte-identical rows to the Python tokenize→chunk→pack pipeline."""
+
+import numpy as np
+import pytest
+
+from mlx_cuda_distributed_pretraining_tpu import native
+from mlx_cuda_distributed_pretraining_tpu.data.packing import chunk_tokens, pack_documents
+from mlx_cuda_distributed_pretraining_tpu.tokenizer import ByteTokenizer
+
+pytestmark = pytest.mark.skipif(not native.available(), reason="no C++ toolchain")
+
+
+def _python_rows(texts, tok, seq_len, overlap=0, max_doc_tokens=10**9):
+    docs = []
+    for t in texts:
+        ids = [tok.bos_id] + tok.encode(t)[:max_doc_tokens] + [tok.eos_id]
+        docs.extend(chunk_tokens(ids, seq_len + 1, overlap))
+    return pack_documents(docs, seq_len, tok.pad_id)
+
+
+@pytest.mark.parametrize("overlap", [0, 3])
+def test_native_matches_python(overlap):
+    tok = ByteTokenizer()
+    texts = ["hello world", "a" * 500, "", "unicode éè☃ text", "short"]
+    seq_len = 64
+    expect = _python_rows(texts, tok, seq_len, overlap)
+    got = native.byte_pack_docs(
+        texts, normal_vocab=256, bos=tok.bos_id, eos=tok.eos_id,
+        pad=tok.pad_id, row_len=seq_len + 1, overlap=overlap)
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_native_byte_filter_small_vocab():
+    tok = ByteTokenizer(normal_vocab_size=128)
+    texts = ["ascii only", "café ☃"]  # multi-byte chars filtered out
+    expect = _python_rows(texts, tok, 32)
+    got = native.byte_pack_docs(
+        texts, normal_vocab=128, bos=tok.bos_id, eos=tok.eos_id,
+        pad=tok.pad_id, row_len=33)
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_native_truncation():
+    tok = ByteTokenizer()
+    texts = ["x" * 1000]
+    expect = _python_rows(texts, tok, 16, max_doc_tokens=100)
+    got = native.byte_pack_docs(
+        texts, normal_vocab=256, bos=tok.bos_id, eos=tok.eos_id,
+        pad=tok.pad_id, row_len=17, max_doc_tokens=100)
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_native_empty_inputs():
+    tok = ByteTokenizer()
+    got = native.byte_pack_docs(
+        [], normal_vocab=256, bos=tok.bos_id, eos=tok.eos_id,
+        pad=tok.pad_id, row_len=17)
+    assert got.shape == (0, 17)
+
+
+def test_datamanager_uses_native(tmp_path):
+    """The in-memory loader's native fast path yields identical training rows."""
+    import json
+
+    from mlx_cuda_distributed_pretraining_tpu.config import DataConfig
+    from mlx_cuda_distributed_pretraining_tpu.data import DataManager
+    from mlx_cuda_distributed_pretraining_tpu.tokenizer import TokenizerManager
+
+    p = tmp_path / "train.jsonl"
+    with open(p, "w") as f:
+        for i in range(20):
+            f.write(json.dumps({"text": f"document {i} " + "lorem ipsum " * 30}) + "\n")
+    dc = DataConfig(input_file=str(p), preprocessing={"max_context_size": 48})
+    tok = TokenizerManager(dc)
+    mgr = DataManager(dc, tok, batch_size=2, seq_len=48)
+
+    texts = [json.loads(l)["text"] for l in open(p)]
+    expect = _python_rows(texts, tok.tokenizer, 48)
+    np.testing.assert_array_equal(mgr.train_rows, expect)
