@@ -183,6 +183,13 @@ class LoggingConfig:
     def validation_interval(self) -> int:
         return int(_get(self.steps, "validation_interval", 0))
 
+    @property
+    def stats_url(self) -> Optional[str]:
+        """WebSocket URL of a stats hub (obs/stats_server.py); metrics are
+        published there each logging interval when set."""
+        url = _get(self.metrics, "stats_url", None)
+        return str(url) if url else None
+
 
 @dataclass
 class SystemConfig:

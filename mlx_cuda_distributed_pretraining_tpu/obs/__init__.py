@@ -1,3 +1,19 @@
 from .logger import Logger
+from .plotting import ema, parse_log, plot_run, write_csv
+from .monitor import LogTailer, find_latest_run, monitor
+from .stats_client import StatsClient
+from .stats_server import StatsServer, StatsState
 
-__all__ = ["Logger"]
+__all__ = [
+    "Logger",
+    "parse_log",
+    "ema",
+    "plot_run",
+    "write_csv",
+    "LogTailer",
+    "find_latest_run",
+    "monitor",
+    "StatsClient",
+    "StatsServer",
+    "StatsState",
+]

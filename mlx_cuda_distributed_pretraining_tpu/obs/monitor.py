@@ -1,0 +1,137 @@
+"""Live training monitor: attach to a running (or finished) run directory.
+
+Capability parity with the reference monitor (reference:
+monitor_training.py / utils/monitoring.py — finds the latest run log,
+regex-extracts step/loss/val_loss/lr/tok-s, live matplotlib plotting and a
+log-tail thread). This version tails ``log.txt`` incrementally, prints a
+status line per refresh, and optionally re-renders the loss plot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+from .plotting import STEP_RE, VAL_RE, KV_RE, plot_run
+
+
+def find_latest_run(runs_root: str = "runs") -> Optional[str]:
+    """Most recently modified run dir with a log.txt (reference:
+    monitor_training.py:69)."""
+    if not os.path.isdir(runs_root):
+        return None
+    best, best_t = None, -1.0
+    for name in os.listdir(runs_root):
+        log = os.path.join(runs_root, name, "log.txt")
+        if os.path.isfile(log):
+            t = os.path.getmtime(log)
+            if t > best_t:
+                best, best_t = os.path.join(runs_root, name), t
+    return best
+
+
+class LogTailer:
+    """Incremental log.txt reader that accumulates parsed metrics."""
+
+    def __init__(self, log_path: str):
+        self.log_path = log_path
+        self._pos = 0
+        self.steps: List[int] = []
+        self.latest: Dict[str, float] = {}
+        self.val_steps: List[int] = []
+        self.val_losses: List[float] = []
+        self.other_lines: List[str] = []
+
+    def poll(self) -> int:
+        """Read newly appended lines; returns how many metric lines parsed."""
+        if not os.path.isfile(self.log_path):
+            return 0
+        n = 0
+        with open(self.log_path) as f:
+            f.seek(self._pos)
+            for line in f:
+                if not line.endswith("\n"):
+                    break  # partial write; re-read next poll
+                self._pos += len(line)
+                line = line.strip()
+                vm = VAL_RE.match(line)
+                if vm:
+                    self.val_steps.append(int(vm.group(1)))
+                    self.val_losses.append(float(vm.group(2)))
+                    continue
+                m = STEP_RE.match(line)
+                if m:
+                    self.steps.append(int(m.group(1)))
+                    kvs = dict(KV_RE.findall(m.group(2)))
+                    self.latest = {k: float(v) for k, v in kvs.items()}
+                    self.latest["step"] = self.steps[-1]
+                    n += 1
+                elif line:
+                    self.other_lines.append(line)
+        return n
+
+    def status_line(self) -> str:
+        if not self.latest:
+            return "(no metric lines yet)"
+        parts = [f"step {int(self.latest['step'])}"]
+        for k in ("loss", "ppl", "lr", "tok/s"):
+            if k in self.latest:
+                fmt = ".3e" if k == "lr" else ".4f" if k != "tok/s" else ".0f"
+                parts.append(f"{k}={self.latest[k]:{fmt}}")
+        if self.val_losses:
+            parts.append(f"val_loss={self.val_losses[-1]:.4f}@{self.val_steps[-1]}")
+        return " | ".join(parts)
+
+
+def monitor(
+    run_dir: str,
+    interval: float = 5.0,
+    max_iters: Optional[int] = None,
+    plot_every: int = 0,
+    on_status: Optional[Callable[[str], None]] = None,
+) -> LogTailer:
+    """Poll loop. ``max_iters`` bounds iterations (None = until Ctrl-C)."""
+    tailer = LogTailer(os.path.join(run_dir, "log.txt"))
+    emit = on_status or (lambda s: print(s, flush=True))
+    i = 0
+    try:
+        while max_iters is None or i < max_iters:
+            if tailer.poll():
+                emit(tailer.status_line())
+                if plot_every and len(tailer.steps) % plot_every == 0:
+                    try:
+                        plot_run(run_dir)
+                    except (ValueError, OSError):
+                        pass
+            i += 1
+            if max_iters is None or i < max_iters:
+                time.sleep(interval)
+    except KeyboardInterrupt:  # pragma: no cover - interactive
+        pass
+    return tailer
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="Monitor a training run")
+    parser.add_argument("run", nargs="?", default=None,
+                        help="run name or dir (default: latest under runs/)")
+    parser.add_argument("--runs-root", default="runs")
+    parser.add_argument("--interval", type=float, default=5.0)
+    parser.add_argument("--plot-every", type=int, default=0,
+                        help="re-render loss_curve.png every N metric lines")
+    a = parser.parse_args(argv)
+    run_dir = a.run
+    if run_dir is None:
+        run_dir = find_latest_run(a.runs_root)
+        if run_dir is None:
+            parser.error(f"no runs found under {a.runs_root}/")
+        print(f"monitoring {run_dir}")
+    elif not os.path.isdir(run_dir):
+        run_dir = os.path.join(a.runs_root, run_dir)
+    monitor(run_dir, a.interval, plot_every=a.plot_every)
+
+
+if __name__ == "__main__":
+    main()

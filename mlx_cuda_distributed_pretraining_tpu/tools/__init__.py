@@ -1,0 +1,4 @@
+"""User-facing tools (reference: tools/ — convert-to-mlx-lm.py,
+train-tokenizer.py, model_cli.py, visualize_model.py; plus the flat data
+prep/inspection scripts prepare_data_a100.py, prepare_tinystories_data.py,
+examine.py, find_data.py)."""

@@ -1,0 +1,131 @@
+"""Export a trained run to HuggingFace-Llama format.
+
+Capability parity with the reference's exporter (reference:
+tools/convert-to-mlx-lm.py:13-177): copy the final model weights +
+tokenizer out of a ``runs/<name>`` directory and emit ``config.json`` /
+``tokenizer_config.json`` in the HF ``LlamaForCausalLM`` layout so the
+checkpoint is consumable by transformers / mlx-lm / lm-eval.
+
+TPU-native note: our parameters are stored as ``[in, out]`` matrices (the
+natural layout for ``x @ W`` on the MXU); HF stores ``nn.Linear`` weights
+``[out, in]``, so projections are transposed on export.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+from typing import Any, Dict
+
+import numpy as np
+
+
+def hf_state_dict(params: Dict[str, Any], tie_word_embeddings: bool) -> Dict[str, np.ndarray]:
+    """Map our pytree to HF-Llama parameter names (transposing projections)."""
+    out: Dict[str, np.ndarray] = {}
+
+    def t(x):
+        return np.asarray(x).T
+
+    out["model.embed_tokens.weight"] = np.asarray(params["tok_embeddings"]["weight"])
+    for i, layer in enumerate(params["layers"]):
+        pre = f"model.layers.{i}"
+        att, ffn = layer["attention"], layer["feed_forward"]
+        out[f"{pre}.input_layernorm.weight"] = np.asarray(layer["attention_norm"]["weight"])
+        out[f"{pre}.self_attn.q_proj.weight"] = t(att["wq"]["weight"])
+        out[f"{pre}.self_attn.k_proj.weight"] = t(att["wk"]["weight"])
+        out[f"{pre}.self_attn.v_proj.weight"] = t(att["wv"]["weight"])
+        out[f"{pre}.self_attn.o_proj.weight"] = t(att["wo"]["weight"])
+        out[f"{pre}.post_attention_layernorm.weight"] = np.asarray(layer["ffn_norm"]["weight"])
+        out[f"{pre}.mlp.gate_proj.weight"] = t(ffn["w_gate"]["weight"])
+        out[f"{pre}.mlp.up_proj.weight"] = t(ffn["w_up"]["weight"])
+        out[f"{pre}.mlp.down_proj.weight"] = t(ffn["w_down"]["weight"])
+    out["model.norm.weight"] = np.asarray(params["norm"]["weight"])
+    if not tie_word_embeddings and "output" in params:
+        out["lm_head.weight"] = t(params["output"]["weight"])
+    return out
+
+
+def hf_config(args: Any, tie_word_embeddings: bool) -> Dict[str, Any]:
+    """HF config.json for LlamaForCausalLM (reference: tools/
+    convert-to-mlx-lm.py:59-89 emits the same architecture block)."""
+    return {
+        "architectures": ["LlamaForCausalLM"],
+        "model_type": "llama",
+        "vocab_size": int(args.vocab_size),
+        "hidden_size": int(args.hidden_size),
+        "intermediate_size": int(args.intermediate_size),
+        "num_hidden_layers": int(args.num_layers),
+        "num_attention_heads": int(args.num_heads),
+        "num_key_value_heads": int(args.num_kv_heads),
+        "head_dim": int(args.head_dim),
+        "hidden_act": "silu",
+        "max_position_embeddings": int(args.max_position_embeddings),
+        "rms_norm_eps": float(args.rms_norm_eps),
+        "rope_theta": float(args.rope_theta),
+        "attention_bias": bool(args.attention_bias),
+        "mlp_bias": bool(args.mlp_bias),
+        "tie_word_embeddings": bool(tie_word_embeddings),
+        "torch_dtype": "float32",
+        "bos_token_id": 1,
+        "eos_token_id": 2,
+    }
+
+
+def convert_run(run_dir: str, out_path: str) -> str:
+    from ..checkpoint.safetensors_io import save_safetensors
+    from ..train.trainer import load_trained
+
+    params, args, tok, _cfg = load_trained(run_dir)
+    os.makedirs(out_path, exist_ok=True)
+
+    sd = hf_state_dict(params, args.tie_word_embeddings)
+    save_safetensors(os.path.join(out_path, "model.safetensors"), sd,
+                     metadata={"format": "pt"})
+
+    cfg = hf_config(args, args.tie_word_embeddings)
+    cfg["bos_token_id"] = tok.bos_id
+    cfg["eos_token_id"] = tok.eos_id
+    with open(os.path.join(out_path, "config.json"), "w") as f:
+        json.dump(cfg, f, indent=2)
+
+    # Tokenizer: copy the HF tokenizer.json when the run used one; byte
+    # tokenizers export their metadata file (HF has no byte-level analogue).
+    tok_src = os.path.join(run_dir, "tokenizer")
+    for name in ("tokenizer.json", "byte_tokenizer.json"):
+        src = os.path.join(tok_src, name)
+        if os.path.isfile(src):
+            shutil.copy(src, os.path.join(out_path, name))
+
+    bos_tok = tok.tokenizer.special_token_names.get("bos", "<bos>")
+    eos_tok = tok.tokenizer.special_token_names.get("eos", "<eos>")
+    tokenizer_config = {
+        "tokenizer_class": "PreTrainedTokenizerFast",
+        "bos_token": bos_tok,
+        "eos_token": eos_tok,
+        "pad_token": tok.tokenizer.special_token_names.get("pad", "<pad>"),
+        "add_bos_token": True,
+        "add_eos_token": False,
+        "model_max_length": int(args.max_position_embeddings),
+    }
+    with open(os.path.join(out_path, "tokenizer_config.json"), "w") as f:
+        json.dump(tokenizer_config, f, indent=2)
+    return out_path
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="Export a run to HF-Llama format")
+    parser.add_argument("--run", required=True, help="run name or directory")
+    parser.add_argument("--runs-root", default="runs")
+    parser.add_argument("--out-path", required=True)
+    a = parser.parse_args(argv)
+    run_dir = a.run if os.path.isdir(a.run) else os.path.join(a.runs_root, a.run)
+    out = convert_run(run_dir, a.out_path)
+    print(f"Exported to {out}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""Data discovery and inspection.
+
+Capability parity with the reference's inspection scripts (reference:
+find_data.py — list candidate data files; examine.py — per-file doc/char/
+token counts with ``--count-tokens``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Any, Dict, List
+
+DATA_EXTS = (".jsonl", ".json", ".txt")
+
+
+def find_data_files(root: str = ".", min_bytes: int = 1024) -> List[Dict[str, Any]]:
+    """Walk ``root`` for candidate corpus files, largest first."""
+    out = []
+    skip_dirs = {".git", "__pycache__", "node_modules", ".venv", "venv"}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in skip_dirs]
+        for name in filenames:
+            if not name.endswith(DATA_EXTS):
+                continue
+            path = os.path.join(dirpath, name)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            if size >= min_bytes:
+                out.append({"path": path, "bytes": size})
+    return sorted(out, key=lambda d: -d["bytes"])
+
+
+def examine_file(path: str, count_tokens: bool = False, text_key: str = "text",
+                 sample: int = 0) -> Dict[str, Any]:
+    """Doc/char statistics for a JSONL (or plain text) corpus; optional
+    byte-token count (1 token per UTF-8 byte + BOS/EOS per doc)."""
+    n_docs = 0
+    n_chars = 0
+    n_tokens = 0
+    samples: List[str] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            text = None
+            if path.endswith(".jsonl") or path.endswith(".json"):
+                try:
+                    obj = json.loads(line)
+                    if isinstance(obj, dict):
+                        text = obj.get(text_key)
+                    elif isinstance(obj, str):
+                        text = obj
+                except json.JSONDecodeError:
+                    continue
+            else:
+                text = line
+            if not text:
+                continue
+            n_docs += 1
+            n_chars += len(text)
+            if count_tokens:
+                n_tokens += len(text.encode("utf-8")) + 2
+            if len(samples) < sample:
+                samples.append(text[:200])
+    stats: Dict[str, Any] = {
+        "path": path,
+        "docs": n_docs,
+        "chars": n_chars,
+        "mean_doc_chars": n_chars / n_docs if n_docs else 0,
+    }
+    if count_tokens:
+        stats["byte_tokens"] = n_tokens
+    if samples:
+        stats["samples"] = samples
+    return stats
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="Find and examine corpus files")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    f = sub.add_parser("find", help="list candidate data files")
+    f.add_argument("--root", default=".")
+    f.add_argument("--min-bytes", type=int, default=1024)
+
+    e = sub.add_parser("examine", help="per-file statistics")
+    e.add_argument("path")
+    e.add_argument("--count-tokens", action="store_true")
+    e.add_argument("--text-key", default="text")
+    e.add_argument("--sample", type=int, default=0, help="print N sample docs")
+
+    a = parser.parse_args(argv)
+    if a.cmd == "find":
+        files = find_data_files(a.root, a.min_bytes)
+        for info in files:
+            print(f"{info['bytes']:>12}  {info['path']}")
+        return files
+    stats = examine_file(a.path, a.count_tokens, a.text_key, a.sample)
+    for k, v in stats.items():
+        if k != "samples":
+            print(f"{k:>16}: {v}")
+    for s in stats.get("samples", []):
+        print(f"  sample: {s!r}")
+    return stats
+
+
+if __name__ == "__main__":
+    main()

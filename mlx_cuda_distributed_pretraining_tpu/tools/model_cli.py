@@ -1,0 +1,121 @@
+"""Interactive REPL over trained runs: list / details / generate.
+
+Capability parity with the reference's model CLI (reference:
+tools/model_cli.py — interactive REPL over runs with list/details/
+generate commands).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import sys
+from typing import Any, Dict, Optional
+
+from .visualize_model import list_runs, print_summary, run_summary
+
+HELP = """commands:
+  list                      list trained runs
+  details <run>             show run summary
+  load <run>                load a run's final checkpoint for generation
+  generate <prompt...>      generate from the loaded run
+  temp <t> | tokens <n>     set sampling temperature / max new tokens
+  quit
+"""
+
+
+class ModelCLI:
+    def __init__(self, runs_root: str = "runs"):
+        self.runs_root = runs_root
+        self.loaded: Optional[str] = None
+        self._bundle = None  # (params, args, tokenizer, config)
+        self.temperature = 0.7
+        self.max_tokens = 128
+
+    def cmd_list(self) -> None:
+        runs = list_runs(self.runs_root)
+        if not runs:
+            print(f"no runs under {self.runs_root}/")
+        for r in runs:
+            marker = "*" if r == self.loaded else " "
+            print(f" {marker} {r}")
+
+    def cmd_details(self, run: str) -> None:
+        run_dir = run if os.path.isdir(run) else os.path.join(self.runs_root, run)
+        print_summary(run_summary(run_dir))
+
+    def cmd_load(self, run: str) -> None:
+        from ..train.trainer import load_trained
+
+        self._bundle = load_trained(run, runs_root=self.runs_root)
+        self.loaded = run
+        print(f"loaded {run}")
+
+    def cmd_generate(self, prompt: str) -> Optional[str]:
+        if self._bundle is None:
+            print("no run loaded (use: load <run>)")
+            return None
+        from ..infer.generate import generate_text
+
+        params, args, tok, _cfg = self._bundle
+        text = generate_text(params, args, tok, prompt,
+                             max_new_tokens=self.max_tokens,
+                             temperature=self.temperature)
+        print(text)
+        return text
+
+    def dispatch(self, line: str) -> bool:
+        """Returns False when the REPL should exit."""
+        parts = shlex.split(line)
+        if not parts:
+            return True
+        cmd, rest = parts[0], parts[1:]
+        if cmd in ("quit", "exit", "q"):
+            return False
+        elif cmd == "list":
+            self.cmd_list()
+        elif cmd == "details" and rest:
+            self.cmd_details(rest[0])
+        elif cmd == "load" and rest:
+            self.cmd_load(rest[0])
+        elif cmd == "generate":
+            self.cmd_generate(" ".join(rest))
+        elif cmd == "temp" and rest:
+            self.temperature = float(rest[0])
+        elif cmd == "tokens" and rest:
+            self.max_tokens = int(rest[0])
+        else:
+            print(HELP)
+        return True
+
+    def repl(self) -> None:
+        print(HELP)
+        while True:
+            try:
+                line = input("model> ")
+            except (EOFError, KeyboardInterrupt):
+                break
+            try:
+                if not self.dispatch(line):
+                    break
+            except Exception as e:  # keep the REPL alive on tool errors
+                print(f"error: {e}")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="Interactive model CLI")
+    parser.add_argument("--runs-root", default="runs")
+    parser.add_argument("-c", "--command", default=None,
+                        help="run one command non-interactively")
+    a = parser.parse_args(argv)
+    cli = ModelCLI(a.runs_root)
+    if a.command:
+        cli.dispatch(a.command)
+        return cli
+    cli.repl()
+    return cli
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,129 @@
+"""Data preparation: validate JSONL corpora and create train/val splits.
+
+Capability parity with the reference's prep scripts (reference:
+prepare_data_a100.py — JSONL validation, val-split creation, tokenizer
+checks; prepare_tinystories_data.py — dataset→JSONL conversion). Sources:
+local JSONL/text files or an HF dataset name (gated import).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+from typing import Iterator, Optional, Tuple
+
+
+def validate_jsonl(path: str, text_key: str = "text") -> Tuple[int, int]:
+    """Returns (valid_docs, invalid_lines)."""
+    good = bad = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+                if isinstance(obj, dict) and isinstance(obj.get(text_key), str) and obj[text_key]:
+                    good += 1
+                else:
+                    bad += 1
+            except json.JSONDecodeError:
+                bad += 1
+    return good, bad
+
+
+def _iter_docs(src: str, text_key: str, hf_split: str) -> Iterator[str]:
+    if os.path.exists(src):
+        with open(src) as f:
+            if src.endswith(".jsonl"):
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        obj = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if isinstance(obj, dict) and obj.get(text_key):
+                        yield obj[text_key]
+            else:  # plain text: blank-line separated documents
+                doc: list = []
+                for line in f:
+                    if line.strip():
+                        doc.append(line.rstrip("\n"))
+                    elif doc:
+                        yield "\n".join(doc)
+                        doc = []
+                if doc:
+                    yield "\n".join(doc)
+    else:  # HF dataset name, e.g. roneneldan/TinyStories
+        from datasets import load_dataset  # deferred: optional dependency
+
+        for sample in load_dataset(src, split=hf_split, streaming=True):
+            if isinstance(sample, dict) and sample.get(text_key):
+                yield sample[text_key]
+
+
+def prepare_split(
+    source: str,
+    out_dir: str,
+    val_fraction: float = 0.01,
+    max_docs: Optional[int] = None,
+    text_key: str = "text",
+    hf_split: str = "train",
+    seed: int = 42,
+) -> Tuple[str, str]:
+    """Write ``train.jsonl`` / ``val.jsonl`` under ``out_dir``; every doc
+    goes to val with probability ``val_fraction`` (deterministic by seed)."""
+    os.makedirs(out_dir, exist_ok=True)
+    train_path = os.path.join(out_dir, "train.jsonl")
+    val_path = os.path.join(out_dir, "val.jsonl")
+    rng = random.Random(seed)
+    n_train = n_val = 0
+    with open(train_path, "w") as ftr, open(val_path, "w") as fva:
+        for i, text in enumerate(_iter_docs(source, text_key, hf_split)):
+            if max_docs is not None and i >= max_docs:
+                break
+            line = json.dumps({"text": text}) + "\n"
+            if rng.random() < val_fraction:
+                fva.write(line)
+                n_val += 1
+            else:
+                ftr.write(line)
+                n_train += 1
+    print(f"Wrote {n_train} train docs -> {train_path}")
+    print(f"Wrote {n_val} val docs -> {val_path}")
+    return train_path, val_path
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="Prepare train/val JSONL data")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    v = sub.add_parser("validate", help="validate a JSONL corpus")
+    v.add_argument("path")
+    v.add_argument("--text-key", default="text")
+
+    s = sub.add_parser("split", help="create train/val JSONL from a source")
+    s.add_argument("source", help="JSONL/text file or HF dataset name")
+    s.add_argument("--out-dir", default="data")
+    s.add_argument("--val-fraction", type=float, default=0.01)
+    s.add_argument("--max-docs", type=int, default=None)
+    s.add_argument("--text-key", default="text")
+    s.add_argument("--hf-split", default="train")
+    s.add_argument("--seed", type=int, default=42)
+
+    a = parser.parse_args(argv)
+    if a.cmd == "validate":
+        good, bad = validate_jsonl(a.path, a.text_key)
+        print(f"{a.path}: {good} valid docs, {bad} invalid lines")
+        return 0 if bad == 0 else 1
+    prepare_split(a.source, a.out_dir, a.val_fraction, a.max_docs,
+                  a.text_key, a.hf_split, a.seed)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,105 @@
+"""Train a byte-level BPE tokenizer from a JSONL corpus.
+
+Capability parity with the reference trainer (reference:
+tools/train-tokenizer.py:39-101): byte-level BPE without a word-boundary
+regex, NFKC normalization, special tokens and vocab size from the YAML
+config, output saved as ``<out>/tokenizer.json`` loadable via
+``data.tokenizer_path``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Iterator, List, Optional
+
+
+def _iter_texts(paths: List[str], text_key: str = "text") -> Iterator[str]:
+    for path in paths:
+        with open(path, "r") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(obj, dict) and text_key in obj:
+                    yield obj[text_key]
+                elif isinstance(obj, str):
+                    yield obj
+
+
+def train_tokenizer(
+    inputs: List[str],
+    out_dir: str,
+    vocab_size: int = 32000,
+    special_tokens: Optional[List[str]] = None,
+    min_frequency: int = 2,
+) -> str:
+    """Returns the path of the written tokenizer.json."""
+    from tokenizers import Tokenizer, decoders, normalizers, pre_tokenizers
+    from tokenizers.models import BPE
+    from tokenizers.trainers import BpeTrainer
+
+    special_tokens = special_tokens or ["<pad>", "<bos>", "<eos>"]
+    tok = Tokenizer(BPE(unk_token=None))
+    tok.normalizer = normalizers.NFKC()
+    # use_regex=False: no word-boundary pre-split, merges can cross spaces
+    # (reference: tools/train-tokenizer.py trains byte-level BPE without the
+    # GPT-2 boundary regex).
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False, use_regex=False)
+    tok.decoder = decoders.ByteLevel()
+
+    trainer = BpeTrainer(
+        vocab_size=vocab_size,
+        min_frequency=min_frequency,
+        special_tokens=special_tokens,
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        show_progress=False,
+    )
+    tok.train_from_iterator(_iter_texts(inputs), trainer=trainer)
+
+    os.makedirs(out_dir, exist_ok=True)
+    out_file = os.path.join(out_dir, "tokenizer.json")
+    tok.save(out_file)
+    return out_file
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="Train a byte-level BPE tokenizer")
+    parser.add_argument("--config", default=None, help="YAML config (reads data section)")
+    parser.add_argument("--input", nargs="*", default=None, help="JSONL input files")
+    parser.add_argument("--vocab-size", type=int, default=None)
+    parser.add_argument("--output", default=None, help="output directory")
+    parser.add_argument("--min-frequency", type=int, default=2)
+    a = parser.parse_args(argv)
+
+    inputs = a.input or []
+    vocab_size = a.vocab_size
+    out_dir = a.output
+    special = None
+    if a.config:
+        from ..config import Config
+
+        cfg = Config.from_yaml(a.config)
+        tok_cfg = dict(cfg.data.tokenizer or {})
+        if not inputs and cfg.data.input_file:
+            inputs = [cfg.data.input_file]
+        vocab_size = vocab_size or int(tok_cfg.get("vocab_size", 32000))
+        out_dir = out_dir or cfg.data.tokenizer_path or "tokenizer"
+        st = tok_cfg.get("special_tokens")
+        if st:
+            special = list(st.values())
+    if not inputs:
+        parser.error("no input files (use --input or a config with data.input_file)")
+    out_file = train_tokenizer(
+        inputs, out_dir or "tokenizer", vocab_size or 32000, special, a.min_frequency)
+    print(f"Saved {out_file}")
+    return out_file
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,121 @@
+"""Run-directory inspector: prints model/config/checkpoint/metrics stats.
+
+Capability parity with the reference's visualizer (reference:
+tools/visualize_model.py — run-dir stats printer over runs/<name>).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+
+def list_runs(runs_root: str = "runs") -> List[str]:
+    if not os.path.isdir(runs_root):
+        return []
+    return sorted(
+        d for d in os.listdir(runs_root)
+        if os.path.isdir(os.path.join(runs_root, d, "checkpoints"))
+        or os.path.isfile(os.path.join(runs_root, d, "config.yaml"))
+    )
+
+
+def run_summary(run_dir: str) -> Dict[str, Any]:
+    """Collect config, checkpoint ledger, final metrics for one run."""
+    out: Dict[str, Any] = {"run_dir": run_dir, "name": os.path.basename(run_dir)}
+
+    cfg_path = os.path.join(run_dir, "config.yaml")
+    if os.path.isfile(cfg_path):
+        from ..config import Config
+
+        cfg = Config.from_yaml(cfg_path)
+        dims = dict(cfg.model.dimensions or {})
+        att = dict(cfg.model.attention or {})
+        out["architecture"] = cfg.model.architecture
+        out["hidden_size"] = dims.get("hidden_size")
+        out["num_layers"] = dims.get("num_layers")
+        out["num_heads"] = att.get("num_heads")
+        out["optimizer"] = (cfg.training.optimization or {}).get("optimizer")
+        out["batch_size"] = cfg.training.batch_size
+        out["iters"] = cfg.training.iters
+
+    meta_path = os.path.join(run_dir, "metadata.json")
+    if os.path.isfile(meta_path):
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+            out["total_tokens"] = meta.get("total_tokens")
+            ckpts = meta.get("checkpoints", [])
+            out["num_checkpoints"] = len(ckpts)
+            val = meta.get("validation", {})
+            if val.get("losses"):
+                out["best_val_loss"] = min(val["losses"])
+                out["final_val_loss"] = val["losses"][-1]
+        except (json.JSONDecodeError, OSError):
+            pass
+
+    ckpt_dir = os.path.join(run_dir, "checkpoints")
+    if os.path.isdir(ckpt_dir):
+        files = sorted(os.listdir(ckpt_dir))
+        out["checkpoint_files"] = len(files)
+        out["checkpoint_bytes"] = sum(
+            os.path.getsize(os.path.join(ckpt_dir, f)) for f in files)
+
+    log_path = os.path.join(run_dir, "log.txt")
+    if os.path.isfile(log_path):
+        from ..obs.plotting import parse_log
+
+        steps, metrics = parse_log(log_path)
+        if steps:
+            out["last_step"] = steps[-1]
+            out["last_loss"] = metrics["loss"][-1]
+            if metrics.get("tok/s"):
+                ts = [t for t in metrics["tok/s"] if t is not None]
+                if ts:
+                    out["mean_tok_s"] = sum(ts) / len(ts)
+    return out
+
+
+def print_summary(s: Dict[str, Any]) -> None:
+    print(f"== {s.get('name')} ({s.get('run_dir')}) ==")
+    order = ["architecture", "hidden_size", "num_layers", "num_heads", "optimizer",
+             "batch_size", "iters", "last_step", "last_loss", "mean_tok_s",
+             "best_val_loss", "final_val_loss", "total_tokens",
+             "num_checkpoints", "checkpoint_files", "checkpoint_bytes"]
+    for k in order:
+        if s.get(k) is not None:
+            v = s[k]
+            if isinstance(v, float):
+                v = f"{v:.4f}"
+            print(f"  {k:>18}: {v}")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="Inspect trained runs")
+    parser.add_argument("run", nargs="?", default=None, help="run name (omit to list all)")
+    parser.add_argument("--runs-root", default="runs")
+    parser.add_argument("--json", action="store_true")
+    a = parser.parse_args(argv)
+
+    if a.run is None:
+        runs = list_runs(a.runs_root)
+        if a.json:
+            print(json.dumps(runs))
+        else:
+            for r in runs:
+                print(r)
+        return runs
+
+    run_dir = a.run if os.path.isdir(a.run) else os.path.join(a.runs_root, a.run)
+    s = run_summary(run_dir)
+    if a.json:
+        print(json.dumps(s, indent=2))
+    else:
+        print_summary(s)
+    return s
+
+
+if __name__ == "__main__":
+    main()

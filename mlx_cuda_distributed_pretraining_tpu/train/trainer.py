@@ -156,6 +156,17 @@ class Trainer:
         if self.mesh is not None and self.state_shardings is not None:
             self.state = jax.device_put(self.state, self.state_shardings)
 
+        # optional live stats publishing (obs/stats_server.py hub)
+        self.stats_client = None
+        if for_training and cfg.logging.stats_url:
+            from ..obs.stats_client import StatsClient
+
+            self.stats_client = StatsClient(
+                cfg.logging.stats_url,
+                worker_id=f"{cfg.name}-p{jax.process_index()}",
+            ).start()
+            self.stats_client.register({"devices": jax.local_device_count()})
+
         self.early_stopping = EarlyStoppingMonitor.from_config(cfg.training)
         self.total_tokens = 0
         self.start_step = 0
@@ -339,6 +350,8 @@ class Trainer:
                 if int(metrics["nonfinite"]):
                     self.logger.log(f"WARNING: non-finite loss at step {step}")
                 self.logger.log_metrics(step, line)
+                if self.stats_client is not None:
+                    self.stats_client.log_metrics(step, line)
                 window_tokens = 0
                 window_start = time.perf_counter()
 
@@ -373,6 +386,8 @@ class Trainer:
         self.save_checkpoint("final")
         if hasattr(self.data, "stop"):
             self.data.stop()  # streaming sources run a prefetch thread
+        if self.stats_client is not None:
+            self.stats_client.close()
         self.logger.log("Training complete")
         self.logger.close()
         return {"final_loss": last_loss, "final_val_loss": final_val, "steps": step}

@@ -1,0 +1,168 @@
+"""Observability tests: log parsing/plotting, monitor tailer, stats
+server/client round trip (reference capabilities: utils/plotting.py,
+monitor_training.py, stats_server.py, stats_client.py)."""
+
+import asyncio
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from mlx_cuda_distributed_pretraining_tpu.obs import (
+    LogTailer,
+    StatsClient,
+    StatsServer,
+    StatsState,
+    ema,
+    find_latest_run,
+    parse_log,
+    plot_run,
+)
+
+LOG = """[2026-01-01 00:00:00] Model: 1,000 parameters (0.00M)
+Step 0 validation: val_loss=5.5000
+Step 5: loss=4.0000 | ppl=54.5982 | lr=1.000e-02 | tok/s=1000.0 | toks=320
+Step 10: loss=3.0000 | ppl=20.0855 | lr=9.000e-03 | grad_norm=0.5000 | tok/s=2000.0 | toks=320
+Step 10 validation: val_loss=3.2000
+[2026-01-01 00:01:00] Saved checkpoint at step 10
+"""
+
+
+def _write_log(tmp_path, text=LOG, name="r1"):
+    run = tmp_path / name
+    os.makedirs(run, exist_ok=True)
+    with open(run / "log.txt", "w") as f:
+        f.write(text)
+    return str(run)
+
+
+def test_parse_log(tmp_path):
+    run = _write_log(tmp_path)
+    steps, metrics = parse_log(os.path.join(run, "log.txt"))
+    assert steps == [5, 10]
+    assert metrics["loss"] == [4.0, 3.0]
+    assert metrics["grad_norm"] == [None, 0.5]
+    assert metrics["_val_steps"] == [0, 10]
+    assert metrics["_val_losses"] == [5.5, 3.2]
+    assert metrics["val_loss"] == [None, 3.2]
+
+
+def test_ema_smoothing():
+    vals = [10.0, None, 0.0]
+    sm = ema(vals, alpha=0.5)
+    assert sm[0] == 10.0 and sm[1] is None and sm[2] == 5.0
+
+
+def test_plot_run_writes_csv_and_png(tmp_path):
+    run = _write_log(tmp_path)
+    out = plot_run(run)
+    csv_path = os.path.join(run, "metrics.csv")
+    assert os.path.isfile(csv_path)
+    lines = open(csv_path).read().strip().splitlines()
+    assert lines[0].startswith("step,")
+    assert len(lines) == 3
+    if out is not None:  # matplotlib available
+        assert os.path.isfile(out)
+
+
+def test_log_tailer_incremental(tmp_path):
+    run = _write_log(tmp_path, text="")
+    tailer = LogTailer(os.path.join(run, "log.txt"))
+    assert tailer.poll() == 0
+    with open(os.path.join(run, "log.txt"), "a") as f:
+        f.write("Step 5: loss=4.0000 | ppl=54.5982 | lr=1.000e-02 | tok/s=10.0 | toks=32\n")
+    assert tailer.poll() == 1
+    assert tailer.latest["loss"] == 4.0
+    with open(os.path.join(run, "log.txt"), "a") as f:
+        f.write("Step 10 validation: val_loss=3.5000\n")
+    tailer.poll()
+    assert tailer.val_losses == [3.5]
+    assert "val_loss=3.5000@10" in tailer.status_line()
+
+
+def test_find_latest_run(tmp_path):
+    _write_log(tmp_path, name="old")
+    time.sleep(0.02)
+    new = _write_log(tmp_path, name="new")
+    assert find_latest_run(str(tmp_path)) == new
+
+
+def test_stats_state_aggregation():
+    st = StatsState()
+    assert st.handle({"type": "register", "worker_id": "w0", "capabilities": {"devices": 4}})
+    st.handle({"type": "metrics", "worker_id": "w0", "step": 5,
+               "data": {"loss": 2.0, "tok/s": 100.0}})
+    st.handle({"type": "metrics", "worker_id": "w1", "step": 7,
+               "data": {"loss": 4.0, "tok/s": 300.0}})
+    agg = st.aggregated()
+    assert agg["num_workers"] == 2
+    assert agg["mean_loss"] == 3.0
+    assert agg["total_tok_s"] == 400.0
+    assert agg["max_step"] == 7
+    snap = st.snapshot()
+    assert snap["type"] == "initial_state"
+    assert len(snap["history"]) == 2
+
+
+def test_stats_state_history_ring():
+    st = StatsState(history_limit=10)
+    for i in range(25):
+        st.handle({"type": "metrics", "worker_id": "w", "step": i, "data": {"loss": float(i)}})
+    assert len(st.history) == 10
+    assert st.history[-1]["step"] == 24
+
+
+@pytest.mark.skipif(
+    not pytest.importorskip("websockets", reason="websockets unavailable"),
+    reason="websockets unavailable")
+def test_stats_server_client_roundtrip(tmp_path):
+    """Full wire test: server hub + background client, metrics land in
+    state and persistence file."""
+    persist = str(tmp_path / "stats.json")
+    server = StatsServer(host="127.0.0.1", port=18765, persist_path=persist)
+
+    loop_holder = {}
+
+    def run_server():
+        loop = asyncio.new_event_loop()
+        loop_holder["loop"] = loop
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(server.serve())
+
+    t = threading.Thread(target=run_server, daemon=True)
+    t.start()
+    time.sleep(0.5)
+
+    client = StatsClient("ws://127.0.0.1:18765", "worker-a",
+                         heartbeat_interval=0.5).start()
+    client.register({"devices": 8})
+    for step in range(3):
+        client.log_metrics(step, {"loss": 3.0 - step, "tok/s": 1000.0})
+    deadline = time.time() + 5
+    while time.time() < deadline and server.state.workers.get("worker-a", {}).get("step") != 2:
+        time.sleep(0.05)
+    client.close()
+
+    w = server.state.workers.get("worker-a")
+    assert w is not None, "client messages never reached the server"
+    assert w["step"] == 2
+    assert w["metrics"]["loss"] == 1.0
+    server.persist()
+    with open(persist) as f:
+        saved = json.load(f)
+    assert saved["workers"]["worker-a"]["metrics"]["tok/s"] == 1000.0
+
+    loop_holder["loop"].call_soon_threadsafe(server.stop)
+    t.join(timeout=5)
+
+
+def test_stats_client_offline_buffering():
+    """Messages sent while no server exists are buffered, not lost/crashy."""
+    client = StatsClient("ws://127.0.0.1:19999", "w", reconnect_delay=0.1).start()
+    for i in range(5):
+        client.log_metrics(i, {"loss": 1.0})
+    time.sleep(0.5)
+    client.close()
+    assert len(client._buffer) == 5

@@ -1,0 +1,214 @@
+"""Tools tests: tokenizer training, HF export, run inspection, data prep
+(reference capabilities: tools/train-tokenizer.py, tools/convert-to-mlx-lm.py,
+tools/visualize_model.py, tools/model_cli.py, prepare_data_a100.py,
+examine.py, find_data.py)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mlx_cuda_distributed_pretraining_tpu.config import Config
+from mlx_cuda_distributed_pretraining_tpu.tools import (
+    convert_to_hf,
+    inspect_data,
+    prepare_data,
+    train_tokenizer,
+    visualize_model,
+)
+
+
+def _write_jsonl(path, texts):
+    with open(path, "w") as f:
+        for t in texts:
+            f.write(json.dumps({"text": t}) + "\n")
+
+
+@pytest.fixture(scope="module")
+def trained_run(tmp_path_factory):
+    """One tiny trained run shared by export/inspect/CLI tests."""
+    from mlx_cuda_distributed_pretraining_tpu.train.trainer import Trainer
+
+    tmp = tmp_path_factory.mktemp("toolrun")
+    train = tmp / "train.jsonl"
+    _write_jsonl(train, ["the quick brown fox jumps over the lazy dog " * 3] * 30)
+    cfg = Config.from_dict({
+        "name": "tooltest",
+        "overwrite": True,
+        "data": {
+            "input_file": str(train),
+            "validation_file": str(train),
+            "preprocessing": {"max_context_size": 48},
+            "tokenizer": {"normal_vocab_size": 256},
+        },
+        "model": {
+            "architecture": "llama",
+            "dimensions": {"hidden_size": 32, "intermediate_size": 64, "num_layers": 2},
+            "attention": {"num_heads": 4, "num_kv_heads": 2, "head_dim": 8},
+            "misc": {"tie_word_embeddings": False},
+        },
+        "training": {
+            "hyperparameters": {"batch_size": 4, "learning_rate": 1e-2, "iters": 10},
+            "optimization": {"optimizer": "adamw"},
+        },
+        "logging": {
+            "steps": {"logging_interval": 5, "checkpoint_interval": 0, "validation_interval": 5},
+        },
+        "system": {"seed": 0},
+    })
+    tr = Trainer(cfg, runs_root=str(tmp / "runs"), quiet=True)
+    tr.train()
+    return tr.run_dir
+
+
+def test_train_tokenizer(tmp_path):
+    corpus = tmp_path / "c.jsonl"
+    _write_jsonl(corpus, ["hello world, this is a corpus of words"] * 50)
+    out = train_tokenizer.train_tokenizer([str(corpus)], str(tmp_path / "tok"), vocab_size=300)
+    assert os.path.isfile(out)
+    from tokenizers import Tokenizer
+
+    tok = Tokenizer.from_file(out)
+    ids = tok.encode("hello world", add_special_tokens=False).ids
+    assert len(ids) > 0
+    assert tok.token_to_id("<pad>") is not None
+    assert tok.decode(ids).replace(" ", "") == "helloworld".replace(" ", "")
+
+
+def test_tokenizer_roundtrip_into_manager(tmp_path):
+    from mlx_cuda_distributed_pretraining_tpu.config import DataConfig
+    from mlx_cuda_distributed_pretraining_tpu.tokenizer import TokenizerManager
+
+    corpus = tmp_path / "c.jsonl"
+    _write_jsonl(corpus, ["some words to learn merges from"] * 40)
+    out_dir = tmp_path / "tok"
+    train_tokenizer.train_tokenizer([str(corpus)], str(out_dir), vocab_size=280)
+    mgr = TokenizerManager(DataConfig(tokenizer_path=str(out_dir)))
+    ids = mgr.tokenize("some words")
+    assert mgr.detokenize(ids).strip() == "some words"
+    assert mgr.pad_id != mgr.eos_id
+
+
+def test_convert_to_hf(trained_run, tmp_path):
+    out = convert_to_hf.convert_run(trained_run, str(tmp_path / "export"))
+    assert os.path.isfile(os.path.join(out, "model.safetensors"))
+    with open(os.path.join(out, "config.json")) as f:
+        cfg = json.load(f)
+    assert cfg["architectures"] == ["LlamaForCausalLM"]
+    assert cfg["hidden_size"] == 32
+    assert cfg["num_key_value_heads"] == 2
+
+    from mlx_cuda_distributed_pretraining_tpu.checkpoint.safetensors_io import load_safetensors
+
+    tensors, meta = load_safetensors(os.path.join(out, "model.safetensors"))
+    assert "model.embed_tokens.weight" in tensors
+    assert "lm_head.weight" in tensors  # untied in this run
+    # HF layout is [out, in]: q_proj out dim = num_heads*head_dim = 32
+    q = tensors["model.layers.0.self_attn.q_proj.weight"]
+    assert q.shape == (32, 32)
+    emb = tensors["model.embed_tokens.weight"]
+    assert emb.shape[0] == cfg["vocab_size"]
+
+
+def test_hf_export_logits_match(trained_run, tmp_path):
+    """The exported HF state dict must describe the same function: check a
+    manual forward with HF-layout weights equals our model's logits."""
+    import jax.numpy as jnp
+
+    from mlx_cuda_distributed_pretraining_tpu.models import llama
+    from mlx_cuda_distributed_pretraining_tpu.train.trainer import load_trained
+
+    params, args, tok, _ = load_trained(trained_run)
+    sd = convert_to_hf.hf_state_dict(params, args.tie_word_embeddings)
+
+    x = np.array([[1, 5, 9, 7]], dtype=np.int32)
+    ours, _ = llama.forward(params, jnp.asarray(x), args)
+
+    # Rebuild our param tree from the HF dict (transpose back) and re-run.
+    rebuilt = {
+        "tok_embeddings": {"weight": jnp.asarray(sd["model.embed_tokens.weight"])},
+        "norm": {"weight": jnp.asarray(sd["model.norm.weight"])},
+        "layers": [],
+    }
+    for i in range(args.num_layers):
+        pre = f"model.layers.{i}"
+        rebuilt["layers"].append({
+            "attention_norm": {"weight": jnp.asarray(sd[f"{pre}.input_layernorm.weight"])},
+            "ffn_norm": {"weight": jnp.asarray(sd[f"{pre}.post_attention_layernorm.weight"])},
+            "attention": {
+                "wq": {"weight": jnp.asarray(sd[f"{pre}.self_attn.q_proj.weight"].T)},
+                "wk": {"weight": jnp.asarray(sd[f"{pre}.self_attn.k_proj.weight"].T)},
+                "wv": {"weight": jnp.asarray(sd[f"{pre}.self_attn.v_proj.weight"].T)},
+                "wo": {"weight": jnp.asarray(sd[f"{pre}.self_attn.o_proj.weight"].T)},
+            },
+            "feed_forward": {
+                "w_gate": {"weight": jnp.asarray(sd[f"{pre}.mlp.gate_proj.weight"].T)},
+                "w_up": {"weight": jnp.asarray(sd[f"{pre}.mlp.up_proj.weight"].T)},
+                "w_down": {"weight": jnp.asarray(sd[f"{pre}.mlp.down_proj.weight"].T)},
+            },
+        })
+    if "lm_head.weight" in sd:
+        rebuilt["output"] = {"weight": jnp.asarray(sd["lm_head.weight"].T)}
+    theirs, _ = llama.forward(rebuilt, jnp.asarray(x), args)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(theirs), rtol=1e-5, atol=1e-5)
+
+
+def test_visualize_model(trained_run, capsys):
+    s = visualize_model.run_summary(trained_run)
+    assert s["architecture"] == "llama"
+    assert s["last_step"] == 10
+    assert s["final_val_loss"] is not None
+    visualize_model.print_summary(s)
+    out = capsys.readouterr().out
+    assert "tooltest" in out
+
+    runs_root = os.path.dirname(trained_run)
+    assert "tooltest" in visualize_model.list_runs(runs_root)
+
+
+def test_model_cli(trained_run, capsys):
+    from mlx_cuda_distributed_pretraining_tpu.tools.model_cli import ModelCLI
+
+    cli = ModelCLI(runs_root=os.path.dirname(trained_run))
+    cli.cmd_list()
+    assert "tooltest" in capsys.readouterr().out
+    cli.dispatch("load tooltest")
+    cli.max_tokens = 8
+    text = cli.cmd_generate("the quick")
+    assert isinstance(text, str)
+    assert cli.dispatch("quit") is False
+
+
+def test_prepare_data(tmp_path):
+    src = tmp_path / "src.jsonl"
+    _write_jsonl(src, [f"document number {i}" for i in range(200)])
+    train_p, val_p = prepare_data.prepare_split(
+        str(src), str(tmp_path / "out"), val_fraction=0.1, seed=0)
+    n_train = sum(1 for _ in open(train_p))
+    n_val = sum(1 for _ in open(val_p))
+    assert n_train + n_val == 200
+    assert 5 <= n_val <= 40  # ~10%
+    good, bad = prepare_data.validate_jsonl(train_p)
+    assert good == n_train and bad == 0
+
+
+def test_validate_jsonl_catches_bad(tmp_path):
+    p = tmp_path / "bad.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"text": "ok"}) + "\n")
+        f.write("not json\n")
+        f.write(json.dumps({"notext": 1}) + "\n")
+    good, bad = prepare_data.validate_jsonl(str(p))
+    assert good == 1 and bad == 2
+
+
+def test_inspect_data(tmp_path):
+    p = tmp_path / "c.jsonl"
+    _write_jsonl(p, ["abc", "defgh"])
+    stats = inspect_data.examine_file(str(p), count_tokens=True)
+    assert stats["docs"] == 2
+    assert stats["chars"] == 8
+    assert stats["byte_tokens"] == 8 + 4  # bytes + BOS/EOS per doc
+    files = inspect_data.find_data_files(str(tmp_path), min_bytes=1)
+    assert any(f["path"].endswith("c.jsonl") for f in files)
