@@ -1,0 +1,97 @@
+"""Optimizer comparison harness: same model/data/seed, one run per
+optimizer, machine-readable results.
+
+The reference ships only result PNGs (optimizer_comparison.png, no
+numbers — SURVEY.md §6); this produces a CSV of per-step losses and a
+JSON summary per optimizer so comparisons are reproducible.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+DEFAULT_OPTIMIZERS = ["adamw", "sgd", "lion", "muon", "shampoo", "hybrid"]
+
+
+def compare(
+    base_config: Dict[str, Any],
+    optimizers: List[str],
+    runs_root: str,
+    iters: Optional[int] = None,
+) -> Dict[str, Dict[str, Any]]:
+    """Train one run per optimizer from the same base config; returns
+    {optimizer: {final_loss, final_val_loss, losses, steps}}."""
+    from ..config import Config
+    from ..obs.plotting import parse_log
+    from ..train.trainer import Trainer
+
+    results: Dict[str, Dict[str, Any]] = {}
+    for opt in optimizers:
+        cfg_dict = json.loads(json.dumps(base_config))  # deep copy
+        cfg_dict["name"] = f"{cfg_dict.get('name', 'optcmp')}-{opt}"
+        cfg_dict["overwrite"] = True
+        cfg_dict.setdefault("training", {}).setdefault("optimization", {})["optimizer"] = opt
+        if iters:
+            cfg_dict["training"].setdefault("hyperparameters", {})["iters"] = iters
+        cfg = Config.from_dict(cfg_dict)
+        trainer = Trainer(cfg, runs_root=runs_root, quiet=True)
+        out = trainer.train()
+        steps, metrics = parse_log(os.path.join(trainer.run_dir, "log.txt"))
+        results[opt] = {
+            "final_loss": out["final_loss"],
+            "final_val_loss": out["final_val_loss"],
+            "steps": steps,
+            "losses": metrics.get("loss", []),
+        }
+    return results
+
+
+def write_outputs(results: Dict[str, Dict[str, Any]], out_dir: str) -> str:
+    os.makedirs(out_dir, exist_ok=True)
+    csv_path = os.path.join(out_dir, "optimizer_comparison.csv")
+    names = list(results)
+    all_steps = sorted({s for r in results.values() for s in r["steps"]})
+    by_opt = {n: dict(zip(results[n]["steps"], results[n]["losses"])) for n in names}
+    with open(csv_path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["step"] + names)
+        for s in all_steps:
+            w.writerow([s] + [by_opt[n].get(s) for n in names])
+    summary = {
+        n: {"final_loss": r["final_loss"], "final_val_loss": r["final_val_loss"]}
+        for n, r in results.items()
+    }
+    with open(os.path.join(out_dir, "optimizer_comparison.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    return csv_path
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="Compare optimizers on one config")
+    parser.add_argument("--config", required=True, help="base YAML config")
+    parser.add_argument("--optimizers", nargs="*", default=DEFAULT_OPTIMIZERS)
+    parser.add_argument("--iters", type=int, default=None, help="override steps per run")
+    parser.add_argument("--runs-root", default="runs")
+    parser.add_argument("--out-dir", default="optimizer_comparison")
+    a = parser.parse_args(argv)
+
+    import yaml
+
+    with open(a.config) as f:
+        base = yaml.safe_load(f)
+    results = compare(base, a.optimizers, a.runs_root, a.iters)
+    csv_path = write_outputs(results, a.out_dir)
+    print(f"Wrote {csv_path}")
+    for n, r in results.items():
+        val = r["final_val_loss"]
+        print(f"  {n:>10}: final_loss={r['final_loss']:.4f}"
+              + (f" val_loss={val:.4f}" if val is not None else ""))
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Optimizer comparison sweep on one config (replaces the reference's
+# optimizer_comparison.png with reproducible CSV/JSON numbers).
+set -euo pipefail
+CONFIG="${1:?usage: run_compare_optimizers.sh <config.yaml> [iters]}"
+ITERS="${2:-}"
+ARGS=(--config "$CONFIG")
+[ -n "$ITERS" ] && ARGS+=(--iters "$ITERS")
+exec python -m mlx_cuda_distributed_pretraining_tpu.tools.compare_optimizers "${ARGS[@]}"

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Multi-host TPU pod launcher: start the SAME SPMD program on every host of
+# a pod slice. This replaces the reference's Modal/coordinator-worker cloud
+# path (reference: train_a100.py, distributed/worker.py) with the TPU-native
+# model — jax.distributed.initialize auto-detects pod topology on each host.
+#
+# On a Cloud TPU pod slice (run from your workstation):
+#   scripts/run_pod.sh <tpu-name> <zone> <config.yaml>
+# On each pod host directly (e.g. under a different scheduler), just run:
+#   python -m mlx_cuda_distributed_pretraining_tpu.parallel.launch --config <config.yaml>
+set -euo pipefail
+
+TPU_NAME="${1:?usage: run_pod.sh <tpu-name> <zone> <config.yaml>}"
+ZONE="${2:?usage: run_pod.sh <tpu-name> <zone> <config.yaml>}"
+CONFIG="${3:?usage: run_pod.sh <tpu-name> <zone> <config.yaml>}"
+REPO_DIR="${REPO_DIR:-$(basename "$(pwd)")}"
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+  --command "cd $REPO_DIR && python -m mlx_cuda_distributed_pretraining_tpu.parallel.launch --config $CONFIG"

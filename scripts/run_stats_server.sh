@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Start the WebSocket stats hub for multi-worker runs (reference:
+# stats_server.py). Workers publish when logging.metrics.stats_url is set.
+set -euo pipefail
+HOST="${1:-127.0.0.1}"
+PORT="${2:-8765}"
+PERSIST="${3:-stats.json}"
+exec python -m mlx_cuda_distributed_pretraining_tpu.obs.stats_server \
+  --host "$HOST" --port "$PORT" --persist "$PERSIST"

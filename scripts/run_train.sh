@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Launch a training run in the background with PID file + monitor, the way
+# the reference's scripts/run_*.sh wrappers do (reference:
+# scripts/run_hybrid_distributed.sh starts training + a status poll loop).
+#
+# Usage: scripts/run_train.sh <config.yaml> [runs_root]
+set -euo pipefail
+
+CONFIG="${1:?usage: run_train.sh <config.yaml> [runs_root]}"
+RUNS_ROOT="${2:-runs}"
+NAME="$(python - "$CONFIG" <<'EOF'
+import sys, yaml
+print(yaml.safe_load(open(sys.argv[1]))["name"])
+EOF
+)"
+
+mkdir -p "$RUNS_ROOT"
+LOG="$RUNS_ROOT/$NAME.launch.log"
+
+nohup python -m mlx_cuda_distributed_pretraining_tpu.train.trainer \
+  --config "$CONFIG" --runs-root "$RUNS_ROOT" >"$LOG" 2>&1 &
+PID=$!
+echo "$PID" > "$RUNS_ROOT/$NAME.pid"
+echo "training started: pid=$PID config=$CONFIG log=$LOG"
+echo "monitor with: python -m mlx_cuda_distributed_pretraining_tpu.obs.monitor $NAME --runs-root $RUNS_ROOT"

@@ -212,3 +212,39 @@ def test_inspect_data(tmp_path):
     assert stats["byte_tokens"] == 8 + 4  # bytes + BOS/EOS per doc
     files = inspect_data.find_data_files(str(tmp_path), min_bytes=1)
     assert any(f["path"].endswith("c.jsonl") for f in files)
+
+
+def test_compare_optimizers(tmp_path):
+    from mlx_cuda_distributed_pretraining_tpu.tools import compare_optimizers
+
+    train = tmp_path / "train.jsonl"
+    _write_jsonl(train, ["the quick brown fox jumps over the lazy dog " * 3] * 30)
+    base = {
+        "name": "cmp",
+        "data": {
+            "input_file": str(train),
+            "preprocessing": {"max_context_size": 32},
+            "tokenizer": {"normal_vocab_size": 256},
+        },
+        "model": {
+            "architecture": "llama",
+            "dimensions": {"hidden_size": 32, "intermediate_size": 64, "num_layers": 1},
+            "attention": {"num_heads": 4, "num_kv_heads": 2, "head_dim": 8},
+        },
+        "training": {
+            "hyperparameters": {"batch_size": 4, "learning_rate": 1e-2, "iters": 6},
+            "optimization": {"optimizer": "adamw"},
+        },
+        "logging": {"steps": {"logging_interval": 2, "checkpoint_interval": 0,
+                              "validation_interval": 0}},
+        "system": {"seed": 0},
+    }
+    results = compare_optimizers.compare(
+        base, ["adamw", "muon"], str(tmp_path / "runs"), iters=6)
+    assert set(results) == {"adamw", "muon"}
+    for r in results.values():
+        assert np.isfinite(r["final_loss"])
+        assert len(r["steps"]) == 3
+    csv_path = compare_optimizers.write_outputs(results, str(tmp_path / "out"))
+    header = open(csv_path).readline().strip().split(",")
+    assert header == ["step", "adamw", "muon"]
