@@ -106,14 +106,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_kv,
                 mask_fn, score_fn, kv_lo, kv_hi):
     qi = pl.program_id(2)
     h = pl.program_id(1)
-    q = q_ref[0, 0].astype(jnp.float32)
+    # Matmul operands stay in their storage dtype (bf16 in training) so the
+    # MXU runs at full rate; accumulation is fp32 via preferred_element_type.
+    q = q_ref[0, 0]
     bq, d = q.shape
     row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_kv), 0)
 
     def body(j, carry):
         m, l, acc = carry
-        k = k_ref[0, 0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        k = k_ref[0, 0, pl.ds(j * block_kv, block_kv), :]
+        v = v_ref[0, 0, pl.ds(j * block_kv, block_kv), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         col = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, (bq, block_kv), 1)
@@ -126,7 +128,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_kv,
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
     m0 = jnp.full((bq,), NEG_INF, jnp.float32)
@@ -135,7 +138,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_kv,
     m, l, acc = jax.lax.fori_loop(kv_lo(qi), kv_hi(qi), body, (m0, l0, acc0))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0, 0] = (m + jnp.log(l_safe)).astype(lse_ref.dtype)
+    # lse is laid out [B, H, 1, Sq]: the singleton dim keeps the block's
+    # second-to-last dim equal to the array dim, satisfying TPU (8, 128)
+    # tiling without padding lse out to 128 lanes.
+    lse_ref[0, 0, 0] = (m + jnp.log(l_safe)).astype(lse_ref.dtype)
 
 
 # -- backward kernels --------------------------------------------------------
@@ -143,16 +149,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                    scale, block_kv, mask_fn, score_fn, kv_lo, kv_hi):
     qi = pl.program_id(2)
     h = pl.program_id(1)
-    q = q_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0].astype(jnp.float32)
-    delta = delta_ref[0, 0].astype(jnp.float32)
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0, 0].astype(jnp.float32)
+    delta = delta_ref[0, 0, 0].astype(jnp.float32)
     bq, d = q.shape
     row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_kv), 0)
 
     def body(j, dq):
-        k = k_ref[0, 0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        k = k_ref[0, 0, pl.ds(j * block_kv, block_kv), :]
+        v = v_ref[0, 0, pl.ds(j * block_kv, block_kv), :]
         s_raw = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32) * scale
         col = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, (bq, block_kv), 1)
@@ -167,7 +173,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         if d_mod is not None:  # non-additive score mod: chain through its Jacobian
             ds = ds * d_mod(s_raw, row, col, h)
         ds = ds * scale
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+        return dq + jax.lax.dot_general(ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(kv_lo(qi), kv_hi(qi), body, jnp.zeros((bq, d), jnp.float32))
@@ -178,17 +184,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
                     scale, block_q, mask_fn, score_fn, q_lo, q_hi):
     ki = pl.program_id(2)
     h = pl.program_id(1)
-    k = k_ref[0, 0].astype(jnp.float32)
-    v = v_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
     bkv, d = k.shape
     col = ki * bkv + jax.lax.broadcasted_iota(jnp.int32, (block_q, bkv), 1)
 
     def body(j, carry):
         dk, dv = carry
-        q = q_ref[0, 0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, 0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(j * block_q, block_q)].astype(jnp.float32)
-        delta = delta_ref[0, 0, pl.ds(j * block_q, block_q)].astype(jnp.float32)
+        q = q_ref[0, 0, pl.ds(j * block_q, block_q), :]
+        do = do_ref[0, 0, pl.ds(j * block_q, block_q), :]
+        lse = lse_ref[0, 0, 0, pl.ds(j * block_q, block_q)].astype(jnp.float32)
+        delta = delta_ref[0, 0, 0, pl.ds(j * block_q, block_q)].astype(jnp.float32)
         s_raw = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32) * scale
         row = j * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bkv), 0)
@@ -196,7 +202,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         if mask_fn is not None:
             s = jnp.where(mask_fn(row, col), s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        dv = dv + jax.lax.dot_general(p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -205,7 +211,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         if d_mod is not None:
             ds = ds * d_mod(s_raw, row, col, h)
         ds = ds * scale
-        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+        dk = dk + jax.lax.dot_general(ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         return dk, dv
 
@@ -254,11 +260,11 @@ def _attention_core(
             ],
             out_specs=[
                 _vmem_spec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
-                _vmem_spec((1, 1, bq), lambda b, h, i: (b, h, i)),
+                _vmem_spec((1, 1, 1, bq), lambda b, h, i: (b, h, 0, i)),
             ],
             out_shape=[
                 jax.ShapeDtypeStruct((B, Hq, Sq, D), q.dtype),
-                jax.ShapeDtypeStruct((B, Hq, Sq), jnp.float32),
+                jax.ShapeDtypeStruct((B, Hq, 1, Sq), jnp.float32),
             ],
             interpret=_interpret(),
         )(q, k, v)
@@ -273,7 +279,8 @@ def _attention_core(
         bkv = min(block_kv, Skv)
         nq = Sq // bq
         nkv = Skv // bkv
-        delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [B,Hq,Sq]
+        delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1)[:, :, None, :]  # [B,Hq,1,Sq], lse layout
 
         kv_lo, kv_hi = _kv_range(mask_type, window, prefix_len, bq, bkv, nkv)
         dq = pl.pallas_call(
@@ -286,8 +293,8 @@ def _attention_core(
                 _vmem_spec((1, 1, Skv, D), lambda b, h, i: (b, h // G, 0, 0)),
                 _vmem_spec((1, 1, Skv, D), lambda b, h, i: (b, h // G, 0, 0)),
                 _vmem_spec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
-                _vmem_spec((1, 1, bq), lambda b, h, i: (b, h, i)),
-                _vmem_spec((1, 1, bq), lambda b, h, i: (b, h, i)),
+                _vmem_spec((1, 1, 1, bq), lambda b, h, i: (b, h, 0, i)),
+                _vmem_spec((1, 1, 1, bq), lambda b, h, i: (b, h, 0, i)),
             ],
             out_specs=_vmem_spec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
             out_shape=jax.ShapeDtypeStruct((B, Hq, Sq, D), q.dtype),
@@ -305,8 +312,8 @@ def _attention_core(
                 _vmem_spec((1, 1, bkv, D), lambda b, h, i: (b, h // G, i, 0)),
                 _vmem_spec((1, 1, bkv, D), lambda b, h, i: (b, h // G, i, 0)),
                 _vmem_spec((1, 1, Sq, D), lambda b, h, i: (b, h, 0, 0)),
-                _vmem_spec((1, 1, Sq), lambda b, h, i: (b, h, 0)),
-                _vmem_spec((1, 1, Sq), lambda b, h, i: (b, h, 0)),
+                _vmem_spec((1, 1, 1, Sq), lambda b, h, i: (b, h, 0, 0)),
+                _vmem_spec((1, 1, 1, Sq), lambda b, h, i: (b, h, 0, 0)),
             ],
             out_specs=[
                 _vmem_spec((1, 1, bkv, D), lambda b, h, i: (b, h, i, 0)),
@@ -344,8 +351,8 @@ def flash_attention(
     window_size: int = 512,
     prefix_len: int = 0,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_kv: int = 128,
+    block_q: int = 512,
+    block_kv: int = 1024,
     mask_fn: Optional[Callable] = None,
     score_fn: Optional[Callable] = None,
 ) -> jnp.ndarray:
@@ -359,6 +366,17 @@ def flash_attention(
     B, Sq, Hq, D = q.shape
     _, Skv, Hkv, _ = k.shape
     scale = (D ** -0.5) if scale is None else scale
+
+    def _fit(block, dim):
+        # Largest power-of-two block <= requested that divides the sequence,
+        # so e.g. Sq=768 tiles at 256 instead of falling off to the O(S^2)
+        # reference path. 128 is the TPU lane width / minimum tile.
+        while block > 128 and dim % block:
+            block //= 2
+        return min(block, dim)
+
+    block_q = _fit(block_q, Sq)
+    block_kv = _fit(block_kv, Skv)
 
     from . import masks as M
 

@@ -80,8 +80,8 @@ def flex_attention(
     mask_mod: Optional[Callable] = None,
     score_mod: Optional[KernelScoreMod] = None,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_kv: int = 128,
+    block_q: int = 512,
+    block_kv: int = 1024,
 ) -> jnp.ndarray:
     """[B, S, H, D] layout. ``mask_mod(q_idx, kv_idx) -> bool`` (True =
     attend); ``score_mod(scores, q_idx, kv_idx, head)``."""

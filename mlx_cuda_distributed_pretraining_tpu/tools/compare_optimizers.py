@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import argparse
 import csv
+import copy
 import json
 import os
 from typing import Any, Dict, List, Optional
@@ -31,7 +32,7 @@ def compare(
 
     results: Dict[str, Dict[str, Any]] = {}
     for opt in optimizers:
-        cfg_dict = json.loads(json.dumps(base_config))  # deep copy
+        cfg_dict = copy.deepcopy(base_config)
         cfg_dict["name"] = f"{cfg_dict.get('name', 'optcmp')}-{opt}"
         cfg_dict["overwrite"] = True
         cfg_dict.setdefault("training", {}).setdefault("optimization", {})["optimizer"] = opt

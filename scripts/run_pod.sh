@@ -13,7 +13,9 @@ set -euo pipefail
 TPU_NAME="${1:?usage: run_pod.sh <tpu-name> <zone> <config.yaml>}"
 ZONE="${2:?usage: run_pod.sh <tpu-name> <zone> <config.yaml>}"
 CONFIG="${3:?usage: run_pod.sh <tpu-name> <zone> <config.yaml>}"
+# Where the repo lives on each pod host; override with REPO_DIR=... if the
+# checkout is not at $HOME/<local-dir-name>.
 REPO_DIR="${REPO_DIR:-$(basename "$(pwd)")}"
 
 gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
-  --command "cd $REPO_DIR && python -m mlx_cuda_distributed_pretraining_tpu.parallel.launch --config $CONFIG"
+  --command "cd '$REPO_DIR' && python -m mlx_cuda_distributed_pretraining_tpu.parallel.launch --config '$CONFIG'"
