@@ -58,10 +58,18 @@ class LlamaArgs:
     prefix_len: int = 0
     score_mod_type: Optional[str] = None  # None | alibi | soft_cap
     soft_cap: float = 50.0
-    # MoE fields accepted for config compatibility (reference declares but
-    # never uses them: models/llama.py:40-41); a real MoE block keys off them.
+    # MoE (reference declares these fields but never uses them:
+    # models/llama.py:40-41; here they drive a real block — models/moe.py).
     num_local_experts: int = 0
     num_experts_per_tok: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    router_z_weight: float = 0.0
+    moe_group_size: int = 256
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_local_experts > 0 and self.num_experts_per_tok > 0
 
     @classmethod
     def from_config(cls, model_cfg: Any, vocab_size: int) -> "LlamaArgs":
@@ -69,6 +77,9 @@ class LlamaArgs:
         rope = dict(getattr(model_cfg, "rope", None) or {})
         misc = dict(getattr(model_cfg, "misc", None) or {})
         norm = dict(getattr(model_cfg, "normalization", None) or {})
+        moe = dict(getattr(model_cfg, "moe", None) or {})
+        if moe.get("num_local_experts") and misc.get("mlp_bias"):
+            raise ValueError("mlp_bias is not supported with MoE (experts are bias-free)")
         scaling = rope.get("scaling") or {}
         scale_factor = scaling.get("factor") if isinstance(scaling, dict) else None
         return cls(
@@ -95,8 +106,12 @@ class LlamaArgs:
             prefix_len=int(att.get("prefix_len", 0)),
             score_mod_type=att.get("score_mod"),
             soft_cap=float(att.get("soft_cap", 50.0)),
-            num_local_experts=int(getattr(model_cfg, "moe", {}).get("num_local_experts", 0) or 0),
-            num_experts_per_tok=int(getattr(model_cfg, "moe", {}).get("num_experts_per_tok", 0) or 0),
+            num_local_experts=int(moe.get("num_local_experts", 0) or 0),
+            num_experts_per_tok=int(moe.get("num_experts_per_tok", 0) or 0),
+            moe_capacity_factor=float(moe.get("capacity_factor", 1.25) or 1.25),
+            moe_aux_weight=float(moe.get("aux_loss_weight", 0.01) or 0.0),
+            router_z_weight=float(moe.get("router_z_weight", 0.0) or 0.0),
+            moe_group_size=int(moe.get("group_size", 256) or 256),
         )
 
 
@@ -105,7 +120,8 @@ def init_params(rng: jax.Array, args: LlamaArgs, dtype=jnp.float32) -> Params:
     """Initialize parameters: normal(0.02) embeddings/projections, residual
     output projections scaled by 1/sqrt(2*num_layers) (GPT-2 style), ones for
     norms."""
-    n_streams = 7 * args.num_layers + 2
+    per_layer = 8 if args.is_moe else 7
+    n_streams = per_layer * args.num_layers + 2
     keys = iter(jax.random.split(rng, n_streams))
     std = 0.02
     res_std = std / (2 * args.num_layers) ** 0.5
@@ -126,16 +142,23 @@ def init_params(rng: jax.Array, args: LlamaArgs, dtype=jnp.float32) -> Params:
                 "wo": {"weight": dense(next(keys), (Hq * Dh, D), res_std)},
             },
             "ffn_norm": {"weight": jnp.ones((D,), dtype)},
-            "feed_forward": {
+        }
+        if args.is_moe:
+            from . import moe as moe_lib
+
+            layer["feed_forward"] = moe_lib.init_moe_params(keys, args, dtype)
+        else:
+            layer["feed_forward"] = {
                 "w_gate": {"weight": dense(next(keys), (D, I), std)},
                 "w_up": {"weight": dense(next(keys), (D, I), std)},
                 "w_down": {"weight": dense(next(keys), (I, D), res_std)},
-            },
-        }
+            }
         if args.attention_bias:
             for name, fan_out in (("wq", Hq * Dh), ("wk", Hkv * Dh), ("wv", Hkv * Dh), ("wo", D)):
                 layer["attention"][name]["bias"] = jnp.zeros((fan_out,), dtype)
         if args.mlp_bias:
+            if args.is_moe:
+                raise ValueError("mlp_bias is not supported with MoE (experts are bias-free)")
             for name, fan_out in (("w_gate", I), ("w_up", I), ("w_down", D)):
                 layer["feed_forward"][name]["bias"] = jnp.zeros((fan_out,), dtype)
         layers.append(layer)
@@ -314,15 +337,26 @@ def transformer_block(
     positions: jnp.ndarray,
     cache: Optional[Dict[str, jnp.ndarray]] = None,
     attn_impl: Optional[str] = None,
-) -> Tuple[jnp.ndarray, Optional[Dict[str, jnp.ndarray]]]:
-    """Pre-norm residual block (reference: models/llama.py:298-319)."""
+) -> Tuple[jnp.ndarray, Optional[Dict[str, jnp.ndarray]], jnp.ndarray]:
+    """Pre-norm residual block (reference: models/llama.py:298-319).
+
+    Returns ``(x, new_cache, aux_loss)`` — aux is the MoE load-balancing
+    loss (0 for dense layers)."""
     h, new_cache = attention_block(
         p["attention"], rms_norm(x, p["attention_norm"]["weight"], args.rms_norm_eps),
         args, positions, cache, attn_impl,
     )
     x = x + h
-    x = x + mlp_block(p["feed_forward"], rms_norm(x, p["ffn_norm"]["weight"], args.rms_norm_eps))
-    return x, new_cache
+    normed = rms_norm(x, p["ffn_norm"]["weight"], args.rms_norm_eps)
+    if args.is_moe:
+        from .moe import moe_block
+
+        ff, aux = moe_block(p["feed_forward"], normed, args)
+    else:
+        ff = mlp_block(p["feed_forward"], normed)
+        aux = jnp.zeros((), jnp.float32)
+    x = x + ff
+    return x, new_cache, aux
 
 
 # -- full model -------------------------------------------------------------
@@ -335,12 +369,15 @@ def forward(
     compute_dtype: jnp.dtype = jnp.float32,
     remat: Optional[str] = None,
     remat_ratio: float = 1.0,
+    return_aux: bool = False,
 ) -> Tuple[jnp.ndarray, Optional[list]]:
     """tokens [B, S] int32 → (logits [B, S, V] fp32, new_cache | None).
 
     ``remat``: None | "full" | "dots" — per-layer ``jax.checkpoint`` with the
     corresponding policy; ``remat_ratio`` checkpoints only the first fraction
     of layers (reference: system.gradient_checkpointing_ratio).
+    ``return_aux=True`` appends the summed MoE aux loss:
+    ``(logits, cache, aux)``.
     """
     B, S = tokens.shape
     x = params["tok_embeddings"]["weight"].astype(compute_dtype)[tokens]
@@ -359,10 +396,12 @@ def forward(
     cast = partial(jax.tree_util.tree_map, lambda a: a.astype(compute_dtype))
     new_cache = [] if cache is not None else None
     n_remat = int(round(args.num_layers * remat_ratio))
+    aux_total = jnp.zeros((), jnp.float32)
     for i, layer in enumerate(params["layers"]):
         blk = block if (remat and i < n_remat) else transformer_block
         layer_cache = cache[i] if cache is not None else None
-        x, c = blk(cast(layer), x, args, positions, layer_cache, None)
+        x, c, aux = blk(cast(layer), x, args, positions, layer_cache, None)
+        aux_total = aux_total + aux
         if new_cache is not None:
             new_cache.append(c)
 
@@ -374,6 +413,8 @@ def forward(
     logits = logits.astype(jnp.float32)
     if args.logit_scale:
         logits = logits * args.logit_scale
+    if return_aux:
+        return logits, new_cache, aux_total
     return logits, new_cache
 
 
@@ -396,12 +437,16 @@ def loss_fn(
     compute_dtype: jnp.dtype = jnp.float32,
     remat: Optional[str] = None,
     remat_ratio: float = 1.0,
+    include_aux: bool = True,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Masked mean cross-entropy in fp32 (reference: core/training.py
-    compute_loss :1195-1260). Returns (loss, token_count)."""
-    logits, _ = forward(
+    compute_loss :1195-1260). Returns (loss, token_count). MoE models add
+    the pre-scaled router aux losses when ``include_aux`` (training); eval
+    passes ``include_aux=False`` so val loss/ppl stay pure LM cross-entropy,
+    comparable with dense baselines."""
+    logits, _, aux = forward(
         params, batch["inputs"], args, compute_dtype=compute_dtype,
-        remat=remat, remat_ratio=remat_ratio,
+        remat=remat, remat_ratio=remat_ratio, return_aux=True,
     )
     targets = batch["targets"]
     mask = batch["mask"].astype(jnp.float32)
@@ -409,4 +454,7 @@ def loss_fn(
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     nll = (logz - gold) * mask
     count = jnp.maximum(mask.sum(), 1.0)
-    return nll.sum() / count, mask.sum()
+    loss = nll.sum() / count
+    if args.is_moe and include_aux:
+        loss = loss + aux  # pre-scaled inside moe_block
+    return loss, mask.sum()

@@ -10,6 +10,8 @@ Axes (any subset, in this order):
 - ``dp``  — data parallel (batch split; gradient psum)
 - ``fsdp``— fully-sharded data parallel (params/opt-state sharded; batch
             also split along it)
+- ``ep``  — expert parallel (MoE expert dim sharded; batch also split
+            along it, dispatch einsums become all-to-alls)
 - ``sp``  — sequence/context parallel (ring attention over ``ppermute``)
 - ``tp``  — tensor parallel (attention heads / MLP columns)
 
@@ -25,7 +27,7 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
-AXIS_ORDER = ("dp", "fsdp", "sp", "tp")
+AXIS_ORDER = ("dp", "fsdp", "ep", "sp", "tp")
 
 
 def mesh_axis_sizes(system_cfg: Any, n_devices: Optional[int] = None) -> Dict[str, int]:

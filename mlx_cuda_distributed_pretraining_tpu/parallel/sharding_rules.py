@@ -22,11 +22,16 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # (path regex, spec builder). fsdp shards the non-tp dim of every matrix.
+# Expert-parallel (MoE, models/moe.py): stacked [E, ...] expert tensors lead
+# with the ep axis so expert compute and weights partition together.
 _RULES = [
     (r"tok_embeddings\.weight$", ("tp", "fsdp")),  # [V, D] vocab-parallel
     (r"output\.weight$", ("fsdp", "tp")),          # [D, V]
     (r"attention\.w[qkv]\.weight$", ("fsdp", "tp")),  # [D, H*Dh] column
     (r"attention\.wo\.weight$", ("tp", "fsdp")),      # [H*Dh, D] row
+    (r"experts\.w_(gate|up)\.weight$", ("ep", "fsdp", "tp")),  # [E, D, I]
+    (r"experts\.w_down\.weight$", ("ep", "tp", "fsdp")),       # [E, I, D]
+    (r"feed_forward\.router\.weight$", ("fsdp", None)),        # [D, E]
     (r"feed_forward\.w_(gate|up)\.weight$", ("fsdp", "tp")),  # [D, I] column
     (r"feed_forward\.w_down\.weight$", ("tp", "fsdp")),       # [I, D] row
     (r"\.bias$", (None,)),
@@ -54,8 +59,11 @@ def param_pspec(path: str, shape, mesh: Mesh) -> P:
 
 
 def batch_pspec(mesh: Mesh) -> P:
-    """Batch dim over dp×fsdp; sequence dim over sp (context parallel)."""
-    data_axes = tuple(a for a in ("dp", "fsdp") if _axis(mesh, a))
+    """Batch dim over dp×fsdp×ep; sequence dim over sp (context parallel).
+
+    ep doubles as a data axis for non-expert compute — the dispatch einsum
+    re-shards tokens expert-major (the all-to-all)."""
+    data_axes = tuple(a for a in ("dp", "fsdp", "ep") if _axis(mesh, a))
     seq_axis = _axis(mesh, "sp")
     return P(data_axes if data_axes else None, seq_axis)
 

@@ -113,7 +113,16 @@ class Trainer:
                 remat=self.remat, remat_ratio=self.remat_ratio,
             )
 
+        # Validation excludes MoE router aux terms: val loss / ppl stay pure
+        # LM cross-entropy, comparable across dense and MoE runs.
+        def eval_loss_fn(params, batch):
+            return arch.loss_fn(
+                params, batch, args, compute_dtype=self.compute_dtype,
+                include_aux=False,
+            )
+
         self.loss_fn = loss_fn
+        self.eval_loss_fn = eval_loss_fn
 
         # -- data ------------------------------------------------------------
         self.data: Optional[DataManager] = None
@@ -150,7 +159,7 @@ class Trainer:
             log_grad_norm=cfg.logging.log_gradient_norm,
             params_like=self.params,
         )
-        self.eval_step = make_eval_step(self.loss_fn, self.mesh, self.state_shardings)
+        self.eval_step = make_eval_step(self.eval_loss_fn, self.mesh, self.state_shardings)
 
         self.state = init_train_state(self.params, self.optimizer)
         if self.mesh is not None and self.state_shardings is not None:

@@ -1,0 +1,202 @@
+"""MoE block + expert parallelism.
+
+The reference only declares MoE config fields (models/llama.py:40-41);
+our models/moe.py implements the real block. These tests check routing
+math, gradient flow to every expert, and that the ep-sharded train step
+on a virtual mesh matches the single-device loss.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlx_cuda_distributed_pretraining_tpu.models import llama, moe
+from mlx_cuda_distributed_pretraining_tpu.optim import build_optimizer
+from mlx_cuda_distributed_pretraining_tpu.config import SystemConfig, TrainingConfig
+from mlx_cuda_distributed_pretraining_tpu.parallel import build_mesh
+from mlx_cuda_distributed_pretraining_tpu.train.train_step import (
+    init_train_state,
+    make_train_step,
+)
+
+MOE_ARGS = llama.LlamaArgs(
+    vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=2,
+    num_heads=2, num_kv_heads=2, head_dim=16, max_position_embeddings=64,
+    num_local_experts=4, num_experts_per_tok=2,
+)
+
+
+def _batch(bs=4, seq=16, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.integers(1, 120, size=(bs, seq + 1)).astype(np.int32)
+    return {
+        "inputs": jnp.asarray(x[:, :-1]),
+        "targets": jnp.asarray(x[:, 1:]),
+        "mask": jnp.ones((bs, seq), jnp.float32),
+    }
+
+
+def test_dispatch_combine_shapes_and_conservation():
+    # A perfectly balanced router keeps every token: combine sums to 1.
+    B, S, E, K, C = 2, 8, 4, 2, 8
+    probs = jnp.full((B, S, E), 1.0 / E)
+    dispatch, combine = moe._dispatch_combine(probs, K, C)
+    assert dispatch.shape == (B, S, E, C)
+    # every token dispatched to exactly K slots
+    np.testing.assert_allclose(np.asarray(dispatch.sum(axis=(2, 3))), K)
+    # combine weights renormalized over the K picks
+    np.testing.assert_allclose(np.asarray(combine.sum(axis=(2, 3))), 1.0, atol=1e-5)
+
+
+def test_capacity_drops_overflow_tokens():
+    # All tokens want expert 0 with capacity 2: only 2 survive per row.
+    B, S, E, K, C = 1, 6, 4, 1, 2
+    logits = jnp.zeros((B, S, E)).at[..., 0].set(10.0)
+    probs = jax.nn.softmax(logits, axis=-1)
+    dispatch, combine = moe._dispatch_combine(probs, K, C)
+    assert float(dispatch[..., 0, :].sum()) == pytest.approx(2.0)
+    # dropped tokens have zero combine weight (residual carries them)
+    per_token = np.asarray(combine.sum(axis=(2, 3)))[0]
+    assert (per_token[:2] > 0.9).all() and (per_token[2:] < 1e-6).all()
+
+
+def test_balanced_router_aux_loss_is_one():
+    # Uniform probs + uniform assignment -> Switch aux loss == 1.
+    probs = jnp.full((2, 8, 4), 0.25)
+    idx = jnp.tile(jnp.arange(4), 4).reshape(2, 8)
+    assert float(moe.load_balancing_loss(probs, idx, 4)) == pytest.approx(1.0)
+
+
+def test_moe_forward_and_all_experts_get_gradients():
+    params = llama.init_params(jax.random.PRNGKey(0), MOE_ARGS)
+    batch = _batch()
+    loss, grads = jax.value_and_grad(
+        lambda p: llama.loss_fn(p, batch, MOE_ARGS)[0]
+    )(params)
+    assert np.isfinite(float(loss))
+    g = grads["layers"][0]["feed_forward"]["experts"]["w_gate"]["weight"]
+    per_expert = np.asarray(jnp.abs(g).sum(axis=(1, 2)))
+    assert (per_expert > 0).all(), f"dead experts: {per_expert}"
+    # router learns too
+    rg = grads["layers"][0]["feed_forward"]["router"]["weight"]
+    assert float(jnp.abs(rg).sum()) > 0
+
+
+def test_moe_aux_loss_increases_total_loss():
+    params = llama.init_params(jax.random.PRNGKey(0), MOE_ARGS)
+    batch = _batch()
+    import dataclasses
+
+    no_aux = dataclasses.replace(MOE_ARGS, moe_aux_weight=0.0)
+    l_with, _ = llama.loss_fn(params, batch, MOE_ARGS)
+    l_without, _ = llama.loss_fn(params, batch, no_aux)
+    assert float(l_with) > float(l_without)
+
+
+def test_router_z_loss_applies_without_aux_weight():
+    # z-loss must survive moe_aux_weight=0 (it is scaled independently).
+    import dataclasses
+
+    params = llama.init_params(jax.random.PRNGKey(0), MOE_ARGS)
+    batch = _batch()
+    base = dataclasses.replace(MOE_ARGS, moe_aux_weight=0.0, router_z_weight=0.0)
+    with_z = dataclasses.replace(MOE_ARGS, moe_aux_weight=0.0, router_z_weight=1.0)
+    l0, _ = llama.loss_fn(params, batch, base)
+    lz, _ = llama.loss_fn(params, batch, with_z)
+    assert float(lz) > float(l0)
+
+
+def test_moe_nondivisible_seq_is_padded_not_regrouped():
+    # S=20 with group 8 pads to 24 (3 groups) instead of reverting to one
+    # O(S) capacity group; output stays finite and correctly shaped.
+    import dataclasses
+
+    args = dataclasses.replace(MOE_ARGS, moe_group_size=8)
+    params = llama.init_params(jax.random.PRNGKey(0), args)
+    batch = _batch(bs=2, seq=20)
+    loss, _ = llama.loss_fn(params, batch, args)
+    assert np.isfinite(float(loss))
+    logits, _ = llama.forward(params, batch["inputs"], args)
+    assert logits.shape == (2, 20, MOE_ARGS.vocab_size)
+
+
+def test_eval_loss_excludes_router_aux():
+    params = llama.init_params(jax.random.PRNGKey(0), MOE_ARGS)
+    batch = _batch()
+    l_train, _ = llama.loss_fn(params, batch, MOE_ARGS, include_aux=True)
+    l_eval, _ = llama.loss_fn(params, batch, MOE_ARGS, include_aux=False)
+    assert float(l_train) > float(l_eval)
+
+
+def test_mlp_bias_with_moe_rejected():
+    import dataclasses
+
+    bad = dataclasses.replace(MOE_ARGS, mlp_bias=True)
+    with pytest.raises(ValueError, match="mlp_bias"):
+        llama.init_params(jax.random.PRNGKey(0), bad)
+
+
+def test_moe_token_grouping_keeps_capacity_bounded():
+    # group_size fixes capacity independent of S: dispatch memory is O(S).
+    import dataclasses
+
+    args = dataclasses.replace(MOE_ARGS, moe_group_size=8)
+    params = llama.init_params(jax.random.PRNGKey(0), args)
+    batch = _batch(bs=2, seq=32)  # 4 groups of 8 per row
+    loss, _ = llama.loss_fn(params, batch, args)
+    assert np.isfinite(float(loss))
+    # per-group capacity stays fixed while whole-sequence capacity grows
+    assert moe.expert_capacity(8, 4, 2, 1.25) < moe.expert_capacity(32, 4, 2, 1.25)
+
+
+def test_moe_decode_cache_matches_full_forward():
+    params = llama.init_params(jax.random.PRNGKey(0), MOE_ARGS)
+    tokens = jnp.asarray(np.random.default_rng(1).integers(1, 120, (1, 8)), jnp.int32)
+    full, _ = llama.forward(params, tokens, MOE_ARGS)
+    cache = llama.init_cache(MOE_ARGS, 1, 16)
+    logits, cache = llama.forward(params, tokens[:, :4], MOE_ARGS, cache=cache, start_pos=0)
+    outs = [logits[:, -1]]
+    for i in range(4, 8):
+        logits, cache = llama.forward(
+            params, tokens[:, i : i + 1], MOE_ARGS, cache=cache, start_pos=i
+        )
+        outs.append(logits[:, -1])
+    # decode sees the whole prefix; capacity is per-call so early-token
+    # routing can differ slightly from the full pass — compare loosely.
+    np.testing.assert_allclose(
+        np.asarray(outs[-1]), np.asarray(full[:, -1]), atol=2e-2, rtol=2e-2
+    )
+
+
+def test_moe_train_step_on_ep_mesh_matches_single_device():
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 virtual devices")
+    sys_cfg = SystemConfig(seed=0, device="cpu", mesh={"ep": 2, "dp": 2})
+    mesh = build_mesh(sys_cfg, devices=jax.devices()[:4])
+    params = llama.init_params(jax.random.PRNGKey(0), MOE_ARGS)
+    tr = TrainingConfig(
+        hyperparameters={"learning_rate": 1e-3},
+        scheduler={"type": "cosine"},
+        optimization={"optimizer": "adamw"},
+    )
+    opt = build_optimizer(tr, 10)
+
+    def loss_fn(p, b):
+        return llama.loss_fn(p, b, MOE_ARGS)
+
+    batch = _batch(bs=8)
+    # single-device reference first: the sharded step donates its buffers
+    sstep, _ = make_train_step(loss_fn, opt)
+    sstate = init_train_state(jax.tree_util.tree_map(jnp.copy, params), opt)
+    _, smetrics = sstep(sstate, batch)
+
+    step, shardings = make_train_step(loss_fn, opt, mesh=mesh, params_like=params)
+    state = jax.device_put(init_train_state(params, opt), shardings)
+    new_state, metrics = step(state, batch)
+    sharded_loss = float(metrics["loss"])
+    assert sharded_loss == pytest.approx(float(smetrics["loss"]), rel=1e-4)
+    # expert weights actually sharded over ep
+    w = new_state["params"]["layers"][0]["feed_forward"]["experts"]["w_gate"]["weight"]
+    spec = w.sharding.spec
+    assert spec and spec[0] == "ep", f"expert dim not ep-sharded: {spec}"
