@@ -221,6 +221,9 @@ class SystemConfig:
     # Rematerialization policy: "none" | "full" | "dots" (overrides
     # gradient_checkpointing when set).
     remat: Optional[str] = None
+    # Pipeline parallelism (pp mesh axis): microbatches per step. 0 means
+    # 2 * pp-size (keeps the GPipe bubble fraction under 1/3).
+    pipeline_microbatches: int = 0
 
     @property
     def compute_dtype(self) -> str:

@@ -78,8 +78,6 @@ class LlamaArgs:
         misc = dict(getattr(model_cfg, "misc", None) or {})
         norm = dict(getattr(model_cfg, "normalization", None) or {})
         moe = dict(getattr(model_cfg, "moe", None) or {})
-        if moe.get("num_local_experts") and misc.get("mlp_bias"):
-            raise ValueError("mlp_bias is not supported with MoE (experts are bias-free)")
         scaling = rope.get("scaling") or {}
         scale_factor = scaling.get("factor") if isinstance(scaling, dict) else None
         return cls(

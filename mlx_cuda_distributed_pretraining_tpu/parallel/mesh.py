@@ -7,6 +7,8 @@ data plane (reference: distributed/utils.py DeviceManager,
 distributed/hybrid_distributed.py HybridDeviceManager, distributed/worker.py).
 
 Axes (any subset, in this order):
+- ``pp``  — pipeline parallel (stacked layer slabs + ppermute microbatch
+            rotation; parallel/pipeline.py)
 - ``dp``  — data parallel (batch split; gradient psum)
 - ``fsdp``— fully-sharded data parallel (params/opt-state sharded; batch
             also split along it)
@@ -27,7 +29,7 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
-AXIS_ORDER = ("dp", "fsdp", "ep", "sp", "tp")
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
 
 
 def mesh_axis_sizes(system_cfg: Any, n_devices: Optional[int] = None) -> Dict[str, int]:

@@ -1,0 +1,377 @@
+"""Pipeline parallelism (pp mesh axis) — GPipe-style microbatching.
+
+The reference has no pipeline parallelism (SURVEY.md §2.4: absent). This is
+the TPU-native design, not a port of any GPU schedule:
+
+- Layer parameters are **stacked** into a ``[L, ...]`` pytree whose leading
+  dim is sharded over the ``pp`` mesh axis — each stage owns a contiguous
+  slab of layers. Within a stage, layers run under ``lax.scan``.
+- The schedule is a single ``lax.scan`` over ``M + P - 1`` ticks: each tick
+  every stage applies its slab to its current activation and the results
+  rotate one stage forward via ``jax.lax.ppermute`` over ICI. Stage 0 feeds
+  microbatch ``t``; the last stage computes token-level NLL for microbatch
+  ``t - (P-1)``. No bubbles beyond the inherent ``P-1``.
+- ``jax.shard_map(..., axis_names={'pp'})`` is manual **only over pp**; all
+  other mesh axes (dp/fsdp/tp/ep) stay in GSPMD auto mode, so the usual
+  sharding rules (parallel/sharding_rules.py) keep partitioning the batch
+  and the within-stage weights. Pipeline composes with DP/TP/EP by
+  construction instead of by hand-written schedules.
+- Backward is just ``jax.grad`` through the scan + ppermute (both
+  differentiable); XLA re-emits the reverse rotations.
+
+Limits (documented, enforced): ring (sp) attention inside a pipeline stage
+is not supported — sp and pp are alternative scale-out axes for now.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .sharding_rules import _axis, batch_pspec, param_pspec
+from ..utils.tree import flatten_dict, unflatten_dict
+
+Params = Dict[str, Any]
+
+
+# -- stacked layer layout ----------------------------------------------------
+def stack_layers(params: Params) -> Params:
+    """list-of-layer-dicts → single tree with leading layer dim [L, ...]."""
+    layers = params["layers"]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *layers)
+    out = {k: v for k, v in params.items() if k != "layers"}
+    out["layers"] = stacked
+    return out
+
+
+def unstack_layers(params: Params, num_layers: int) -> Params:
+    """Inverse of :func:`stack_layers` (e.g. for checkpoint compatibility)."""
+    stacked = params["layers"]
+    layers = [
+        jax.tree_util.tree_map(lambda x: x[i], stacked) for i in range(num_layers)
+    ]
+    out = {k: v for k, v in params.items() if k != "layers"}
+    out["layers"] = layers
+    return out
+
+
+def _is_stacked_layers(node: Any, num_layers: int) -> bool:
+    leaves = jax.tree_util.tree_leaves(node)
+    return bool(leaves) and all(
+        getattr(l, "ndim", 0) >= 1 and l.shape[0] == num_layers for l in leaves
+    )
+
+
+def unstack_opt_state(opt_state: Any, num_layers: int) -> Any:
+    """Convert stacked ``layers`` subtrees inside an optimizer-state pytree to
+    the canonical list-of-layers layout (checkpoint compatibility: a pipeline
+    run's optimizer state must resume on a non-pp mesh and vice versa)."""
+
+    def walk(node):
+        if isinstance(node, dict):
+            out = {}
+            for k, v in node.items():
+                if k == "layers" and _is_stacked_layers(v, num_layers):
+                    out[k] = [
+                        jax.tree_util.tree_map(lambda x, i=i: x[i], v)
+                        for i in range(num_layers)
+                    ]
+                else:
+                    out[k] = walk(v)
+            return out
+        if isinstance(node, tuple) and hasattr(node, "_fields"):
+            return type(node)(*[walk(v) for v in node])
+        if isinstance(node, (list, tuple)):
+            vals = [walk(v) for v in node]
+            return vals if isinstance(node, list) else tuple(vals)
+        return node
+
+    return walk(opt_state)
+
+
+def stack_opt_state(opt_state: Any, num_layers: int) -> Any:
+    """Inverse of :func:`unstack_opt_state`."""
+
+    def walk(node):
+        if isinstance(node, dict):
+            out = {}
+            for k, v in node.items():
+                if k == "layers" and isinstance(v, list) and len(v) == num_layers:
+                    out[k] = jax.tree_util.tree_map(
+                        lambda *xs: jnp.stack(xs, axis=0), *v
+                    )
+                else:
+                    out[k] = walk(v)
+            return out
+        if isinstance(node, tuple) and hasattr(node, "_fields"):
+            return type(node)(*[walk(v) for v in node])
+        if isinstance(node, (list, tuple)):
+            vals = [walk(v) for v in node]
+            return vals if isinstance(node, list) else tuple(vals)
+        return node
+
+    return walk(opt_state)
+
+
+def stacked_param_pspec(path: str, shape, mesh: Mesh) -> P:
+    """Sharding spec for a stacked-params leaf.
+
+    ``layers.*`` leaves: leading layer dim over ``pp``, remaining dims by the
+    standard rules. Non-layer leaves (embed/norm/head): standard rules.
+    """
+    pp = _axis(mesh, "pp")
+    if path.startswith("layers."):
+        inner = param_pspec(path[len("layers.") :], shape[1:], mesh)
+        dims = list(inner) + [None] * (len(shape) - 1 - len(inner))
+        lead = pp if (pp is not None and shape[0] % mesh.shape[pp] == 0) else None
+        return P(lead, *dims)
+    return param_pspec(path, shape, mesh)
+
+
+def stacked_tree_pspecs(stacked: Params, mesh: Mesh) -> Any:
+    flat = flatten_dict(stacked)
+    specs = {k: stacked_param_pspec(k, np.shape(v), mesh) for k, v in flat.items()}
+    return unflatten_dict(specs)
+
+
+def pipeline_state_sharding(state: Any, mesh: Mesh, zero_level: int = 0) -> Any:
+    """NamedShardings for {params(stacked), opt_state, step} (ZeRO-1 over dp
+    for still-replicated opt-state dims, mirroring sharding_rules)."""
+    dp = _axis(mesh, "dp")
+    param_specs: dict = {}
+    param_shapes: dict = {}
+
+    def record(path, leaf):
+        k = _path_str(path)
+        param_specs[k] = stacked_param_pspec(k, np.shape(leaf), mesh)
+        param_shapes[k] = np.shape(leaf)
+        return NamedSharding(mesh, param_specs[k])
+
+    params_sh = jax.tree_util.tree_map_with_path(record, state["params"])
+    ordered = sorted(param_specs, key=len, reverse=True)
+
+    def opt_leaf(path, leaf):
+        k = _path_str(path)
+        shape = np.shape(leaf)
+        spec = P()
+        if len(shape) > 0:
+            for p in ordered:
+                if (k == p or k.endswith("." + p)) and param_shapes[p] == shape:
+                    spec = param_specs[p]
+                    break
+            if zero_level >= 1 and dp is not None:
+                dims = list(spec) + [None] * (len(shape) - len(spec))
+                for i, d in enumerate(dims):
+                    if d is None and shape[i] % mesh.shape[dp] == 0 and shape[i] > 1:
+                        dims[i] = dp
+                        break
+                spec = P(*dims)
+        return NamedSharding(mesh, spec)
+
+    return {
+        "params": params_sh,
+        "opt_state": jax.tree_util.tree_map_with_path(opt_leaf, state["opt_state"]),
+        "step": NamedSharding(mesh, P()),
+    }
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return ".".join(parts)
+
+
+# -- the pipelined loss ------------------------------------------------------
+def make_pipeline_loss(
+    args: Any,
+    mesh: Mesh,
+    num_microbatches: int,
+    compute_dtype=jnp.float32,
+    remat: Optional[str] = None,
+    include_aux: bool = True,
+) -> Callable:
+    """Build ``loss(stacked_params, batch) -> (loss, token_count)`` running
+    the GPipe schedule over the mesh's pp axis.
+
+    ``batch`` leaves are [B, S(+1)]-shaped like the standard loss; B must be
+    divisible by ``num_microbatches``.
+    """
+    if getattr(args, "attention_type", "simple") == "ring":
+        raise ValueError("ring (sp) attention inside a pipeline stage is not supported")
+    P_stages = mesh.shape["pp"]
+    M = num_microbatches
+    from ..models.llama import transformer_block, rms_norm, _linear
+
+    def stage_apply(layers_loc, x, positions):
+        cast = partial(jax.tree_util.tree_map, lambda a: a.astype(compute_dtype))
+
+        def one_layer(p_layer, h):
+            y, _, aux = transformer_block(cast(p_layer), h, args, positions, None, None)
+            return y, aux
+
+        if remat:
+            one_layer = jax.checkpoint(one_layer)
+
+        def body(carry, p_layer):
+            h, aux_sum = carry
+            y, aux = one_layer(p_layer, h)
+            return (y, aux_sum + aux), None
+
+        (x, aux), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)), layers_loc)
+        return x, aux
+
+    def inner(layers_loc, embed_w, norm_w, out_w, tokens, targets, mask):
+        # layers_loc: stage slab [L/P, ...]; everything else replicated
+        # w.r.t. pp (GSPMD may still shard over tp/fsdp).
+        p = jax.lax.axis_index("pp")
+        B, S = tokens.shape
+        mb = B // M
+        tok_m = tokens.reshape(M, mb, S)
+        tgt_m = targets.reshape(M, mb, S)
+        msk_m = mask.reshape(M, mb, S)
+        positions = jnp.arange(S, dtype=jnp.int32)
+        is_first = (p == 0).astype(compute_dtype)
+
+        perm = [(i, (i + 1) % P_stages) for i in range(P_stages)]
+
+        def head_nll(out, tgt, msk):
+            h = rms_norm(out, norm_w, args.rms_norm_eps)
+            logits = (h @ out_w.astype(compute_dtype)).astype(jnp.float32)
+            if args.logit_scale:
+                logits = logits * args.logit_scale
+            logz = jax.nn.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+            return ((logz - gold) * msk).sum(), msk.sum()
+
+        def tick(carry, t):
+            state, nll_sum, tok_sum, aux_sum = carry
+            # stage-0 injects microbatch t (clamped when t >= M; masked below)
+            feed_idx = jnp.clip(t, 0, M - 1)
+            x0 = embed_w.astype(compute_dtype)[
+                jax.lax.dynamic_index_in_dim(tok_m, feed_idx, keepdims=False)
+            ]
+            feed_valid = (t < M).astype(compute_dtype)
+            inp = is_first * feed_valid * x0 + (1.0 - is_first) * state
+            out, aux = stage_apply(layers_loc, inp, positions)
+            # my microbatch index this tick; work is real when p<=t<p+M
+            my_idx = t - p
+            working = (my_idx >= 0) & (my_idx < M)
+            aux_sum = aux_sum + aux * working.astype(jnp.float32)
+            # Only the last working stage runs the vocab head (lax.cond:
+            # the other P-1 stages skip the [mb,S,D]x[D,V] matmul entirely).
+            li = jnp.clip(my_idx, 0, M - 1)
+            tgt = jax.lax.dynamic_index_in_dim(tgt_m, li, keepdims=False)
+            msk = jax.lax.dynamic_index_in_dim(msk_m, li, keepdims=False).astype(jnp.float32)
+            nll_c, tok_c = jax.lax.cond(
+                (p == P_stages - 1) & working,
+                head_nll,
+                lambda out, tgt, msk: (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+                out, tgt, msk,
+            )
+            nll_sum = nll_sum + nll_c
+            tok_sum = tok_sum + tok_c
+            # rotate activations one stage forward
+            state_next = jax.lax.ppermute(out, "pp", perm)
+            return (state_next, nll_sum, tok_sum, aux_sum), None
+
+        D = embed_w.shape[1]
+        state0 = jnp.zeros((mb, S, D), compute_dtype)
+        zero = jnp.zeros((), jnp.float32)
+        (state, nll, toks, aux), _ = jax.lax.scan(
+            tick, (state0, zero, zero, zero), jnp.arange(M + P_stages - 1)
+        )
+        nll = jax.lax.psum(nll, "pp")
+        toks = jax.lax.psum(toks, "pp")
+        aux = jax.lax.psum(aux, "pp")
+        return nll, toks, aux
+
+    def loss(stacked_params: Params, batch: Dict[str, jnp.ndarray]):
+        layers = stacked_params["layers"]
+        embed_w = stacked_params["tok_embeddings"]["weight"]
+        norm_w = stacked_params["norm"]["weight"]
+        if args.tie_word_embeddings or "output" not in stacked_params:
+            out_w = embed_w.T
+        else:
+            out_w = stacked_params["output"]["weight"]
+
+        layer_in_specs = jax.tree_util.tree_map(lambda _: P("pp"), layers)
+        bspec = P()  # batch enters replicated w.r.t. pp (auto axes may shard)
+        sm = jax.shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(layer_in_specs, P(), P(), P(), bspec, bspec, bspec),
+            out_specs=(P(), P(), P()),
+            axis_names={"pp"},
+            check_vma=False,
+        )
+        nll, toks, aux = sm(
+            layers, embed_w, norm_w, out_w,
+            batch["inputs"], batch["targets"], batch["mask"],
+        )
+        loss_val = nll / jnp.maximum(toks, 1.0)
+        if getattr(args, "is_moe", False) and include_aux:
+            loss_val = loss_val + aux / M  # aux is pre-scaled per microbatch
+        return loss_val, toks
+
+    return loss
+
+
+# -- the pipelined train step ------------------------------------------------
+def make_pipeline_train_step(
+    args: Any,
+    optimizer: Any,
+    mesh: Mesh,
+    num_microbatches: int,
+    compute_dtype=jnp.float32,
+    remat: Optional[str] = None,
+    zero_level: int = 0,
+    params_like: Optional[Params] = None,
+) -> Tuple[Callable, Any]:
+    """Jitted ``step(state, batch) -> (state, metrics)`` with stacked params
+    sharded over pp (plus the usual auto axes). ``params_like`` is the
+    standard (list-of-layers) param tree used to derive shapes."""
+    from ..optim.base import apply_updates
+    from ..train.train_step import init_train_state
+
+    assert params_like is not None
+    loss_fn = make_pipeline_loss(
+        args, mesh, num_microbatches, compute_dtype=compute_dtype, remat=remat
+    )
+
+    def train_step(state, batch):
+        params = state["params"]
+        (loss, toks), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+        updates, opt_state = optimizer.update(grads, state["opt_state"], params)
+        new_params = apply_updates(params, updates)
+        metrics = {
+            "loss": loss,
+            "toks": toks,
+            "nonfinite": jnp.logical_not(jnp.isfinite(loss)).astype(jnp.int32),
+        }
+        return {"params": new_params, "opt_state": opt_state, "step": state["step"] + 1}, metrics
+
+    stacked_like = jax.eval_shape(stack_layers, params_like)
+    probe = jax.eval_shape(
+        lambda p: init_train_state(p, optimizer), stacked_like
+    )
+    shardings = pipeline_state_sharding(probe, mesh, zero_level)
+    b_shard = NamedSharding(mesh, batch_pspec(mesh))
+    batch_shardings = {"inputs": b_shard, "targets": b_shard, "mask": b_shard}
+    step_fn = jax.jit(
+        train_step,
+        donate_argnums=(0,),
+        in_shardings=(shardings, batch_shardings),
+        out_shardings=(shardings, None),
+    )
+    return step_fn, shardings

@@ -151,19 +151,68 @@ class Trainer:
         self.optimizer = build_optimizer(cfg.training, max(self.total_steps, 1), schedule=self.schedule)
         self.accum_steps = cfg.training.gradient_accumulation_steps
 
-        self.train_step, self.state_shardings = make_train_step(
-            self.loss_fn, self.optimizer,
-            accum_steps=self.accum_steps,
-            mesh=self.mesh,
-            zero_level=cfg.system.zero_optimization_level,
-            log_grad_norm=cfg.logging.log_gradient_norm,
-            params_like=self.params,
+        # Pipeline parallelism: a pp>1 mesh axis switches the whole step to
+        # the GPipe schedule (parallel/pipeline.py) over stacked layer params.
+        self.pipeline = bool(
+            self.mesh is not None
+            and "pp" in self.mesh.axis_names
+            and self.mesh.shape["pp"] > 1
         )
-        self.eval_step = make_eval_step(self.eval_loss_fn, self.mesh, self.state_shardings)
+        if self.pipeline:
+            from ..parallel.pipeline import (
+                make_pipeline_loss,
+                make_pipeline_train_step,
+                stack_layers,
+            )
 
-        self.state = init_train_state(self.params, self.optimizer)
-        if self.mesh is not None and self.state_shardings is not None:
+            pp = self.mesh.shape["pp"]
+            self.microbatches = int(cfg.system.pipeline_microbatches or 2 * pp)
+            # Pipeline microbatching IS gradient accumulation: fold the
+            # configured accum factor in so the effective batch semantics
+            # match the same config on a non-pp mesh.
+            if self.accum_steps > 1:
+                self.microbatches = max(self.microbatches, self.accum_steps)
+                self.logger.log(
+                    f"pipeline: gradient_accumulation_steps={self.accum_steps} folded "
+                    f"into {self.microbatches} microbatches"
+                )
+            if cfg.logging.log_gradient_norm:
+                self.logger.log("pipeline: log_gradient_norm is not supported; ignoring")
+            if cfg.training.batch_size % self.microbatches != 0:
+                raise ValueError(
+                    f"batch_size {cfg.training.batch_size} must be divisible by "
+                    f"pipeline_microbatches {self.microbatches}"
+                )
+            if self.model_args.num_layers % pp != 0:
+                raise ValueError(
+                    f"num_layers {self.model_args.num_layers} must be divisible by pp={pp}"
+                )
+            self.train_step, self.state_shardings = make_pipeline_train_step(
+                args, self.optimizer, self.mesh, self.microbatches,
+                compute_dtype=self.compute_dtype, remat=self.remat,
+                zero_level=cfg.system.zero_optimization_level,
+                params_like=self.params,
+            )
+            self.eval_step = jax.jit(make_pipeline_loss(
+                args, self.mesh, self.microbatches,
+                compute_dtype=self.compute_dtype, include_aux=False,
+            ))
+            self.state = init_train_state(stack_layers(self.params), self.optimizer)
             self.state = jax.device_put(self.state, self.state_shardings)
+        else:
+            self.train_step, self.state_shardings = make_train_step(
+                self.loss_fn, self.optimizer,
+                accum_steps=self.accum_steps,
+                mesh=self.mesh,
+                zero_level=cfg.system.zero_optimization_level,
+                log_grad_norm=cfg.logging.log_gradient_norm,
+                params_like=self.params,
+            )
+            self.eval_step = make_eval_step(self.eval_loss_fn, self.mesh, self.state_shardings)
+
+            self.state = init_train_state(self.params, self.optimizer)
+            if self.mesh is not None and self.state_shardings is not None:
+                self.state = jax.device_put(self.state, self.state_shardings)
 
         # optional live stats publishing (obs/stats_server.py hub)
         self.stats_client = None
@@ -184,6 +233,25 @@ class Trainer:
         if resume and for_training:
             self._resume()
 
+    def _host_params(self):
+        """Current params in the canonical list-of-layers layout (pipeline
+        mode stores them stacked [L, ...]; checkpoints and generation use
+        the unstacked layout so files stay interchangeable across meshes)."""
+        if self.pipeline:
+            from ..parallel.pipeline import unstack_layers
+
+            return unstack_layers(self.state["params"], self.model_args.num_layers)
+        return self.state["params"]
+
+    def _host_opt_state(self):
+        """Optimizer state with stacked ``layers`` subtrees unstacked — same
+        cross-mesh checkpoint compatibility as :meth:`_host_params`."""
+        if self.pipeline:
+            from ..parallel.pipeline import unstack_opt_state
+
+            return unstack_opt_state(self.state["opt_state"], self.model_args.num_layers)
+        return self.state["opt_state"]
+
     # -- checkpointing ------------------------------------------------------
     def save_checkpoint(self, step) -> None:
         if jax.process_index() != 0:
@@ -196,7 +264,7 @@ class Trainer:
             "early_stopping": self.early_stopping.state_dict(),
         }
         self.checkpoints.save(
-            step, self.state["params"], self.state["opt_state"], training_state,
+            step, self._host_params(), self._host_opt_state(), training_state,
             metadata_extra={"total_tokens": int(self.total_tokens)},
         )
         self._write_metadata_summary()
@@ -224,14 +292,23 @@ class Trainer:
         if tag in ("latest", ""):
             tag = self.checkpoints.latest_step() or "final"
         params, opt_state, tstate = self.checkpoints.load(
-            tag, like_params=self.state["params"],
-            like_opt_state=None if rc.reset_optimizer else self.state["opt_state"],
+            tag, like_params=self._host_params(),
+            like_opt_state=None if rc.reset_optimizer else self._host_opt_state(),
         )
         step = 0 if rc.reset_training_state else int(tstate.get("step", 0))
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        if opt_state is not None:
+            opt_state = jax.tree_util.tree_map(jnp.asarray, opt_state)
+        if self.pipeline:
+            from ..parallel.pipeline import stack_layers, stack_opt_state
+
+            params = stack_layers(params)
+            if opt_state is not None:
+                opt_state = stack_opt_state(opt_state, self.model_args.num_layers)
         self.state = {
-            "params": jax.tree_util.tree_map(jnp.asarray, params),
+            "params": params,
             "opt_state": self.state["opt_state"] if rc.reset_optimizer or opt_state is None
-            else jax.tree_util.tree_map(jnp.asarray, opt_state),
+            else opt_state,
             "step": jnp.asarray(step, jnp.int32),
         }
         if self.mesh is not None and self.state_shardings is not None:
@@ -267,7 +344,7 @@ class Trainer:
         for prompt in prompts[:count]:
             try:
                 text = generate_text(
-                    self.state["params"], self.model_args, self.tokenizer, prompt,
+                    self._host_params(), self.model_args, self.tokenizer, prompt,
                     max_new_tokens=max_new_tokens, temperature=0.0,
                 )
                 self.logger.log_sample(step, prompt, text)
@@ -282,6 +359,9 @@ class Trainer:
         on resume, as the reference does."""
         lf = dict(self.config.training.lr_finder or {})
         if not lf.get("enabled") or self.start_step > 0:
+            return None
+        if self.pipeline:
+            self.logger.log("LR finder is not supported with pipeline parallelism; skipping")
             return None
         self.logger.log("Running LR finder sweep")
         suggested, _, _ = run_lr_finder(
