@@ -170,6 +170,11 @@ class LoggingConfig:
     log_parameter_norm: bool = False
     log_samples: bool = False
     log_samples_count: int = 3
+    # Capture a jax.profiler trace for steps [profile_start, profile_stop)
+    # into <run_dir>/profile/ (the reference has no profiler; SURVEY.md §5
+    # tracing plan).
+    profile_start: int = 0
+    profile_stop: int = 0
 
     @property
     def logging_interval(self) -> int:

@@ -19,6 +19,8 @@ def main(argv=None) -> str:
     parser.add_argument("--repetition-penalty", type=float, default=None)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--beams", type=int, default=0, help=">0 switches to beam search")
+    parser.add_argument("--kv-quant", action="store_true",
+                        help="int8-quantized KV cache (less HBM per token)")
     args = parser.parse_args(argv)
 
     from ..train.trainer import load_trained
@@ -37,6 +39,7 @@ def main(argv=None) -> str:
         max_new_tokens=args.max_tokens, temperature=args.temperature,
         top_p=args.top_p, min_p=args.min_p,
         repetition_penalty=args.repetition_penalty, seed=args.seed,
+        kv_quant=args.kv_quant,
     )
     print(args.prompt + text)
     return text

@@ -56,12 +56,14 @@ def _decode_step(args: llama.LlamaArgs, with_processors: bool):
 
 
 def prefill(params, args: llama.LlamaArgs, tokens: np.ndarray, cache_len: int,
-            prefill_step_size: int = 512, cache_dtype=jnp.float32):
+            prefill_step_size: int = 512, cache_dtype=jnp.float32,
+            kv_quant: bool = False):
     """Build a KV cache for ``tokens [B, P]``; returns (cache, last_logits).
 
     The prompt is padded up to a multiple of ``prefill_step_size`` (one
     compile per bucket); the cache position is then rewound to the true
-    length so decode overwrites the junk tail before it can be attended."""
+    length so decode overwrites the junk tail before it can be attended.
+    ``kv_quant`` stores the cache int8 (models/llama.py:init_cache)."""
     B, P = tokens.shape
     step = max(min(prefill_step_size, cache_len), 1)
     bucket = min(max(_round_up(P, step), step), cache_len)
@@ -69,7 +71,8 @@ def prefill(params, args: llama.LlamaArgs, tokens: np.ndarray, cache_len: int,
         raise ValueError(f"prompt length {P} exceeds cache length {cache_len}")
     padded = np.zeros((B, bucket), np.int32)
     padded[:, :P] = tokens
-    cache = llama.init_cache(args, B, max_len=cache_len, dtype=cache_dtype)
+    cache = llama.init_cache(args, B, max_len=cache_len, dtype=cache_dtype,
+                             quantize=kv_quant)
     logits, cache = llama.forward(params, jnp.asarray(padded), args, cache=cache, start_pos=0)
     for layer in cache:
         layer["pos"] = jnp.asarray(P, jnp.int32)
@@ -86,15 +89,17 @@ def generate_step(
     prefill_step_size: int = 512,
     seed: int = 0,
     rep_context: int = 64,
+    kv_quant: bool = False,
 ) -> Iterator[Tuple[int, float]]:
     """Yield ``(token, logprob)`` pairs, KV-cached (reference:
-    generation_lite.py:96-176)."""
+    generation_lite.py:96-176). ``kv_quant`` uses an int8 cache."""
     sampler = sampler or greedy()
     processors = tuple(logits_processors or ())
     tokens = np.asarray(prompt_tokens, np.int32)[None, :]
     P = tokens.shape[1]
     cache_len = min(_round_up(P + max_tokens, 128), max(args.max_position_embeddings, P + max_tokens))
-    cache, last_logits = prefill(params, args, tokens, cache_len, prefill_step_size)
+    cache, last_logits = prefill(params, args, tokens, cache_len, prefill_step_size,
+                                 kv_quant=kv_quant)
 
     rng = jax.random.PRNGKey(seed)
     rng, sub = jax.random.split(rng)
@@ -135,6 +140,7 @@ def generate_lite(
     prefill_step_size: int = 512,
     seed: int = 0,
     verbose: bool = False,
+    kv_quant: bool = False,
 ) -> Tuple[List[int], Dict[str, float]]:
     """Generate with stop tokens and throughput stats (reference:
     generation_lite.py:183-291). Returns (tokens, stats)."""
@@ -144,7 +150,7 @@ def generate_lite(
     logprobs: List[float] = []
     for tok, lp in generate_step(
         params, args, prompt_tokens, max_tokens, sampler, logits_processors,
-        prefill_step_size, seed,
+        prefill_step_size, seed, kv_quant=kv_quant,
     ):
         if tok in stop:
             break
@@ -173,6 +179,7 @@ def generate_text(
     min_p: float = 0.0,
     repetition_penalty: Optional[float] = None,
     seed: int = 0,
+    kv_quant: bool = False,
 ) -> str:
     """Convenience: str → str with EOS stop."""
     from .samplers import make_logits_processors
@@ -182,7 +189,7 @@ def generate_text(
     toks, _ = generate_lite(
         params, args, ids, max_tokens=max_new_tokens, sampler=sampler,
         logits_processors=make_logits_processors(repetition_penalty),
-        stop_tokens=[tokenizer.eos_id], seed=seed,
+        stop_tokens=[tokenizer.eos_id], seed=seed, kv_quant=kv_quant,
     )
     return tokenizer.detokenize(toks)
 

@@ -279,17 +279,28 @@ def attention_block(
     k = apply_rope(k, cos, sin, args.rope_traditional)
 
     new_cache = None
-    if cache is not None:
+    if cache is not None and "k_q" in cache:
+        # int8-quantized cache (reference: generation_lite.py:75-89 optional
+        # KV quantization): per-(position, head) symmetric scales; int8
+        # buffers cut decode's HBM cache reads ~4x, dequant fuses into the
+        # attention matmul.
+        pos = cache["pos"]
+        kq, ks = _quantize_kv(k)
+        vq, vs = _quantize_kv(v)
+        ck_q = jax.lax.dynamic_update_slice(cache["k_q"], kq, (0, pos, 0, 0))
+        ck_s = jax.lax.dynamic_update_slice(cache["k_s"], ks, (0, pos, 0, 0))
+        cv_q = jax.lax.dynamic_update_slice(cache["v_q"], vq, (0, pos, 0, 0))
+        cv_s = jax.lax.dynamic_update_slice(cache["v_s"], vs, (0, pos, 0, 0))
+        new_cache = {"k_q": ck_q, "k_s": ck_s, "v_q": cv_q, "v_s": cv_s, "pos": pos + S}
+        k = ck_q.astype(jnp.float32) * ck_s
+        v = cv_q.astype(jnp.float32) * cv_s
+        out = _cached_attention(q, k, v, positions, pos, S)
+    elif cache is not None:
         pos = cache["pos"]
         ck = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, pos, 0, 0))
         cv = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, pos, 0, 0))
         new_cache = {"k": ck, "v": cv, "pos": pos + S}
-        k, v = ck, cv
-        T = k.shape[1]
-        q_abs = positions  # [S] absolute positions of the queries
-        k_idx = jnp.arange(T, dtype=jnp.int32)
-        explicit = (k_idx[None, :] <= q_abs[:, None]) & (k_idx[None, :] < pos + S)
-        out = reference_attention(q, k, v, explicit_mask=explicit)
+        out = _cached_attention(q, ck, cv, positions, pos, S)
     else:
         mask_mod = build_mask_mod(args)
         impl = attn_impl or args.attention_type
@@ -321,6 +332,24 @@ def attention_block(
 
     out = out.reshape(B, S, Hq * Dh)
     return _linear(out, p["wo"]), new_cache
+
+
+def _quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 per-(batch, position, head) quantization of [B, S, H, D]
+    → (int8 values, fp32 scales [B, S, H, 1])."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _cached_attention(q, k, v, positions, pos, S):
+    """Decode attention over a full static cache buffer under a positional
+    validity mask (keys at or before each query, and already written)."""
+    T = k.shape[1]
+    k_idx = jnp.arange(T, dtype=jnp.int32)
+    explicit = (k_idx[None, :] <= positions[:, None]) & (k_idx[None, :] < pos + S)
+    return reference_attention(q, k, v, explicit_mask=explicit)
 
 
 def mlp_block(p: Params, x: jnp.ndarray) -> jnp.ndarray:
@@ -416,12 +445,33 @@ def forward(
     return logits, new_cache
 
 
-def init_cache(args: LlamaArgs, batch_size: int, max_len: Optional[int] = None, dtype=jnp.float32) -> list:
+def init_cache(
+    args: LlamaArgs,
+    batch_size: int,
+    max_len: Optional[int] = None,
+    dtype=jnp.float32,
+    quantize: bool = False,
+) -> list:
+    """KV cache buffers. ``quantize=True`` allocates int8 value buffers plus
+    per-(position, head) fp32 scales (reference: generation_lite.py:75-89's
+    optional KV-cache quantization, here int8-symmetric)."""
     T = max_len or args.max_position_embeddings
+    B, H, D = batch_size, args.num_kv_heads, args.head_dim
+    if quantize:
+        return [
+            {
+                "k_q": jnp.zeros((B, T, H, D), jnp.int8),
+                "k_s": jnp.zeros((B, T, H, 1), jnp.float32),
+                "v_q": jnp.zeros((B, T, H, D), jnp.int8),
+                "v_s": jnp.zeros((B, T, H, 1), jnp.float32),
+                "pos": jnp.asarray(0, jnp.int32),
+            }
+            for _ in range(args.num_layers)
+        ]
     return [
         {
-            "k": jnp.zeros((batch_size, T, args.num_kv_heads, args.head_dim), dtype),
-            "v": jnp.zeros((batch_size, T, args.num_kv_heads, args.head_dim), dtype),
+            "k": jnp.zeros((B, T, H, D), dtype),
+            "v": jnp.zeros((B, T, H, D), dtype),
             "pos": jnp.asarray(0, jnp.int32),
         }
         for _ in range(args.num_layers)

@@ -398,6 +398,34 @@ class Trainer:
         val_int = cfg.logging.validation_interval
         self.maybe_run_lr_finder()
 
+        # Preemption-aware checkpointing (SURVEY.md §5 failure-detection
+        # plan; the reference's only recovery story is checkpoint-resume):
+        # SIGTERM/SIGINT set a flag; the loop saves and exits cleanly at the
+        # next step boundary.
+        self._preempted = False
+        prev_handlers = {}
+
+        def _on_signal(signum, frame):
+            self._preempted = True
+            # restore the previous handler so a second signal (e.g. a
+            # repeated Ctrl-C during a hung step) terminates immediately
+            import signal as _signal
+
+            _signal.signal(signum, prev_handlers.get(signum, _signal.SIG_DFL))
+
+        try:
+            import signal as _signal
+
+            for sig in (_signal.SIGTERM, _signal.SIGINT):
+                prev_handlers[sig] = _signal.signal(sig, _on_signal)
+        except (ValueError, OSError):  # non-main thread: no signal hooks
+            prev_handlers = {}
+
+        # Optional jax.profiler trace window [profile_start, profile_stop).
+        prof_start = int(cfg.logging.profile_start or 0)
+        prof_stop = int(cfg.logging.profile_stop or 0)
+        prof_active = False
+
         if self.start_step == 0 and val_int:
             v = self.validate()
             if v is not None:
@@ -410,58 +438,96 @@ class Trainer:
         last_loss = float("nan")
         stopped_early = False
 
-        for step in range(self.start_step + 1, self.total_steps + 1):
-            try:
-                batch = self.data.generate_batch(step - 1)
-            except StopIteration:  # finite stream ran dry (streaming sources)
-                self.logger.log(f"Data stream exhausted before step {step}; stopping")
-                break
-            # Host-side token count (non-pad targets) so tok/s stays correct
-            # even when device metrics are only read every log_int steps.
-            step_tokens = int(batch["mask"].sum()) * jax.process_count()
-            window_tokens += step_tokens
-            self.total_tokens += step_tokens
-            self.state, metrics = self.train_step(self.state, _device_batch(batch))
+        try:
+            for step in range(self.start_step + 1, self.total_steps + 1):
+                if prof_stop > prof_start:
+                    if step >= prof_stop and prof_active:
+                        import jax.profiler as _prof
 
-            if step % log_int == 0 or step == self.total_steps:
-                loss = float(metrics["loss"])  # device sync point
-                last_loss = loss
-                elapsed = max(time.perf_counter() - window_start, 1e-9)
-                line = {
-                    "loss": loss,
-                    "ppl": float(math.exp(min(loss, 30.0))),
-                    "lr": float(self.schedule(jnp.asarray(step))),
-                    "tok/s": window_tokens / elapsed,
-                    "toks": int(window_tokens),
-                }
-                if "grad_norm" in metrics:
-                    line["grad_norm"] = float(metrics["grad_norm"])
-                if int(metrics["nonfinite"]):
-                    self.logger.log(f"WARNING: non-finite loss at step {step}")
-                self.logger.log_metrics(step, line)
-                if self.stats_client is not None:
-                    self.stats_client.log_metrics(step, line)
-                window_tokens = 0
-                window_start = time.perf_counter()
+                        jax.block_until_ready(self.state["step"])
+                        _prof.stop_trace()
+                        prof_active = False
+                        self.logger.log(
+                            f"profiler: trace written to {os.path.join(self.run_dir, 'profile')}"
+                        )
+                    elif prof_start <= step < prof_stop and not prof_active:
+                        import jax.profiler as _prof
 
-            if val_int and step % val_int == 0:
-                v = self.validate()
-                if v is not None:
-                    self.logger.log_validation(step, v)
-                    self.val_history["steps"].append(step)
-                    self.val_history["losses"].append(v)
-                    if self.early_stopping.update(v):
-                        self.logger.log(f"Early stopping triggered at step {step}")
-                        stopped_early = True
+                        _prof.start_trace(os.path.join(self.run_dir, "profile"))
+                        prof_active = True
+                        self.logger.log(f"profiler: trace started at step {step}")
+                try:
+                    batch = self.data.generate_batch(step - 1)
+                except StopIteration:  # finite stream ran dry (streaming sources)
+                    self.logger.log(f"Data stream exhausted before step {step}; stopping")
+                    break
+                # Host-side token count (non-pad targets) so tok/s stays correct
+                # even when device metrics are only read every log_int steps.
+                step_tokens = int(batch["mask"].sum()) * jax.process_count()
+                window_tokens += step_tokens
+                self.total_tokens += step_tokens
+                self.state, metrics = self.train_step(self.state, _device_batch(batch))
 
-            if cfg.logging.log_samples and val_int and step % val_int == 0:
-                self.generate_samples(step)
+                if step % log_int == 0 or step == self.total_steps:
+                    loss = float(metrics["loss"])  # device sync point
+                    last_loss = loss
+                    elapsed = max(time.perf_counter() - window_start, 1e-9)
+                    line = {
+                        "loss": loss,
+                        "ppl": float(math.exp(min(loss, 30.0))),
+                        "lr": float(self.schedule(jnp.asarray(step))),
+                        "tok/s": window_tokens / elapsed,
+                        "toks": int(window_tokens),
+                    }
+                    if "grad_norm" in metrics:
+                        line["grad_norm"] = float(metrics["grad_norm"])
+                    if int(metrics["nonfinite"]):
+                        self.logger.log(f"WARNING: non-finite loss at step {step}")
+                    self.logger.log_metrics(step, line)
+                    if self.stats_client is not None:
+                        self.stats_client.log_metrics(step, line)
+                    window_tokens = 0
+                    window_start = time.perf_counter()
 
-            if ckpt_int and step % ckpt_int == 0:
-                self.save_checkpoint(step)
+                if val_int and step % val_int == 0:
+                    v = self.validate()
+                    if v is not None:
+                        self.logger.log_validation(step, v)
+                        self.val_history["steps"].append(step)
+                        self.val_history["losses"].append(v)
+                        if self.early_stopping.update(v):
+                            self.logger.log(f"Early stopping triggered at step {step}")
+                            stopped_early = True
 
-            if stopped_early:
-                break
+                if cfg.logging.log_samples and val_int and step % val_int == 0:
+                    self.generate_samples(step)
+
+                saved_this_step = bool(ckpt_int and step % ckpt_int == 0)
+                if saved_this_step:
+                    self.save_checkpoint(step)
+
+                if self._preempted:
+                    self.logger.log(
+                        f"Preemption signal received: saving checkpoint at step {step} and exiting"
+                    )
+                    if not saved_this_step:
+                        self.save_checkpoint(step)
+                    break
+
+                if stopped_early:
+                    break
+
+        finally:
+            if prof_active:
+                import jax.profiler as _prof
+
+                jax.block_until_ready(self.state["step"])
+                _prof.stop_trace()
+            if prev_handlers:
+                import signal as _signal
+
+                for sig, h in prev_handlers.items():
+                    _signal.signal(sig, h)
 
         step = int(self.state["step"])
         if self.val_history["steps"] and self.val_history["steps"][-1] == step:

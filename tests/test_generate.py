@@ -91,3 +91,39 @@ def test_long_prompt_prefill_chunking():
     toks, _ = generate_lite(PARAMS, ARGS, prompt, max_tokens=4, prefill_step_size=32)
     toks2, _ = generate_lite(PARAMS, ARGS, prompt, max_tokens=4, prefill_step_size=512)
     assert toks == toks2
+
+
+def test_kv_quant_cache_matches_fp32_closely():
+    # int8 per-(position, head) symmetric quantization: greedy decode should
+    # agree with the fp32 cache on a random-init model.
+    prompt = [1, 5, 9, 3]
+    toks_fp, _ = generate_lite(PARAMS, ARGS, prompt, max_tokens=8)
+    toks_q, _ = generate_lite(PARAMS, ARGS, prompt, max_tokens=8, kv_quant=True)
+    # identical tokens expected at this scale; allow <=1 divergence tail
+    agree = sum(a == b for a, b in zip(toks_fp, toks_q))
+    assert agree >= len(toks_fp) - 1, (toks_fp, toks_q)
+
+
+def test_kv_quant_cache_buffers_are_int8():
+    cache = llama.init_cache(ARGS, 1, max_len=32, quantize=True)
+    assert cache[0]["k_q"].dtype == jnp.int8
+    assert cache[0]["v_q"].dtype == jnp.int8
+    assert cache[0]["k_s"].shape == (1, 32, ARGS.num_kv_heads, 1)
+    # int8 buffers + scales are ~4x smaller than fp32 K/V
+    q_bytes = cache[0]["k_q"].nbytes + cache[0]["k_s"].nbytes
+    full = llama.init_cache(ARGS, 1, max_len=32)
+    assert q_bytes < full[0]["k"].nbytes / 2
+
+
+def test_kv_quant_decode_logits_close_to_full_forward():
+    tokens = np.random.default_rng(0).integers(1, 60, size=(1, 12)).astype(np.int32)
+    full_logits, _ = llama.forward(PARAMS, jnp.asarray(tokens), ARGS)
+    cache = llama.init_cache(ARGS, 1, max_len=16, quantize=True)
+    logits, cache = llama.forward(PARAMS, jnp.asarray(tokens[:, :8]), ARGS,
+                                  cache=cache, start_pos=0)
+    for i in range(8, 12):
+        logits, cache = llama.forward(PARAMS, jnp.asarray(tokens[:, i:i + 1]), ARGS,
+                                      cache=cache, start_pos=i)
+    np.testing.assert_allclose(
+        np.asarray(logits[0, -1]), np.asarray(full_logits[0, -1]), atol=0.05, rtol=0.05
+    )

@@ -184,3 +184,42 @@ def test_lr_finder(tmp_path):
     tr = Trainer(cfg, runs_root=str(tmp_path / "runs"), quiet=True)
     tr.train()
     assert os.path.isfile(os.path.join(tr.run_dir, "lr_finder.csv"))
+
+
+def test_sigterm_saves_checkpoint_and_exits(tmp_path):
+    """Preemption-aware checkpointing: SIGTERM mid-run saves and stops."""
+    import signal
+    import threading
+
+    cfg = _tiny_config(tmp_path, name="preempt", iters=100000,
+                       **{"logging.steps.checkpoint_interval": 100000,
+                          "logging.steps.validation_interval": 0})
+    tr = Trainer(cfg, runs_root=str(tmp_path / "runs"), quiet=True)
+    killer = threading.Timer(3.0, lambda: os.kill(os.getpid(), signal.SIGTERM))
+    killer.start()
+    result = tr.train()
+    killer.cancel()
+    assert result["steps"] < 100000  # stopped early
+    log = open(os.path.join(tr.run_dir, "log.txt")).read()
+    assert "Preemption signal received" in log
+    ckpts = os.listdir(os.path.join(tr.run_dir, "checkpoints"))
+    # both the preemption checkpoint and the final save exist
+    assert any(c.startswith("step_") and c.endswith("_model.safetensors") for c in ckpts)
+    assert "step_final_model.safetensors" in ckpts
+
+
+def test_profiler_trace_window(tmp_path):
+    cfg = _tiny_config(tmp_path, name="prof", iters=6,
+                       **{"logging.steps.validation_interval": 0,
+                          "logging.profile_start": 2,
+                          "logging.profile_stop": 4})
+    tr = Trainer(cfg, runs_root=str(tmp_path / "runs"), quiet=True)
+    tr.train()
+    prof_dir = os.path.join(tr.run_dir, "profile")
+    assert os.path.isdir(prof_dir)
+    found = []
+    for root, _, files in os.walk(prof_dir):
+        found.extend(files)
+    assert found, "profiler produced no trace files"
+    log = open(os.path.join(tr.run_dir, "log.txt")).read()
+    assert "profiler: trace started at step 2" in log
