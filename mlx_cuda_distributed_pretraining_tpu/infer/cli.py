@@ -26,6 +26,9 @@ def main(argv=None) -> str:
     from ..train.trainer import load_trained
     from .generate import beam_search, generate_text
 
+    if args.beams > 0 and args.kv_quant:
+        parser.error("--kv-quant is not supported with --beams (beam search "
+                     "uses the fp32 cache)")
     params, margs, tok, _ = load_trained(args.run, runs_root=args.runs_root)
     if args.beams > 0:
         ids = [tok.bos_id] + tok.tokenize(args.prompt)

@@ -186,12 +186,23 @@ def main(argv=None):
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8765)
     parser.add_argument("--persist", default=None, help="JSON persistence path")
+    parser.add_argument("--http-port", type=int, default=0,
+                        help="also serve the live dashboard page on this port")
     a = parser.parse_args(argv)
     server = StatsServer(a.host, a.port, a.persist)
+    httpd = None
+    if a.http_port:
+        from .dashboard import serve_dashboard
+
+        httpd = serve_dashboard(a.host, a.http_port, ws_port=a.port)
+        print(f"dashboard: http://{a.host}:{a.http_port}/ (ws on :{a.port})")
     try:
         asyncio.run(server.serve())
     except KeyboardInterrupt:  # pragma: no cover - interactive
         pass
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
 
 
 if __name__ == "__main__":

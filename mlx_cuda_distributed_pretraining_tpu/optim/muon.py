@@ -58,9 +58,20 @@ def scale_by_muon(momentum: float = 0.95, nesterov: bool = True, ns_steps: int =
         eff = tree_map(lambda m, g: momentum * m + g.astype(jnp.float32), mu, grads) if nesterov else mu
 
         def orth(m):
-            o = newton_schulz5(m, ns_steps)
+            # Stacked layouts (pipeline [L, m, n] slabs, MoE expert banks
+            # [E, m, n], both combined [L, E, m, n]): orthogonalize each
+            # trailing matrix independently via vmap — identical math to
+            # per-matrix Muon.
+            if m.ndim >= 3:
+                import jax
+
+                flat = m.reshape((-1,) + m.shape[-2:])
+                o = jax.vmap(lambda x: newton_schulz5(x, ns_steps))(flat)
+                o = o.reshape(m.shape)
+            else:
+                o = newton_schulz5(m, ns_steps)
             # Match update RMS to SGD-like magnitude: sqrt(max(1, rows/cols))
-            scale = jnp.sqrt(jnp.maximum(1.0, m.shape[0] / m.shape[1]))
+            scale = jnp.sqrt(jnp.maximum(1.0, m.shape[-2] / m.shape[-1]))
             return o * scale
 
         return tree_map(orth, eff), {"mu": mu}
@@ -69,9 +80,10 @@ def scale_by_muon(momentum: float = 0.95, nesterov: bool = True, ns_steps: int =
 
 
 def matrix_label_fn(params):
-    """2-D params (excluding embeddings is the caller's choice; the reference
-    routes purely on ndim — optimizers/muon.py:119-138)."""
-    return tree_map(lambda p: "matrix" if jnp.ndim(p) == 2 else "rest", params)
+    """2-D params get NS5 (the reference routes purely on ndim —
+    optimizers/muon.py:119-138). Leaves with ndim>=3 are stacked matrices
+    (pipeline layer slabs, MoE expert banks) and get batched NS5."""
+    return tree_map(lambda p: "matrix" if jnp.ndim(p) >= 2 else "rest", params)
 
 
 def muon(

@@ -398,29 +398,6 @@ class Trainer:
         val_int = cfg.logging.validation_interval
         self.maybe_run_lr_finder()
 
-        # Preemption-aware checkpointing (SURVEY.md §5 failure-detection
-        # plan; the reference's only recovery story is checkpoint-resume):
-        # SIGTERM/SIGINT set a flag; the loop saves and exits cleanly at the
-        # next step boundary.
-        self._preempted = False
-        prev_handlers = {}
-
-        def _on_signal(signum, frame):
-            self._preempted = True
-            # restore the previous handler so a second signal (e.g. a
-            # repeated Ctrl-C during a hung step) terminates immediately
-            import signal as _signal
-
-            _signal.signal(signum, prev_handlers.get(signum, _signal.SIG_DFL))
-
-        try:
-            import signal as _signal
-
-            for sig in (_signal.SIGTERM, _signal.SIGINT):
-                prev_handlers[sig] = _signal.signal(sig, _on_signal)
-        except (ValueError, OSError):  # non-main thread: no signal hooks
-            prev_handlers = {}
-
         # Optional jax.profiler trace window [profile_start, profile_stop).
         prof_start = int(cfg.logging.profile_start or 0)
         prof_stop = int(cfg.logging.profile_stop or 0)
@@ -437,6 +414,33 @@ class Trainer:
         window_start = time.perf_counter()
         last_loss = float("nan")
         stopped_early = False
+
+        # Preemption-aware checkpointing (SURVEY.md §5 failure-detection
+        # plan; the reference's only recovery story is checkpoint-resume):
+        # SIGTERM/SIGINT set a flag; the loop saves and exits cleanly at the
+        # next step boundary. Installed immediately before the try/finally
+        # that restores them, so no exception can leak the handlers.
+        self._preempted = False
+        prev_handlers = {}
+
+        def _on_signal(signum, frame):
+            self._preempted = True
+            # restore the previous handler so a second signal (e.g. a
+            # repeated Ctrl-C during a hung step) terminates immediately
+            import signal as _signal
+
+            _signal.signal(signum, prev_handlers.get(signum, _signal.SIG_DFL))
+
+        try:
+            import signal as _signal
+
+            for sig in (_signal.SIGTERM, _signal.SIGINT):
+                # signal() returns None for handlers installed by non-Python
+                # code; None is not restorable — map it to SIG_DFL.
+                prev = _signal.signal(sig, _on_signal)
+                prev_handlers[sig] = prev if prev is not None else _signal.SIG_DFL
+        except (ValueError, OSError):  # non-main thread: no signal hooks
+            prev_handlers = {}
 
         try:
             for step in range(self.start_step + 1, self.total_steps + 1):

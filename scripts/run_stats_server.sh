@@ -5,5 +5,6 @@ set -euo pipefail
 HOST="${1:-127.0.0.1}"
 PORT="${2:-8765}"
 PERSIST="${3:-stats.json}"
+HTTP_PORT="${4:-8080}"   # live dashboard page; 0 disables
 exec python -m mlx_cuda_distributed_pretraining_tpu.obs.stats_server \
-  --host "$HOST" --port "$PORT" --persist "$PERSIST"
+  --host "$HOST" --port "$PORT" --persist "$PERSIST" --http-port "$HTTP_PORT"

@@ -166,3 +166,37 @@ def test_stats_client_offline_buffering():
     time.sleep(0.5)
     client.close()
     assert len(client._buffer) == 5
+
+
+def test_dashboard_page_and_http_server():
+    """The live dashboard (reference: hybrid_distributed_patch.py's embedded
+    Chart.js page) is self-contained HTML served over HTTP."""
+    import urllib.request
+
+    from mlx_cuda_distributed_pretraining_tpu.obs.dashboard import (
+        DASHBOARD_HTML,
+        serve_dashboard,
+        write_dashboard,
+    )
+
+    # self-contained: no external asset references (offline pods)
+    assert "http://" not in DASHBOARD_HTML.replace("ws://", "").replace(
+        "http://\" + location.hostname", "")
+    for needle in ('id="loss"', 'id="tput"', 'id="workers"', "WebSocket",
+                   "--series-1", "prefers-color-scheme: dark", "initial_state"):
+        assert needle in DASHBOARD_HTML, needle
+
+    srv = serve_dashboard("127.0.0.1", 0)
+    try:
+        port = srv.server_address[1]
+        html = urllib.request.urlopen(f"http://127.0.0.1:{port}/").read().decode()
+        assert 'id="loss"' in html
+    finally:
+        srv.shutdown()
+
+
+def test_dashboard_write(tmp_path):
+    from mlx_cuda_distributed_pretraining_tpu.obs.dashboard import write_dashboard
+
+    p = write_dashboard(str(tmp_path / "sub" / "dashboard.html"))
+    assert open(p).read().startswith("<!DOCTYPE html>")
