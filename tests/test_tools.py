@@ -248,3 +248,29 @@ def test_compare_optimizers(tmp_path):
     csv_path = compare_optimizers.write_outputs(results, str(tmp_path / "out"))
     header = open(csv_path).readline().strip().split(",")
     assert header == ["step", "adamw", "muon"]
+
+
+def test_hf_export_loads_in_transformers_with_matching_logits(trained_run, tmp_path):
+    """The strongest parity check: the exported directory loads with real
+    ``transformers.LlamaForCausalLM`` (torch CPU) and produces the same
+    logits as our JAX forward (reference flow: README.md:101-125 feeds the
+    exported model to the mlx-lm/lm-eval ecosystem)."""
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+    import jax.numpy as jnp
+
+    from mlx_cuda_distributed_pretraining_tpu.models import llama
+    from mlx_cuda_distributed_pretraining_tpu.train.trainer import load_trained
+
+    out = str(tmp_path / "hf_export")
+    convert_to_hf.convert_run(trained_run, out)
+
+    model = transformers.LlamaForCausalLM.from_pretrained(out)
+    model.eval()
+
+    params, args, tok, _ = load_trained(trained_run)
+    x = np.array([[1, 5, 9, 7, 3, 11]], dtype=np.int32)
+    ours, _ = llama.forward(params, jnp.asarray(x), args)
+    with torch.no_grad():
+        theirs = model(torch.from_numpy(x.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, rtol=2e-4, atol=2e-4)
