@@ -23,25 +23,40 @@ import numpy as np
 
 
 def hf_state_dict(params: Dict[str, Any], tie_word_embeddings: bool) -> Dict[str, np.ndarray]:
-    """Map our pytree to HF-Llama parameter names (transposing projections)."""
+    """Map our pytree to HF parameter names (transposing projections).
+
+    Dense models use the Llama layout; MoE models (``feed_forward.router``)
+    use the Mixtral layout — ``block_sparse_moe.gate`` + per-expert
+    ``experts.N.w1/w2/w3`` (w1=gate, w2=down, w3=up)."""
     out: Dict[str, np.ndarray] = {}
 
     def t(x):
-        return np.asarray(x).T
+        return np.ascontiguousarray(np.asarray(x).T)
 
     out["model.embed_tokens.weight"] = np.asarray(params["tok_embeddings"]["weight"])
     for i, layer in enumerate(params["layers"]):
         pre = f"model.layers.{i}"
         att, ffn = layer["attention"], layer["feed_forward"]
         out[f"{pre}.input_layernorm.weight"] = np.asarray(layer["attention_norm"]["weight"])
-        out[f"{pre}.self_attn.q_proj.weight"] = t(att["wq"]["weight"])
-        out[f"{pre}.self_attn.k_proj.weight"] = t(att["wk"]["weight"])
-        out[f"{pre}.self_attn.v_proj.weight"] = t(att["wv"]["weight"])
-        out[f"{pre}.self_attn.o_proj.weight"] = t(att["wo"]["weight"])
+        for ours, theirs in (("wq", "q_proj"), ("wk", "k_proj"), ("wv", "v_proj"), ("wo", "o_proj")):
+            out[f"{pre}.self_attn.{theirs}.weight"] = t(att[ours]["weight"])
+            if "bias" in att[ours]:
+                out[f"{pre}.self_attn.{theirs}.bias"] = np.asarray(att[ours]["bias"])
         out[f"{pre}.post_attention_layernorm.weight"] = np.asarray(layer["ffn_norm"]["weight"])
-        out[f"{pre}.mlp.gate_proj.weight"] = t(ffn["w_gate"]["weight"])
-        out[f"{pre}.mlp.up_proj.weight"] = t(ffn["w_up"]["weight"])
-        out[f"{pre}.mlp.down_proj.weight"] = t(ffn["w_down"]["weight"])
+        if "router" in ffn:
+            moe_pre = f"{pre}.block_sparse_moe"
+            out[f"{moe_pre}.gate.weight"] = t(ffn["router"]["weight"])  # [E, D]
+            wg = np.asarray(ffn["experts"]["w_gate"]["weight"])  # [E, D, I]
+            wu = np.asarray(ffn["experts"]["w_up"]["weight"])
+            wd = np.asarray(ffn["experts"]["w_down"]["weight"])  # [E, I, D]
+            for e in range(wg.shape[0]):
+                out[f"{moe_pre}.experts.{e}.w1.weight"] = t(wg[e])  # [I, D]
+                out[f"{moe_pre}.experts.{e}.w2.weight"] = t(wd[e])  # [D, I]
+                out[f"{moe_pre}.experts.{e}.w3.weight"] = t(wu[e])  # [I, D]
+        else:
+            out[f"{pre}.mlp.gate_proj.weight"] = t(ffn["w_gate"]["weight"])
+            out[f"{pre}.mlp.up_proj.weight"] = t(ffn["w_up"]["weight"])
+            out[f"{pre}.mlp.down_proj.weight"] = t(ffn["w_down"]["weight"])
     out["model.norm.weight"] = np.asarray(params["norm"]["weight"])
     if not tie_word_embeddings and "output" in params:
         out["lm_head.weight"] = t(params["output"]["weight"])
@@ -49,8 +64,47 @@ def hf_state_dict(params: Dict[str, Any], tie_word_embeddings: bool) -> Dict[str
 
 
 def hf_config(args: Any, tie_word_embeddings: bool) -> Dict[str, Any]:
-    """HF config.json for LlamaForCausalLM (reference: tools/
-    convert-to-mlx-lm.py:59-89 emits the same architecture block)."""
+    """HF config.json: LlamaForCausalLM, or MixtralForCausalLM for MoE
+    (reference: tools/convert-to-mlx-lm.py:59-89 emits the Llama block)."""
+    if getattr(args, "is_moe", False):
+        if args.attention_bias:
+            raise ValueError(
+                "Mixtral has no attention-bias parameters; an MoE model with "
+                "attention_bias=true cannot be exported to HF format"
+            )
+        if float(args.moe_capacity_factor) < float(args.num_local_experts):
+            import warnings
+
+            warnings.warn(
+                f"moe_capacity_factor={args.moe_capacity_factor} < num experts: "
+                "capacity routing may drop tokens, but HF Mixtral never drops — "
+                "exported-model logits can differ from the source on unbalanced "
+                "batches",
+                stacklevel=2,
+            )
+        return {
+            "architectures": ["MixtralForCausalLM"],
+            "model_type": "mixtral",
+            "vocab_size": int(args.vocab_size),
+            "hidden_size": int(args.hidden_size),
+            "intermediate_size": int(args.intermediate_size),
+            "num_hidden_layers": int(args.num_layers),
+            "num_attention_heads": int(args.num_heads),
+            "num_key_value_heads": int(args.num_kv_heads),
+            "head_dim": int(args.head_dim),
+            "hidden_act": "silu",
+            "max_position_embeddings": int(args.max_position_embeddings),
+            "rms_norm_eps": float(args.rms_norm_eps),
+            "rope_theta": float(args.rope_theta),
+            "sliding_window": None,  # older MixtralConfig defaults to 4096
+            "tie_word_embeddings": bool(tie_word_embeddings),
+            "num_local_experts": int(args.num_local_experts),
+            "num_experts_per_tok": int(args.num_experts_per_tok),
+            "router_aux_loss_coef": float(args.moe_aux_weight),
+            "torch_dtype": "float32",
+            "bos_token_id": 1,
+            "eos_token_id": 2,
+        }
     return {
         "architectures": ["LlamaForCausalLM"],
         "model_type": "llama",

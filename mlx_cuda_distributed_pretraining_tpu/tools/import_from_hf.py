@@ -1,0 +1,172 @@
+"""Import an HF-Llama checkpoint into our pytree format.
+
+The reference's ``Model.load_weights`` tolerantly accepts HF-format
+safetensors/torch files (reference: models/llama.py:414-477 non-strict
+filtering); here the same capability is the inverse of tools/convert_to_hf:
+map ``model.layers.N.*`` HF names back to our nested pytree (transposing
+``nn.Linear`` ``[out, in]`` weights to our ``[in, out]`` MXU layout), so a
+published Llama checkpoint can seed continued pretraining on TPU.
+
+Usage:
+    python -m mlx_cuda_distributed_pretraining_tpu.tools.import_from_hf \
+        --hf-dir /path/to/hf_model --out runs/<name>/checkpoints
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def our_params_from_hf(
+    sd: Dict[str, np.ndarray], num_layers: int, strict: bool = False
+) -> Dict[str, Any]:
+    """HF-Llama state dict → our pytree. Unknown keys are ignored (the
+    reference's loader is likewise non-strict); missing required keys raise
+    unless ``strict=False`` leaves gaps for the caller to fill."""
+
+    def t(name):
+        return np.ascontiguousarray(np.asarray(sd[name]).T)
+
+    def get(name):
+        return np.asarray(sd[name])
+
+    params: Dict[str, Any] = {
+        "tok_embeddings": {"weight": get("model.embed_tokens.weight")},
+        "norm": {"weight": get("model.norm.weight")},
+        "layers": [],
+    }
+    for i in range(num_layers):
+        pre = f"model.layers.{i}"
+        try:
+            if f"{pre}.block_sparse_moe.gate.weight" in sd:
+                # Mixtral MoE layout → stacked expert banks
+                moe_pre = f"{pre}.block_sparse_moe"
+                E = 0
+                while f"{moe_pre}.experts.{E}.w1.weight" in sd:
+                    E += 1
+                ff = {
+                    "router": {"weight": t(f"{moe_pre}.gate.weight")},  # [D, E]
+                    "experts": {
+                        "w_gate": {"weight": np.stack(
+                            [t(f"{moe_pre}.experts.{e}.w1.weight") for e in range(E)])},
+                        "w_down": {"weight": np.stack(
+                            [t(f"{moe_pre}.experts.{e}.w2.weight") for e in range(E)])},
+                        "w_up": {"weight": np.stack(
+                            [t(f"{moe_pre}.experts.{e}.w3.weight") for e in range(E)])},
+                    },
+                }
+            else:
+                ff = {
+                    "w_gate": {"weight": t(f"{pre}.mlp.gate_proj.weight")},
+                    "w_up": {"weight": t(f"{pre}.mlp.up_proj.weight")},
+                    "w_down": {"weight": t(f"{pre}.mlp.down_proj.weight")},
+                }
+            layer = {
+                "attention_norm": {"weight": get(f"{pre}.input_layernorm.weight")},
+                "ffn_norm": {"weight": get(f"{pre}.post_attention_layernorm.weight")},
+                "attention": {
+                    "wq": {"weight": t(f"{pre}.self_attn.q_proj.weight")},
+                    "wk": {"weight": t(f"{pre}.self_attn.k_proj.weight")},
+                    "wv": {"weight": t(f"{pre}.self_attn.v_proj.weight")},
+                    "wo": {"weight": t(f"{pre}.self_attn.o_proj.weight")},
+                },
+                "feed_forward": ff,
+            }
+        except KeyError:
+            if strict:
+                raise
+            break
+        for proj in ("q", "k", "v", "o"):
+            bias = f"{pre}.self_attn.{proj}_proj.bias"
+            if bias in sd:
+                layer["attention"][f"w{proj}"]["bias"] = get(bias)
+        params["layers"].append(layer)
+    if "lm_head.weight" in sd:
+        params["output"] = {"weight": np.ascontiguousarray(np.asarray(sd["lm_head.weight"]).T)}
+    return params
+
+
+def model_args_from_hf_config(cfg: Dict[str, Any], vocab_size: Optional[int] = None):
+    """HF config.json → LlamaArgs."""
+    from ..models.llama import LlamaArgs
+
+    heads = int(cfg["num_attention_heads"])
+    hidden = int(cfg["hidden_size"])
+    return LlamaArgs(
+        vocab_size=int(vocab_size or cfg["vocab_size"]),
+        hidden_size=hidden,
+        intermediate_size=int(cfg["intermediate_size"]),
+        num_layers=int(cfg["num_hidden_layers"]),
+        num_heads=heads,
+        num_kv_heads=int(cfg.get("num_key_value_heads", heads)),
+        head_dim=int(cfg.get("head_dim") or hidden // heads),
+        max_position_embeddings=int(cfg.get("max_position_embeddings", 4096)),
+        rms_norm_eps=float(cfg.get("rms_norm_eps", 1e-5)),
+        rope_theta=float(cfg.get("rope_theta", 10000.0)),
+        attention_bias=bool(cfg.get("attention_bias", False)),
+        mlp_bias=bool(cfg.get("mlp_bias", False)),
+        tie_word_embeddings=bool(cfg.get("tie_word_embeddings", True)),
+        num_local_experts=int(cfg.get("num_local_experts", 0) or 0),
+        num_experts_per_tok=int(cfg.get("num_experts_per_tok", 0) or 0),
+        moe_aux_weight=float(cfg.get("router_aux_loss_coef", 0.01) or 0.0),
+        # HF Mixtral has no expert capacity (never drops tokens); a
+        # capacity_factor of E makes our dispatch provably drop-free, so the
+        # imported model computes the same function.
+        moe_capacity_factor=float(cfg.get("num_local_experts", 0) or 1),
+    )
+
+
+def import_hf_dir(hf_dir: str):
+    """Load (params, args) from an HF-Llama model directory (single- or
+    multi-shard safetensors)."""
+    from ..checkpoint.safetensors_io import load_safetensors
+
+    with open(os.path.join(hf_dir, "config.json")) as f:
+        cfg = json.load(f)
+
+    sd: Dict[str, np.ndarray] = {}
+    index = os.path.join(hf_dir, "model.safetensors.index.json")
+    if os.path.isfile(index):
+        with open(index) as f:
+            shards = sorted(set(json.load(f)["weight_map"].values()))
+        for shard in shards:
+            tensors, _meta = load_safetensors(os.path.join(hf_dir, shard))
+            sd.update(tensors)
+    else:
+        sd, _meta = load_safetensors(os.path.join(hf_dir, "model.safetensors"))
+
+    args = model_args_from_hf_config(cfg)
+    params = our_params_from_hf(sd, args.num_layers)
+    if len(params["layers"]) != args.num_layers:
+        raise ValueError(
+            f"found {len(params['layers'])} layers in weights, config says {args.num_layers}"
+        )
+    return params, args
+
+
+def main(argv=None):
+    from ..checkpoint.safetensors_io import save_safetensors
+    from ..utils.tree import flatten_dict
+
+    parser = argparse.ArgumentParser(description="Import an HF-Llama checkpoint")
+    parser.add_argument("--hf-dir", required=True)
+    parser.add_argument("--out", required=True,
+                        help="output directory for step_final_model.safetensors")
+    a = parser.parse_args(argv)
+    params, args = import_hf_dir(a.hf_dir)
+    os.makedirs(a.out, exist_ok=True)
+    flat = {k: np.asarray(v) for k, v in flatten_dict(params).items()}
+    out_file = os.path.join(a.out, "step_final_model.safetensors")
+    save_safetensors(out_file, flat)
+    n = sum(v.size for v in flat.values())
+    print(f"imported {len(flat)} tensors ({n/1e6:.1f}M params) -> {out_file}")
+    print(f"model args: {args}")
+
+
+if __name__ == "__main__":
+    main()

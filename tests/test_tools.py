@@ -25,16 +25,21 @@ def _write_jsonl(path, texts):
             f.write(json.dumps({"text": t}) + "\n")
 
 
-@pytest.fixture(scope="module")
-def trained_run(tmp_path_factory):
-    """One tiny trained run shared by export/inspect/CLI tests."""
+def _train_tiny_run(tmp, name, iters=10, model_extra=None, val_interval=5):
+    """Build + train the shared tiny run used by the tools fixtures."""
     from mlx_cuda_distributed_pretraining_tpu.train.trainer import Trainer
 
-    tmp = tmp_path_factory.mktemp("toolrun")
     train = tmp / "train.jsonl"
     _write_jsonl(train, ["the quick brown fox jumps over the lazy dog " * 3] * 30)
+    model = {
+        "architecture": "llama",
+        "dimensions": {"hidden_size": 32, "intermediate_size": 64, "num_layers": 2},
+        "attention": {"num_heads": 4, "num_kv_heads": 2, "head_dim": 8},
+        "misc": {"tie_word_embeddings": False},
+    }
+    model.update(model_extra or {})
     cfg = Config.from_dict({
-        "name": "tooltest",
+        "name": name,
         "overwrite": True,
         "data": {
             "input_file": str(train),
@@ -42,24 +47,26 @@ def trained_run(tmp_path_factory):
             "preprocessing": {"max_context_size": 48},
             "tokenizer": {"normal_vocab_size": 256},
         },
-        "model": {
-            "architecture": "llama",
-            "dimensions": {"hidden_size": 32, "intermediate_size": 64, "num_layers": 2},
-            "attention": {"num_heads": 4, "num_kv_heads": 2, "head_dim": 8},
-            "misc": {"tie_word_embeddings": False},
-        },
+        "model": model,
         "training": {
-            "hyperparameters": {"batch_size": 4, "learning_rate": 1e-2, "iters": 10},
+            "hyperparameters": {"batch_size": 4, "learning_rate": 1e-2, "iters": iters},
             "optimization": {"optimizer": "adamw"},
         },
         "logging": {
-            "steps": {"logging_interval": 5, "checkpoint_interval": 0, "validation_interval": 5},
+            "steps": {"logging_interval": 5, "checkpoint_interval": 0,
+                      "validation_interval": val_interval},
         },
         "system": {"seed": 0},
     })
     tr = Trainer(cfg, runs_root=str(tmp / "runs"), quiet=True)
     tr.train()
     return tr.run_dir
+
+
+@pytest.fixture(scope="module")
+def trained_run(tmp_path_factory):
+    """One tiny trained run shared by export/inspect/CLI tests."""
+    return _train_tiny_run(tmp_path_factory.mktemp("toolrun"), "tooltest")
 
 
 def test_train_tokenizer(tmp_path):
@@ -274,3 +281,112 @@ def test_hf_export_loads_in_transformers_with_matching_logits(trained_run, tmp_p
     with torch.no_grad():
         theirs = model(torch.from_numpy(x.astype(np.int64))).logits.numpy()
     np.testing.assert_allclose(np.asarray(ours), theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_import_from_hf_roundtrip(trained_run, tmp_path):
+    """export → import returns the identical pytree (tools/import_from_hf
+    is the inverse of convert_to_hf; reference parity: models/llama.py
+    :414-477 tolerant HF weight loading)."""
+    import jax
+
+    from mlx_cuda_distributed_pretraining_tpu.tools import import_from_hf
+    from mlx_cuda_distributed_pretraining_tpu.train.trainer import load_trained
+
+    out = str(tmp_path / "hf_export")
+    convert_to_hf.convert_run(trained_run, out)
+    params2, args2 = import_from_hf.import_hf_dir(out)
+
+    params, args, _, _ = load_trained(trained_run)
+    assert args2.num_layers == args.num_layers
+    assert args2.num_kv_heads == args.num_kv_heads
+    a = {k: v for k, v in
+         jax.tree_util.tree_flatten_with_path(params)[0]}
+    b = {k: v for k, v in
+         jax.tree_util.tree_flatten_with_path(params2)[0]}
+    assert set(map(str, a)) == set(map(str, b))
+    for k in a:
+        np.testing.assert_allclose(np.asarray(a[k]), np.asarray(b[k]), rtol=1e-6,
+                                   err_msg=str(k))
+
+
+def test_import_from_hf_cli(trained_run, tmp_path, capsys):
+    from mlx_cuda_distributed_pretraining_tpu.checkpoint.manager import CheckpointManager
+    from mlx_cuda_distributed_pretraining_tpu.tools import import_from_hf
+    from mlx_cuda_distributed_pretraining_tpu.train.trainer import load_trained
+
+    out = str(tmp_path / "hf_export")
+    convert_to_hf.convert_run(trained_run, out)
+    ckpt_dir = str(tmp_path / "imported")
+    import_from_hf.main(["--hf-dir", out, "--out", ckpt_dir])
+    assert "imported" in capsys.readouterr().out
+    params, _, _, _ = load_trained(trained_run)
+    loaded = CheckpointManager.load_params(
+        os.path.join(ckpt_dir, "step_final_model.safetensors"), like=params)
+    for a, b in zip(*(map(lambda t: __import__("jax").tree_util.tree_leaves(t),
+                          (params, loaded)))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+@pytest.fixture(scope="module")
+def moe_run(tmp_path_factory):
+    """Tiny trained MoE run for Mixtral-format export tests.
+    capacity_factor = num experts => capacity == all tokens: no drops, so
+    routing matches Mixtral's (no-capacity) semantics."""
+    return _train_tiny_run(
+        tmp_path_factory.mktemp("moerun"), "moetool", iters=6, val_interval=0,
+        model_extra={"moe": {"num_local_experts": 4, "num_experts_per_tok": 2,
+                             "capacity_factor": 4.0, "aux_loss_weight": 0.01}},
+    )
+
+
+def test_moe_export_mixtral_layout(moe_run, tmp_path):
+    out = convert_to_hf.convert_run(moe_run, str(tmp_path / "mx"))
+    with open(os.path.join(out, "config.json")) as f:
+        cfg = json.load(f)
+    assert cfg["architectures"] == ["MixtralForCausalLM"]
+    assert cfg["num_local_experts"] == 4 and cfg["num_experts_per_tok"] == 2
+    from mlx_cuda_distributed_pretraining_tpu.checkpoint.safetensors_io import load_safetensors
+
+    tensors, _ = load_safetensors(os.path.join(out, "model.safetensors"))
+    assert "model.layers.0.block_sparse_moe.gate.weight" in tensors
+    assert "model.layers.0.block_sparse_moe.experts.3.w2.weight" in tensors
+
+
+def test_moe_export_loads_in_transformers_mixtral_with_matching_logits(moe_run, tmp_path):
+    """Our MoE block must BE Mixtral's function when capacity drops nothing:
+    softmax→top-k→renormalize equals Mixtral's softmax-over-selected."""
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+    import jax.numpy as jnp
+
+    from mlx_cuda_distributed_pretraining_tpu.models import llama
+    from mlx_cuda_distributed_pretraining_tpu.train.trainer import load_trained
+
+    out = convert_to_hf.convert_run(moe_run, str(tmp_path / "mx"))
+    model = transformers.MixtralForCausalLM.from_pretrained(out)
+    model.eval()
+
+    params, args, tok, _ = load_trained(moe_run)
+    x = np.array([[1, 5, 9, 7, 3, 11]], dtype=np.int32)
+    ours, _ = llama.forward(params, jnp.asarray(x), args)
+    with torch.no_grad():
+        theirs = model(torch.from_numpy(x.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_moe_import_from_hf_roundtrip(moe_run, tmp_path):
+    import jax
+
+    from mlx_cuda_distributed_pretraining_tpu.tools import import_from_hf
+    from mlx_cuda_distributed_pretraining_tpu.train.trainer import load_trained
+
+    out = convert_to_hf.convert_run(moe_run, str(tmp_path / "mx"))
+    params2, args2 = import_from_hf.import_hf_dir(out)
+    params, args, _, _ = load_trained(moe_run)
+    assert args2.num_local_experts == 4 and args2.num_experts_per_tok == 2
+    a = {str(k): v for k, v in jax.tree_util.tree_flatten_with_path(params)[0]}
+    b = {str(k): v for k, v in jax.tree_util.tree_flatten_with_path(params2)[0]}
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_allclose(np.asarray(a[k]), np.asarray(b[k]), rtol=1e-6,
+                                   err_msg=k)
