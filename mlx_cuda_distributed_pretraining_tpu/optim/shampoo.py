@@ -58,26 +58,35 @@ def shampoo_core(
     graft_type: str = "adam",
     eps: float = 1e-12,
 ) -> Transform:
-    """Preconditions 2-D gradients; other ranks pass through to the grafting
-    direction only."""
+    """Preconditions 2-D gradients (and 3-D stacked banks — pipeline layer
+    slabs, MoE experts — as vmapped independent matrices); other ranks pass
+    through to the grafting direction only."""
 
     def _sides(p):
-        m, n = (p.shape + (1, 1))[:2] if p.ndim >= 2 else (0, 0)
-        return (
-            p.ndim == 2 and m <= max_preconditioner_dim,
-            p.ndim == 2 and n <= max_preconditioner_dim,
-        )
+        if p.ndim < 2:
+            return False, False
+        m, n = p.shape[-2], p.shape[-1]
+        return m <= max_preconditioner_dim, n <= max_preconditioner_dim
 
     def init(params):
         def per_param(p):
             st = {}
-            if p.ndim == 2:
+            if p.ndim >= 2:
                 use_l, use_r = _sides(p)
-                m, n = p.shape
-                st["stats_l"] = jnp.zeros((m, m), jnp.float32) if use_l else jnp.zeros((m,), jnp.float32)
-                st["stats_r"] = jnp.zeros((n, n), jnp.float32) if use_r else jnp.zeros((n,), jnp.float32)
-                st["prec_l"] = jnp.eye(m, dtype=jnp.float32) if use_l else jnp.ones((m,), jnp.float32)
-                st["prec_r"] = jnp.eye(n, dtype=jnp.float32) if use_r else jnp.ones((n,), jnp.float32)
+                m, n = p.shape[-2], p.shape[-1]
+                lead = p.shape[:-2]  # () for 2-D, (B,) for stacked banks
+
+                def zeros(shape):
+                    return jnp.zeros(lead + shape, jnp.float32)
+
+                st["stats_l"] = zeros((m, m)) if use_l else zeros((m,))
+                st["stats_r"] = zeros((n, n)) if use_r else zeros((n,))
+                eye_l = jnp.eye(m, dtype=jnp.float32)
+                eye_r = jnp.eye(n, dtype=jnp.float32)
+                st["prec_l"] = (jnp.broadcast_to(eye_l, lead + (m, m)) if use_l
+                                else jnp.ones(lead + (m,), jnp.float32))
+                st["prec_r"] = (jnp.broadcast_to(eye_r, lead + (n, n)) if use_r
+                                else jnp.ones(lead + (n,), jnp.float32))
             # grafting (adam) state
             st["g_mu"] = jnp.zeros_like(p, jnp.float32)
             st["g_nu"] = jnp.zeros_like(p, jnp.float32)
@@ -105,30 +114,54 @@ def shampoo_core(
             new["g_mu"], new["g_nu"] = mu, nu
             graft_dir = (mu / bc1) / (jnp.sqrt(nu / bc2) + 1e-8) if graft_type == "adam" else g32
 
-            if g.ndim != 2:
+            if g.ndim < 2:
                 direction = graft_dir
             else:
                 use_l, use_r = _sides(g)
-                sl = st["stats_l"]
-                sr = st["stats_r"]
-                sl = beta2 * sl + (1 - beta2) * ((g32 @ g32.T) if use_l else jnp.sum(g32 * g32, axis=1))
-                sr = beta2 * sr + (1 - beta2) * ((g32.T @ g32) if use_r else jnp.sum(g32 * g32, axis=0))
+
+                def core2d(g2, gd2, sl, sr, pl_old, pr_old):
+                    """One matrix: stats EMA → (periodic) root → precondition
+                    → norm-transplant graft (reference: shampoo.py:297-312)."""
+                    sl = beta2 * sl + (1 - beta2) * ((g2 @ g2.T) if use_l else jnp.sum(g2 * g2, axis=1))
+                    sr = beta2 * sr + (1 - beta2) * ((g2.T @ g2) if use_r else jnp.sum(g2 * g2, axis=0))
+
+                    def recompute(_):
+                        pl = inverse_pth_root(sl, 4) if use_l else (sl + eps) ** -0.25
+                        pr = inverse_pth_root(sr, 4) if use_r else (sr + eps) ** -0.25
+                        return pl, pr
+
+                    pl, pr = jax.lax.cond(refresh, recompute, lambda _: (pl_old, pr_old), None)
+                    pg = (pl @ g2) if use_l else (pl[:, None] * g2)
+                    pg = (pg @ pr) if use_r else (pg * pr[None, :])
+                    pg_norm = jnp.linalg.norm(pg)
+                    graft_norm = jnp.linalg.norm(gd2)
+                    pg = pg * (graft_norm / jnp.maximum(pg_norm, eps))
+                    return pg, sl, sr, pl, pr
+
+                if g.ndim > 2:
+                    # stacked bank ([L,m,n], [E,m,n], or [L,E,m,n]): flatten
+                    # all leading dims, precondition each matrix, restore.
+                    lead = g.shape[:-2]
+
+                    def flat2(x):
+                        return x.reshape((-1,) + x.shape[len(lead):])
+
+                    pg, sl, sr, pl, pr = jax.vmap(core2d)(
+                        flat2(g32), flat2(graft_dir),
+                        flat2(st["stats_l"]), flat2(st["stats_r"]),
+                        flat2(st["prec_l"]), flat2(st["prec_r"]),
+                    )
+                    pg = pg.reshape(g.shape)
+                    sl, sr, pl, pr = (
+                        x.reshape(lead + x.shape[1:]) for x in (sl, sr, pl, pr)
+                    )
+                else:
+                    pg, sl, sr, pl, pr = core2d(
+                        g32, graft_dir, st["stats_l"], st["stats_r"],
+                        st["prec_l"], st["prec_r"],
+                    )
                 new["stats_l"], new["stats_r"] = sl, sr
-
-                def recompute(_):
-                    pl = inverse_pth_root(sl, 4) if use_l else (sl + eps) ** -0.25
-                    pr = inverse_pth_root(sr, 4) if use_r else (sr + eps) ** -0.25
-                    return pl, pr
-
-                pl, pr = jax.lax.cond(refresh, recompute, lambda _: (st["prec_l"], st["prec_r"]), None)
                 new["prec_l"], new["prec_r"] = pl, pr
-
-                pg = (pl @ g32) if use_l else (pl[:, None] * g32)
-                pg = (pg @ pr) if use_r else (pg * pr[None, :])
-                # norm-transplant grafting (reference: shampoo.py:297-312)
-                pg_norm = jnp.linalg.norm(pg)
-                graft_norm = jnp.linalg.norm(graft_dir)
-                pg = pg * (graft_norm / jnp.maximum(pg_norm, eps))
                 direction = jnp.where(active, pg, graft_dir)
 
             mom = momentum * st["mom"] + direction

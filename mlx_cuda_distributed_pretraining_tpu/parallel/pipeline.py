@@ -156,14 +156,15 @@ def pipeline_state_sharding(state: Any, mesh: Mesh, zero_level: int = 0) -> Any:
     ordered = sorted(param_specs, key=len, reverse=True)
 
     def opt_leaf(path, leaf):
+        from .sharding_rules import match_opt_leaf_spec
+
         k = _path_str(path)
         shape = np.shape(leaf)
         spec = P()
         if len(shape) > 0:
-            for p in ordered:
-                if (k == p or k.endswith("." + p)) and param_shapes[p] == shape:
-                    spec = param_specs[p]
-                    break
+            matched = match_opt_leaf_spec(k, shape, ordered, param_specs, param_shapes)
+            if matched is not None:
+                spec = matched
             if zero_level >= 1 and dp is not None:
                 dims = list(spec) + [None] * (len(shape) - len(spec))
                 for i, d in enumerate(dims):

@@ -99,6 +99,34 @@ def _path_str(path) -> str:
     return ".".join(parts)
 
 
+def match_opt_leaf_spec(k: str, shape, ordered_paths, param_specs, param_shapes) -> Optional[P]:
+    """Match an optimizer-state leaf to its parameter's spec by path suffix.
+
+    Tried against both the leaf path and its parent (optimizers that nest
+    per-param dicts — e.g. shampoo's ``...wq.weight.stats_l`` — match via
+    the parent ``...wq.weight``). Exact-shape matches inherit the full spec;
+    bank-statistics leaves like shampoo's ``[*lead, m, m]`` that only share
+    the leading (ep/pp-sharded) dim inherit that leading axis, keeping
+    per-expert/per-stage stats sharded with their bank instead of
+    replicated.
+    """
+    candidates = (k, k.rsplit(".", 1)[0])
+    for cand in candidates:
+        for p in ordered_paths:
+            if (cand == p or cand.endswith("." + p)) and param_shapes[p] == shape:
+                return param_specs[p]
+    for cand in candidates:
+        for p in ordered_paths:
+            if cand == p or cand.endswith("." + p):
+                pspec = list(param_specs[p])
+                pshape = param_shapes[p]
+                if (pspec and pspec[0] is not None and len(shape) >= 1
+                        and len(pshape) >= 1 and shape[0] == pshape[0]):
+                    return P(pspec[0], *([None] * (len(shape) - 1)))
+                return None
+    return None
+
+
 def state_sharding(state: Any, mesh: Mesh, zero_level: int = 0) -> Any:
     """Shardings for {params, opt_state, step}-style train state.
 
@@ -130,10 +158,9 @@ def state_sharding(state: Any, mesh: Mesh, zero_level: int = 0) -> Any:
         shape = np.shape(leaf)
         spec = P()
         if len(shape) > 0:
-            for p in ordered_paths:
-                if (k == p or k.endswith("." + p)) and param_shapes[p] == shape:
-                    spec = param_specs[p]
-                    break
+            matched = match_opt_leaf_spec(k, shape, ordered_paths, param_specs, param_shapes)
+            if matched is not None:
+                spec = matched
             if zero_level >= 1 and dp is not None:
                 dims = list(spec) + [None] * (len(shape) - len(spec))
                 for i, d in enumerate(dims):

@@ -1,0 +1,89 @@
+"""Tool-use agent loop (infer/agent.py — the working version of the
+reference's dead generate_agent.py)."""
+
+import pytest
+
+from mlx_cuda_distributed_pretraining_tpu.infer.agent import (
+    AgentStep,
+    default_tools,
+    run_agent,
+    safe_calc,
+    tool_prompt,
+)
+
+
+def test_safe_calc_arithmetic():
+    assert safe_calc("2+2*3") == "8"
+    assert safe_calc("(10 - 4) / 3") == "2.0"
+    assert safe_calc("2**10") == "1024"
+
+
+def test_safe_calc_rejects_code():
+    assert safe_calc("__import__('os')").startswith("error")
+    assert safe_calc("open('/etc/passwd')").startswith("error")
+    assert safe_calc("x + 1").startswith("error")
+    assert safe_calc("1/0").startswith("error")
+
+
+def test_agent_executes_tool_and_feeds_result_back():
+    contexts = []
+
+    def fake_gen(context):
+        contexts.append(context)
+        if len(contexts) == 1:
+            return "Let me compute that. <<calc: 6*7>>"
+        return "The answer is 42."
+
+    final, trace = run_agent(fake_gen, "what is 6*7?")
+    assert final == "The answer is 42."
+    assert trace[0].tool == "calc" and trace[0].result == "42"
+    # result was injected into the follow-up context
+    assert "<<result: 42>>" in contexts[1]
+    # tool docs are in the first context
+    assert "calc" in contexts[0] and "what is 6*7?" in contexts[0]
+
+
+def test_agent_discards_speculation_after_tool_call():
+    calls = []
+
+    def fake_gen(context):
+        calls.append(context)
+        if len(calls) == 1:
+            return "<<calc: 1+1>> and then I guess the answer is 7"
+        return "It is 2."
+
+    final, trace = run_agent(fake_gen, "1+1?")
+    assert final == "It is 2."
+    assert "I guess" not in trace[0].text
+
+
+def test_agent_unknown_tool_reports_error():
+    calls = iter(["<<frobnicate: x>>", "ok"])
+
+    def fake_gen(context):
+        return next(calls)
+
+    final, trace = run_agent(fake_gen, "hi")
+    assert trace[0].result.startswith("error: unknown tool")
+    assert final == "ok"
+
+
+def test_agent_turn_budget():
+    def always_tool(context):
+        return "<<calc: 1+1>>"
+
+    final, trace = run_agent(always_tool, "loop forever", max_turns=3)
+    assert len(trace) == 3
+    assert all(s.tool == "calc" for s in trace)
+
+
+def test_tool_prompt_lists_tools():
+    p = tool_prompt(default_tools())
+    assert "calc" in p and "wordcount" in p
+
+
+def test_safe_calc_caps_magnitude_and_exponent():
+    assert safe_calc("9**9**9").startswith("error")
+    assert safe_calc("10**300 * 10**300").startswith("error")
+    assert safe_calc("2**64").startswith("error")
+    assert safe_calc("2**10") == "1024"

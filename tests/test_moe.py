@@ -200,3 +200,34 @@ def test_moe_train_step_on_ep_mesh_matches_single_device():
     w = new_state["params"]["layers"][0]["feed_forward"]["experts"]["w_gate"]["weight"]
     spec = w.sharding.spec
     assert spec and spec[0] == "ep", f"expert dim not ep-sharded: {spec}"
+
+
+def test_shampoo_bank_stats_shard_over_ep():
+    """Shampoo's per-expert preconditioner stats [E, m, m] must shard over
+    ep with their bank, not replicate (parallel/sharding_rules.py
+    match_opt_leaf_spec leading-dim inheritance)."""
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 virtual devices")
+    sys_cfg = SystemConfig(seed=0, device="cpu", mesh={"ep": 2, "dp": 2})
+    mesh = build_mesh(sys_cfg, devices=jax.devices()[:4])
+    params = llama.init_params(jax.random.PRNGKey(0), MOE_ARGS)
+    tr = TrainingConfig(
+        hyperparameters={"learning_rate": 1e-3},
+        scheduler={"type": "cosine"},
+        optimization={"optimizer": "shampoo"},
+    )
+    opt = build_optimizer(tr, 10)
+
+    def loss_fn(p, b):
+        return llama.loss_fn(p, b, MOE_ARGS)
+
+    step, shardings = make_train_step(loss_fn, opt, mesh=mesh, params_like=params)
+    state = jax.device_put(init_train_state(params, opt), shardings)
+    state, metrics = step(state, _batch(bs=8))
+    assert np.isfinite(float(metrics["loss"]))
+
+    flat = jax.tree_util.tree_flatten_with_path(state["opt_state"])[0]
+    stats = [(str(k), v) for k, v in flat if "stats_l" in str(k) and v.ndim == 3]
+    assert stats, "no bank stats found in shampoo state"
+    for k, v in stats:
+        assert v.sharding.spec and v.sharding.spec[0] == "ep", f"{k}: {v.sharding.spec}"

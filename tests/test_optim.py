@@ -237,3 +237,22 @@ def test_muon_trains_pipeline_stacked_params():
              "mask": jnp.ones((4, 16), jnp.float32)}
     state, metrics = step(state, batch)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_shampoo_batched_matches_per_matrix():
+    # A stacked [B, m, n] leaf preconditions exactly like each slice alone.
+    from mlx_cuda_distributed_pretraining_tpu.optim.shampoo import shampoo_core
+
+    rng = np.random.default_rng(0)
+    stack = jnp.asarray(rng.normal(size=(3, 8, 6)), jnp.float32)
+    t = shampoo_core(update_period=1, start_step=1, momentum=0.0)
+
+    s_stack = t.init({"w": stack})
+    up_stack, _ = t.update({"w": stack}, s_stack, {"w": stack})
+
+    for i in range(3):
+        si = t.init({"w": stack[i]})
+        up_i, _ = t.update({"w": stack[i]}, si, {"w": stack[i]})
+        np.testing.assert_allclose(
+            np.asarray(up_stack["w"][i]), np.asarray(up_i["w"]), atol=1e-5
+        )
