@@ -378,6 +378,16 @@ def build_data_manager(
     data_cfg = config.data if hasattr(config, "data") else config
     source = getattr(data_cfg, "source", "jsonl")
     streaming_cfg = getattr(data_cfg, "streaming", {}) or {}
+    if source == "token_shards":
+        from .token_shards import TokenShardDataManager
+
+        shard_dir = getattr(data_cfg, "input_file", None) or streaming_cfg.get("shard_dir")
+        if not os.path.isabs(shard_dir):
+            shard_dir = os.path.join(base_dir, shard_dir)
+        return TokenShardDataManager(
+            shard_dir, batch_size, seq_len or data_cfg.max_context_size,
+            seed=seed, process_index=process_index, process_count=process_count,
+        )
     if source in ("hf_stream", "synthetic") or streaming_cfg.get("shards"):
         return StreamingDataManager(
             data_cfg, tokenizer, batch_size, seq_len=seq_len, seed=seed,
