@@ -382,6 +382,11 @@ def build_data_manager(
         from .token_shards import TokenShardDataManager
 
         shard_dir = getattr(data_cfg, "input_file", None) or streaming_cfg.get("shard_dir")
+        if not shard_dir:
+            raise ValueError(
+                "data.source=token_shards requires data.input_file or "
+                "data.streaming.shard_dir to point at the shard directory"
+            )
         if not os.path.isabs(shard_dir):
             shard_dir = os.path.join(base_dir, shard_dir)
         return TokenShardDataManager(

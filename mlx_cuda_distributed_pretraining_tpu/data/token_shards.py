@@ -60,13 +60,15 @@ def write_token_shards(
             ids = list(ids) + [eos]
         buf.extend(int(i) for i in ids)
         while len(buf) >= shard_tokens:
+            if max_tokens is not None and total + shard_tokens > max_tokens:
+                break
             chunk, buf = buf[:shard_tokens], buf[shard_tokens:]
             name = f"shard_{len(files):05d}.bin"
             np.asarray(chunk, dtype=dtype).tofile(os.path.join(out_dir, name))
             files.append(name)
             total += shard_tokens
         if max_tokens is not None and total + len(buf) >= max_tokens:
-            buf = buf[: max_tokens - total]
+            buf = buf[: max(0, max_tokens - total)]
             break
     flush()
 

@@ -68,11 +68,32 @@ def clip_by_global_norm(max_norm: float) -> Transform:
     return Transform(lambda p: {}, update)
 
 
+def is_vector_like_path(path) -> bool:
+    """True when a pytree key path names a per-layer vector (bias, norm gain)
+    regardless of the leaf's rank. Under pipeline parallelism layer params
+    are stacked along a leading ``L`` axis, so a norm weight ``[D]`` becomes
+    ``[L, D]`` — ndim-based routing would silently treat it as a matrix.
+    Routing by name keeps optimizer semantics identical across meshes
+    (reference routes bias/norm by name: enhanced_optimizers.py:88-102)."""
+    keys = [str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k)))) for k in path]
+    if not keys:
+        return False
+    last = keys[-1]
+    if "bias" in last:
+        return True
+    if last == "weight" and len(keys) >= 2 and "norm" in keys[-2]:
+        return True
+    return False
+
+
 def default_wd_mask(params: Any) -> Any:
-    """True where decoupled weight decay applies: only tensors with ndim >= 2
-    (embeddings/projections); biases and norm gains are skipped (reference:
+    """True where decoupled weight decay applies: only true matrices
+    (embeddings/projections); biases and norm gains are skipped by name so
+    pipeline-stacked ``[L, D]`` vectors stay excluded (reference:
     enhanced_optimizers.py:88-102 skips bias/norm by name)."""
-    return tree_map(lambda p: jnp.ndim(p) >= 2, params)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, p: jnp.ndim(p) >= 2 and not is_vector_like_path(path), params
+    )
 
 
 def add_decayed_weights(weight_decay: float, mask: Optional[Callable[[Any], Any]] = default_wd_mask) -> Transform:

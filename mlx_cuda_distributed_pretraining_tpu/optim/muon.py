@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from .base import (
@@ -20,6 +21,7 @@ from .base import (
     add_decayed_weights,
     chain,
     default_wd_mask,
+    is_vector_like_path,
     maybe_clip,
     partition,
     scale_by_schedule,
@@ -63,8 +65,6 @@ def scale_by_muon(momentum: float = 0.95, nesterov: bool = True, ns_steps: int =
             # trailing matrix independently via vmap — identical math to
             # per-matrix Muon.
             if m.ndim >= 3:
-                import jax
-
                 flat = m.reshape((-1,) + m.shape[-2:])
                 o = jax.vmap(lambda x: newton_schulz5(x, ns_steps))(flat)
                 o = o.reshape(m.shape)
@@ -80,10 +80,18 @@ def scale_by_muon(momentum: float = 0.95, nesterov: bool = True, ns_steps: int =
 
 
 def matrix_label_fn(params):
-    """2-D params get NS5 (the reference routes purely on ndim —
-    optimizers/muon.py:119-138). Leaves with ndim>=3 are stacked matrices
-    (pipeline layer slabs, MoE expert banks) and get batched NS5."""
-    return tree_map(lambda p: "matrix" if jnp.ndim(p) >= 2 else "rest", params)
+    """True matrices get NS5 (the reference routes on ndim —
+    optimizers/muon.py:119-138 — but its params are never stacked). Leaves
+    with ndim>=3 are stacked matrices (pipeline layer slabs, MoE expert
+    banks) and get batched NS5; bias/norm leaves are routed to 'rest' **by
+    path**, so a pipeline-stacked norm weight ``[L, D]`` is not mistaken for
+    a matrix and semantics match the dense-mesh run exactly."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, p: "matrix"
+        if jnp.ndim(p) >= 2 and not is_vector_like_path(path)
+        else "rest",
+        params,
+    )
 
 
 def muon(

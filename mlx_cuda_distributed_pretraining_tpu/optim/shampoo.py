@@ -29,6 +29,7 @@ from .base import (
     add_decayed_weights,
     chain,
     default_wd_mask,
+    is_vector_like_path,
     maybe_clip,
     scale_by_schedule,
     tree_map,
@@ -68,10 +69,17 @@ def shampoo_core(
         m, n = p.shape[-2], p.shape[-1]
         return m <= max_preconditioner_dim, n <= max_preconditioner_dim
 
+    def _precondition(path, p):
+        """Only true matrices get Kronecker preconditioning. Bias/norm
+        leaves are excluded by path so pipeline-stacked ``[L, D]`` vectors
+        are treated as vectors (graft direction only), matching the
+        dense-mesh semantics exactly."""
+        return p.ndim >= 2 and not is_vector_like_path(path)
+
     def init(params):
-        def per_param(p):
+        def per_param(path, p):
             st = {}
-            if p.ndim >= 2:
+            if _precondition(path, p):
                 use_l, use_r = _sides(p)
                 m, n = p.shape[-2], p.shape[-1]
                 lead = p.shape[:-2]  # () for 2-D, (B,) for stacked banks
@@ -95,7 +103,7 @@ def shampoo_core(
 
         return {
             "count": jnp.zeros((), jnp.int32),
-            "per_param": tree_map(lambda p: per_param(p), params),
+            "per_param": jax.tree_util.tree_map_with_path(per_param, params),
         }
 
     def update(grads, state, params):
@@ -103,7 +111,7 @@ def shampoo_core(
         refresh = (count % update_period == 0) | (count == start_step)
         active = count >= start_step
 
-        def per_param(g, st):
+        def per_param(path, g, st):
             g32 = g.astype(jnp.float32)
             new = dict(st)
             # grafting direction (adam by default; "sgd" grafts the raw grad)
@@ -114,7 +122,7 @@ def shampoo_core(
             new["g_mu"], new["g_nu"] = mu, nu
             graft_dir = (mu / bc1) / (jnp.sqrt(nu / bc2) + 1e-8) if graft_type == "adam" else g32
 
-            if g.ndim < 2:
+            if not _precondition(path, g):
                 direction = graft_dir
             else:
                 use_l, use_r = _sides(g)
@@ -168,9 +176,9 @@ def shampoo_core(
             new["mom"] = mom
             return mom, new
 
-        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_pg, treedef = jax.tree_util.tree_flatten_with_path(grads)
         flat_s = treedef.flatten_up_to(state["per_param"])
-        outs = [per_param(g, s) for g, s in zip(flat_g, flat_s)]
+        outs = [per_param(path, g, s) for (path, g), s in zip(flat_pg, flat_s)]
         updates = treedef.unflatten([o[0] for o in outs])
         new_pp = treedef.unflatten([o[1] for o in outs])
         return updates, {"count": count, "per_param": new_pp}

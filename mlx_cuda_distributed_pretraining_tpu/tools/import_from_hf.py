@@ -110,7 +110,9 @@ def model_args_from_hf_config(cfg: Dict[str, Any], vocab_size: Optional[int] = N
         rope_theta=float(cfg.get("rope_theta", 10000.0)),
         attention_bias=bool(cfg.get("attention_bias", False)),
         mlp_bias=bool(cfg.get("mlp_bias", False)),
-        tie_word_embeddings=bool(cfg.get("tie_word_embeddings", True)),
+        # HF LlamaConfig defaults tie_word_embeddings to False; defaulting
+        # True here would silently ignore an imported lm_head.weight.
+        tie_word_embeddings=bool(cfg.get("tie_word_embeddings", False)),
         num_local_experts=int(cfg.get("num_local_experts", 0) or 0),
         num_experts_per_tok=int(cfg.get("num_experts_per_tok", 0) or 0),
         moe_aux_weight=float(cfg.get("router_aux_loss_coef", 0.01) or 0.0),
@@ -140,6 +142,10 @@ def import_hf_dir(hf_dir: str):
     else:
         sd, _meta = load_safetensors(os.path.join(hf_dir, "model.safetensors"))
 
+    if cfg.get("tie_word_embeddings") is None:
+        # Config omits the key: the checkpoint itself is authoritative —
+        # a separate lm_head.weight means untied.
+        cfg = dict(cfg, tie_word_embeddings="lm_head.weight" not in sd)
     args = model_args_from_hf_config(cfg)
     params = our_params_from_hf(sd, args.num_layers)
     if len(params["layers"]) != args.num_layers:

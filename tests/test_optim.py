@@ -256,3 +256,58 @@ def test_shampoo_batched_matches_per_matrix():
         np.testing.assert_allclose(
             np.asarray(up_stack["w"][i]), np.asarray(up_i["w"]), atol=1e-5
         )
+
+
+def test_stacked_vector_routing_matches_dense_mesh():
+    """Pipeline stacking turns norm weights [D] into [L, D] and biases [n]
+    into [L, n]; routing must still send them to 'rest'/graft-only so
+    optimizer semantics match the dense-mesh run (ADVICE r1: medium)."""
+    from mlx_cuda_distributed_pretraining_tpu.optim.base import default_wd_mask
+    from mlx_cuda_distributed_pretraining_tpu.optim.muon import matrix_label_fn
+    from mlx_cuda_distributed_pretraining_tpu.optim.shampoo import shampoo_core
+
+    stacked = {
+        "layers": {
+            "attention_norm": {"weight": jnp.ones((4, 16))},   # stacked vector
+            "attention": {
+                "wq": {"weight": jnp.ones((4, 16, 16))},       # stacked matrix
+                "wq_bias_holder": {"bias": jnp.ones((4, 16))}, # stacked bias
+            },
+        },
+        "tok_embeddings": {"weight": jnp.ones((32, 16))},       # true matrix
+        "norm": {"weight": jnp.ones((16,))},                    # plain vector
+    }
+    labels = matrix_label_fn(stacked)
+    assert labels["layers"]["attention_norm"]["weight"] == "rest"
+    assert labels["layers"]["attention"]["wq"]["weight"] == "matrix"
+    assert labels["layers"]["attention"]["wq_bias_holder"]["bias"] == "rest"
+    assert labels["tok_embeddings"]["weight"] == "matrix"
+    assert labels["norm"]["weight"] == "rest"
+
+    mask = default_wd_mask(stacked)
+    assert not mask["layers"]["attention_norm"]["weight"]
+    assert not mask["layers"]["attention"]["wq_bias_holder"]["bias"]
+    assert mask["layers"]["attention"]["wq"]["weight"]
+
+    # Shampoo: stacked vectors carry no Kronecker stats (graft-only path).
+    st = shampoo_core().init(stacked)
+    pp = st["per_param"]["layers"]["attention_norm"]["weight"]
+    assert "stats_l" not in pp
+    assert "stats_l" in st["per_param"]["layers"]["attention"]["wq"]["weight"]
+
+
+def test_token_shards_respects_max_tokens(tmp_path):
+    """write_token_shards must not overshoot the token budget even when a
+    shard flush happens mid-document (ADVICE r1: low)."""
+    from mlx_cuda_distributed_pretraining_tpu.data.token_shards import write_token_shards
+
+    class ByteTok:
+        vocab_size = 256
+        eos_id = 0
+
+        def tokenize(self, s):
+            return list(s.encode())
+
+    docs = ["a" * 37 for _ in range(50)]
+    idx = write_token_shards(docs, ByteTok(), str(tmp_path), shard_tokens=64, max_tokens=200)
+    assert idx["total_tokens"] <= 200
