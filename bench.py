@@ -1,15 +1,30 @@
-"""Benchmark harness: pretrain tokens/sec on the real TPU chip.
+"""Benchmark harness: the scale matrix on the real TPU chip.
 
-Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": N}
+Prints ONE JSON line on stdout (driver contract):
+    {"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": N,
+     "matrix": [...per-case results...]}
+Per-case progress lines go to stderr.
+
+The matrix (VERDICT r1 item 1): {2M, 40M, 100M, 400M} params x flash
+attention (the measured default) at a realistic 32,768 vocab, with
+simple-attention comparison points, each entry carrying tok/s, step_ms and
+MFU; plus decode/prefill throughput (VERDICT item 4) and one end-to-end
+Trainer run whose tok/s must track the bare-step number (VERDICT item 9).
 
 Baseline (BASELINE.md): the reference's only throughput anchor is the
 Llama-2M run on an Apple M3 Max — ~200M FineWeb-Edu tokens in ~2h ≈ 27.5K
-tok/s. We measure the same 2M-parameter model shape doing full training
-steps (fwd+bwd+AdamW update, bf16 compute) on one TPU chip.
+tok/s (reference README.md:60). vs_baseline is the 2M-flash entry against
+that. MFU = flops_per_token * tok/s / chip_peak with
+flops_per_token = 6*N + 6*L*S*d_attn (causal attention term included).
 
-Env knobs: BENCH_MODEL (2m|40m|100m), BENCH_BATCH, BENCH_SEQ, BENCH_STEPS,
-BENCH_OPT.
+Sync note: through the axon tunnel ``jax.block_until_ready`` is a no-op
+and each dispatch costs ~70ms RTT, so every measurement chains steps
+on-device (state feeds the next step) and syncs once via a host fetch;
+decode/prefill additionally use a two-point (T(n_hi)-T(n_lo)) difference
+to cancel the fixed overhead.
+
+Env knobs: BENCH_CASES (comma list: 2m,40m,100m,400m,simple,decode,
+trainer; default all), BENCH_STEPS, BENCH_VOCAB.
 """
 
 from __future__ import annotations
@@ -18,22 +33,39 @@ import json
 import os
 import sys
 import time
+from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-BASELINE_TOKS_PER_SEC = 27500.0
+BASELINE_TOKS_PER_SEC = 27500.0  # reference README.md:60 implied
+V5E_PEAK_FLOPS = 197e12  # TPU v5e bf16 peak per chip
 
-MODELS = {
-    "2m": dict(hidden_size=128, intermediate_size=256, num_layers=4,
-               num_heads=8, num_kv_heads=8, head_dim=16),
-    "40m": dict(hidden_size=512, intermediate_size=1536, num_layers=12,
-                num_heads=8, num_kv_heads=8, head_dim=64),
-    "100m": dict(hidden_size=768, intermediate_size=2048, num_layers=12,
-                 num_heads=12, num_kv_heads=12, head_dim=64),
+# BASELINE.md scale points; per-chip batch/seq chosen to fill HBM.
+SCALES = {
+    "2m": dict(shape=dict(hidden_size=128, intermediate_size=256, num_layers=4,
+                          num_heads=8, num_kv_heads=8, head_dim=16),
+               batch=64, seq=1024, remat=None),
+    "40m": dict(shape=dict(hidden_size=512, intermediate_size=1536, num_layers=12,
+                           num_heads=8, num_kv_heads=8, head_dim=64),
+                batch=32, seq=2048, remat=None),
+    "100m": dict(shape=dict(hidden_size=768, intermediate_size=2048, num_layers=12,
+                            num_heads=12, num_kv_heads=12, head_dim=64),
+                 batch=16, seq=2048, remat=None),
+    "400m": dict(shape=dict(hidden_size=1024, intermediate_size=4096, num_layers=24,
+                            num_heads=16, num_kv_heads=16, head_dim=64),
+                 batch=8, seq=2048, remat="dots"),
 }
 
 
-def main() -> None:
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def flops_per_token(n_params, num_layers, seq, d_attn):
+    return 6.0 * n_params + 6.0 * num_layers * seq * d_attn
+
+
+def bench_train_case(name, scale_key, attn, vocab, steps):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -46,17 +78,11 @@ def main() -> None:
         make_train_step,
     )
 
-    model_key = os.environ.get("BENCH_MODEL", "2m")
-    batch = int(os.environ.get("BENCH_BATCH", "64"))
-    seq = int(os.environ.get("BENCH_SEQ", "1024"))
-    steps = int(os.environ.get("BENCH_STEPS", "30"))
-    opt_name = os.environ.get("BENCH_OPT", "adamw")
-    vocab = int(os.environ.get("BENCH_VOCAB", "512"))
-
-    shape = MODELS[model_key]
+    sc = SCALES[scale_key]
+    batch, seq, remat = sc["batch"], sc["seq"], sc["remat"]
     args = llama.LlamaArgs(
         vocab_size=vocab, max_position_embeddings=seq,
-        attention_type=os.environ.get("BENCH_ATTN", "simple"), **shape,
+        attention_type=attn, **sc["shape"],
     )
     params = llama.init_params(jax.random.PRNGKey(0), args)
     n_params = llama.num_params(params)
@@ -64,12 +90,13 @@ def main() -> None:
     tr_cfg = TrainingConfig(
         hyperparameters={"learning_rate": 1e-3, "weight_decay": 0.01, "gradient_clip": 1.0},
         scheduler={"type": "cosine", "min_lr_ratio": 0.1},
-        optimization={"optimizer": opt_name},
+        optimization={"optimizer": "adamw"},
     )
     opt = build_optimizer(tr_cfg, 1000)
 
     def loss_fn(p, b):
-        return llama.loss_fn(p, b, args, compute_dtype=jnp.bfloat16)
+        return llama.loss_fn(p, b, args, compute_dtype=jnp.bfloat16,
+                             remat=remat)
 
     step, _ = make_train_step(loss_fn, opt)
     state = init_train_state(params, opt)
@@ -82,33 +109,238 @@ def main() -> None:
         "mask": jnp.ones((batch, seq), jnp.float32),
     }
 
-    # warmup/compile. Sync by fetching the loss to host (float()), not
-    # jax.block_until_ready: measured on the axon TPU tunnel 2026-07-29,
-    # block_until_ready returned in ~0.4ms for steps that take ~150ms
-    # (implying >5000 TFLOP/s on a ~200 TFLOP chip), while a host transfer
-    # gave consistent, physically plausible timings.
-    state, metrics = step(state, b)
+    state, metrics = step(state, b)  # compile + warm
     float(metrics["loss"])
 
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = step(state, b)
-    final_loss = float(metrics["loss"])
+    final_loss = float(metrics["loss"])  # host fetch syncs the whole chain
     dt = time.perf_counter() - t0
 
-    toks_per_step = batch * seq
-    value = steps * toks_per_step / dt
+    toks = steps * batch * seq
+    tok_s = toks / dt
+    ft = flops_per_token(n_params, args.num_layers, seq,
+                         args.num_heads * args.head_dim)
+    return {
+        "case": name, "params_m": round(n_params / 1e6, 1), "attn": attn,
+        "batch": batch, "seq": seq, "vocab": vocab, "remat": remat,
+        "tok_s": round(tok_s, 0), "step_ms": round(1000 * dt / steps, 1),
+        "mfu": round(ft * tok_s / V5E_PEAK_FLOPS, 4),
+        "final_loss": round(final_loss, 3),
+    }
+
+
+def bench_decode_case(scale_key, vocab):
+    """Device decode throughput (chained greedy steps, two-point timing)
+    and bucketed prefill throughput."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mlx_cuda_distributed_pretraining_tpu.models import llama
+
+    sc = SCALES[scale_key]
+    args = llama.LlamaArgs(
+        vocab_size=vocab, max_position_embeddings=2048, **sc["shape"],
+    )
+    params = llama.init_params(jax.random.PRNGKey(0), args)
+    B, P, attend = 8, 512, 1024
+
+    @partial(jax.jit, static_argnums=(2,))
+    def prefill_fwd(params, toks, attend_len):
+        cache = llama.init_cache(args, B, max_len=2048, dtype=jnp.bfloat16)
+        logits, cache = llama.forward(params, toks, args, cache=cache,
+                                      start_pos=0, attend_len=attend_len)
+        return logits, cache
+
+    @partial(jax.jit, static_argnums=(3, 4))
+    def decode_chain(params, cache, tok, n, attend_len):
+        def body(i, carry):
+            cache, tok = carry
+            logits, cache = llama.forward(
+                params, tok[:, None], args, cache=cache,
+                start_pos=P + i, attend_len=attend_len)
+            return cache, jnp.argmax(logits[:, -1, :], -1).astype(jnp.int32)
+
+        return lax.fori_loop(0, n, body, (cache, tok))
+
+    toks = jnp.ones((B, P), jnp.int32)
+
+    def sync(x):
+        jax.device_get(jax.tree_util.tree_leaves(x)[0].ravel()[:1])
+
+    # prefill: time one [B, 512] forward via two-point chained calls
+    @partial(jax.jit, static_argnums=(2,))
+    def prefill_chain(params, toks, n):
+        def body(i, t):
+            logits, _ = prefill_fwd(params, t, 512)
+            return (t + jnp.argmax(logits[:, -1:, :], -1).astype(jnp.int32) * 0)
+
+        return lax.fori_loop(0, n, body, toks)
+
+    ts = {}
+    for n in (2, 6):
+        sync(prefill_chain(params, toks, n))  # compile
+        t0 = time.perf_counter()
+        sync(prefill_chain(params, toks, n))
+        ts[n] = time.perf_counter() - t0
+    prefill_s = (ts[6] - ts[2]) / 4
+    prefill_tok_s = B * P / max(prefill_s, 1e-9)
+
+    _, cache = prefill_fwd(params, toks, 512)
+    tok0 = jnp.ones((B,), jnp.int32)
+    ts = {}
+    for n in (8, 40):
+        sync(decode_chain(params, cache, tok0, n, attend))  # compile
+        t0 = time.perf_counter()
+        sync(decode_chain(params, cache, tok0, n, attend))
+        ts[n] = time.perf_counter() - t0
+    per_step = (ts[40] - ts[8]) / 32
+    return {
+        "case": f"decode_{scale_key}", "batch": B, "prompt": P,
+        "attend_bucket": attend,
+        "decode_tok_s": round(B / max(per_step, 1e-9), 1),
+        "decode_step_ms": round(per_step * 1e3, 2),
+        "prefill_tok_s": round(prefill_tok_s, 0),
+    }
+
+
+def bench_trainer_case(vocab, workdir="/tmp/bench_trainer"):
+    """End-to-end Trainer on-chip (40M, flash, bf16, token-shard data):
+    proves the input pipeline keeps the device fed (VERDICT item 9)."""
+    import shutil
+
+    import numpy as np
+
+    from mlx_cuda_distributed_pretraining_tpu.config import Config
+    from mlx_cuda_distributed_pretraining_tpu.train.trainer import Trainer
+
+    shutil.rmtree(workdir, ignore_errors=True)
+    os.makedirs(workdir, exist_ok=True)
+    sc = SCALES["40m"]
+    batch, seq = sc["batch"], sc["seq"]
+
+    # binary token shards (memmap path), 40 steps of data
+    shard_dir = os.path.join(workdir, "shards")
+    os.makedirs(shard_dir)
+    n_tokens = 45 * batch * (seq + 1)
+    rng = np.random.default_rng(0)
+    arr = rng.integers(1, vocab - 4, size=n_tokens).astype(np.uint16)
+    arr.tofile(os.path.join(shard_dir, "shard_00000.bin"))
+    with open(os.path.join(shard_dir, "index.json"), "w") as f:
+        json.dump({"dtype": "uint16", "shard_tokens": n_tokens,
+                   "total_tokens": n_tokens, "files": ["shard_00000.bin"],
+                   "vocab_size": vocab, "eos_id": 0}, f)
+
+    sh = sc["shape"]
+    cfg_dict = {
+        "name": "bench-trainer",
+        "overwrite": True,
+        "data": {
+            "source": "token_shards",
+            "input_file": shard_dir,
+            "preprocessing": {"max_context_size": seq},
+            "tokenizer": {"default": "byte"},
+        },
+        "model": {
+            "architecture": "llama",
+            "dimensions": {"hidden_size": sh["hidden_size"],
+                           "intermediate_size": sh["intermediate_size"],
+                           "num_layers": sh["num_layers"],
+                           "num_heads": sh["num_heads"]},
+            "attention": {"num_kv_heads": sh["num_kv_heads"],
+                          "head_dim": sh["head_dim"],
+                          "max_position_embeddings": seq,
+                          "attention_type": "flash"},
+            "misc": {"vocab_size": vocab},
+        },
+        "training": {
+            "hyperparameters": {"batch_size": batch, "learning_rate": 1e-3,
+                                "iters": 40, "gradient_clip": 1.0},
+            "scheduler": {"type": "cosine_with_warmup", "warmup_steps": 5},
+            "optimization": {"optimizer": "adamw"},
+        },
+        "logging": {"steps": {"logging_interval": 10,
+                              "checkpoint_interval": 0,
+                              "validation_interval": 0}},
+        "system": {"seed": 0, "compute_dtype": "bfloat16"},
+    }
+    import yaml
+
+    cfg_path = os.path.join(workdir, "cfg.yaml")
+    with open(cfg_path, "w") as f:
+        yaml.dump(cfg_dict, f)
+    config = Config.from_yaml(cfg_path)
+    t = Trainer(config, runs_root=os.path.join(workdir, "runs"), quiet=True)
+    t0 = time.perf_counter()
+    t.train()
+    dt = time.perf_counter() - t0
+
+    # parse steady-state tok/s from log.txt (last report line)
+    tok_s = None
+    log_path = os.path.join(workdir, "runs", "bench-trainer", "log.txt")
+    with open(log_path) as f:
+        for line in f:
+            if "tok/s=" in line:
+                tok_s = float(line.split("tok/s=")[1].split()[0].rstrip("|"))
+    return {
+        "case": "trainer_40m_flash_e2e", "batch": batch, "seq": seq,
+        "vocab": vocab, "tok_s": tok_s, "wall_s": round(dt, 1),
+    }
+
+
+def main() -> None:
+    import jax
+
+    vocab = int(os.environ.get("BENCH_VOCAB", "32768"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    cases_env = os.environ.get("BENCH_CASES",
+                               "2m,40m,100m,400m,simple,decode,trainer")
+    wanted = set(cases_env.split(","))
+
     device = jax.devices()[0]
+    log(f"[bench] device={device} vocab={vocab} steps={steps} cases={sorted(wanted)}")
+
+    matrix = []
+
+    def run(name, fn, *a):
+        t0 = time.perf_counter()
+        try:
+            r = fn(*a)
+            r["bench_wall_s"] = round(time.perf_counter() - t0, 1)
+            matrix.append(r)
+            log(f"[bench] {json.dumps(r)}")
+        except Exception as e:  # noqa: BLE001 - one OOM must not kill the bench
+            matrix.append({"case": name, "error": str(e)[:300]})
+            log(f"[bench] {name} FAILED: {str(e)[:300]}")
+
+    for key in ("2m", "40m", "100m", "400m"):
+        if key in wanted:
+            run(f"{key}_flash", bench_train_case, f"{key}_flash", key, "flash", vocab, steps)
+    if "simple" in wanted:
+        run("2m_simple", bench_train_case, "2m_simple", "2m", "simple", vocab, steps)
+        run("40m_simple", bench_train_case, "40m_simple", "40m", "simple", vocab, steps)
+    if "decode" in wanted:
+        run("decode_2m", bench_decode_case, "2m", vocab)
+        run("decode_100m", bench_decode_case, "100m", vocab)
+    if "trainer" in wanted:
+        run("trainer", bench_trainer_case, vocab)
+
+    flash_2m = next((r for r in matrix if r.get("case") == "2m_flash" and "tok_s" in r), None)
+    best_mfu = max((r.get("mfu", 0.0) or 0.0 for r in matrix), default=0.0)
+    headline = flash_2m or next((r for r in matrix if r.get("tok_s")), {"case": "none", "tok_s": 0})
+    # vs_baseline (M3-Max 2M anchor) only makes sense for the 2M case.
+    vs = round(headline["tok_s"] / BASELINE_TOKS_PER_SEC, 3) if headline is flash_2m else None
     print(json.dumps({
-        "metric": f"pretrain_tokens_per_sec_per_chip_llama_{model_key}"
-                  f"_{n_params/1e6:.1f}Mparams_bs{batch}_seq{seq}_{opt_name}",
-        "value": round(value, 1),
+        "metric": f"pretrain_tokens_per_sec_per_chip_llama_{headline['case']}"
+                  f"_vocab{vocab}",
+        "value": headline.get("tok_s", 0),
         "unit": "tok/s",
-        "vs_baseline": round(value / BASELINE_TOKS_PER_SEC, 3),
+        "vs_baseline": vs,
         "device": str(device),
-        "steps_timed": steps,
-        "step_ms": round(1000 * dt / steps, 2),
-        "final_loss": round(final_loss, 4),
+        "best_mfu": best_mfu,
+        "matrix": matrix,
     }))
 
 

@@ -34,7 +34,20 @@ from .safetensors_io import load_safetensors, save_safetensors
 
 
 def _to_numpy_tree(tree: Any) -> Any:
-    return jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)), tree)
+    """Bring a pytree to host numpy. Arrays sharded across *processes*
+    (multi-host FSDP/ZeRO: no single process can address every shard) are
+    assembled via ``process_allgather`` — a collective, so when any array in
+    the tree is not fully addressable EVERY process must call this function
+    (the trainer gathers on all processes and only writes on process 0)."""
+
+    def to_host(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree_util.tree_map(to_host, tree)
 
 
 class CheckpointManager:

@@ -32,15 +32,27 @@ def _round_up(n: int, mult: int) -> int:
     return ((n + mult - 1) // mult) * mult
 
 
-def _decode_step(args: llama.LlamaArgs, with_processors: bool):
-    """Compiled once per (args, cache-size bucket) — cached."""
-    key = (args, with_processors)
+def _attend_bucket(n: int, cache_len: int, lo: int = 256) -> int:
+    """Smallest power-of-two >= n (min ``lo``), clamped to the cache: decode
+    attends over this prefix of the cache instead of the whole buffer, so
+    per-token cost is O(position), not O(max context). Power-of-two buckets
+    bound recompiles at log2(cache_len)."""
+    b = lo
+    while b < n:
+        b *= 2
+    return min(b, cache_len)
+
+
+def _decode_step(args: llama.LlamaArgs, with_processors: bool, attend_len: Optional[int]):
+    """Compiled once per (args, attend bucket) — cached."""
+    key = (args, with_processors, attend_len)
     if key in _STEP_CACHE:
         return _STEP_CACHE[key]
 
     @partial(jax.jit, static_argnames=("sampler", "processors"))
     def step(params, cache, token, pos, rng, history, sampler, processors):
-        logits, cache = llama.forward(params, token[:, None], args, cache=cache, start_pos=pos)
+        logits, cache = llama.forward(params, token[:, None], args, cache=cache, start_pos=pos,
+                                      attend_len=attend_len)
         logits = logits[:, -1, :]
         for proc in processors or ():
             logits = proc(history, logits)
@@ -73,7 +85,8 @@ def prefill(params, args: llama.LlamaArgs, tokens: np.ndarray, cache_len: int,
     padded[:, :P] = tokens
     cache = llama.init_cache(args, B, max_len=cache_len, dtype=cache_dtype,
                              quantize=kv_quant)
-    logits, cache = llama.forward(params, jnp.asarray(padded), args, cache=cache, start_pos=0)
+    logits, cache = llama.forward(params, jnp.asarray(padded), args, cache=cache, start_pos=0,
+                                  attend_len=_attend_bucket(bucket, cache_len))
     for layer in cache:
         layer["pos"] = jnp.asarray(P, jnp.int32)
     return cache, logits[:, P - 1, :]
@@ -113,19 +126,25 @@ def generate_step(
     lp0 = jax.nn.log_softmax(last_logits, axis=-1)
     tok = sampler(sub, last_logits)
     lp = jnp.take_along_axis(lp0, tok[:, None], axis=-1)[:, 0]
-    step = _decode_step(args, bool(processors))
 
     pos = P
     for i in range(max_tokens):
-        t_host = int(tok[0])
-        yield t_host, float(lp[0])
-        if i == max_tokens - 1:
+        # Dispatch the NEXT step before host-reading the current token: JAX
+        # dispatch is async, so the device computes step i+1 while the host
+        # converts/yields token i (the reference overlaps the same way with
+        # mx.async_eval: core/generation_lite.py:158-175).
+        nxt = None
+        if i < max_tokens - 1:
+            hist_next = jnp.concatenate([history[:, 1:], tok[:, None]], axis=1)
+            step = _decode_step(args, bool(processors), _attend_bucket(pos + 1, cache_len))
+            nxt = step(
+                params, cache, tok, jnp.asarray(pos, jnp.int32), rng, hist_next,
+                sampler=sampler, processors=processors,
+            )
+        yield int(tok[0]), float(lp[0])
+        if nxt is None:
             break
-        history = jnp.concatenate([history[:, 1:], tok[:, None]], axis=1)
-        cache, tok, lp, rng, history = step(
-            params, cache, tok, jnp.asarray(pos, jnp.int32), rng, history,
-            sampler=sampler, processors=processors,
-        )
+        cache, tok, lp, rng, history = nxt
         pos += 1
 
 
@@ -214,9 +233,10 @@ def beam_search(
     cache, last_logits = prefill(params, args, np.repeat(tokens, num_beams, axis=0),
                                  cache_len, prefill_step_size)
 
-    @jax.jit
-    def expand(cache, toks, pos, scores, alive):
-        logits, cache = llama.forward(params, toks[:, None], args, cache=cache, start_pos=pos)
+    @partial(jax.jit, static_argnames=("attend_len",))
+    def expand(cache, toks, pos, scores, alive, attend_len):
+        logits, cache = llama.forward(params, toks[:, None], args, cache=cache, start_pos=pos,
+                                      attend_len=attend_len)
         lp = jax.nn.log_softmax(logits[:, -1, :], axis=-1)  # [k, V]
         V = lp.shape[-1]
         # finished beams may only extend with EOS at zero cost
@@ -247,7 +267,8 @@ def beam_search(
         if not bool(np.any(np.asarray(alive))):
             break
         cache, toks, scores, alive, origin = expand(
-            cache, toks, jnp.asarray(pos, jnp.int32), scores, alive)
+            cache, toks, jnp.asarray(pos, jnp.int32), scores, alive,
+            attend_len=_attend_bucket(pos + 1, cache_len))
         origin = np.asarray(origin)
         toks_h = np.asarray(toks)
         seqs = [seqs[origin[i]] + [int(toks_h[i])] for i in range(num_beams)]

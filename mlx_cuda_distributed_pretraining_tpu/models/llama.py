@@ -260,13 +260,17 @@ def attention_block(
     positions: jnp.ndarray,
     cache: Optional[Dict[str, jnp.ndarray]] = None,
     attn_impl: Optional[str] = None,
+    attend_len: Optional[int] = None,
 ) -> Tuple[jnp.ndarray, Optional[Dict[str, jnp.ndarray]]]:
     """Self-attention with RoPE, GQA and optional KV cache.
 
     cache = {"k": [B, T, Hkv, Dh], "v": ..., "pos": scalar} with T =
     max_position_embeddings; decode writes at ``pos`` via dynamic slice and
-    attends over the full buffer under a positional validity mask.
-    """
+    attends under a positional validity mask. ``attend_len`` (static)
+    restricts attention to the first ``attend_len`` cache slots — the
+    generation loop passes a power-of-two bucket >= pos+S, so decode cost
+    is O(bucket), not O(T) (the reference's per-token decode is O(cache)
+    from step 1: core/generation_lite.py:158-175)."""
     B, S, _ = x.shape
     Hq, Hkv, Dh = args.num_heads, args.num_kv_heads, args.head_dim
 
@@ -292,15 +296,17 @@ def attention_block(
         cv_q = jax.lax.dynamic_update_slice(cache["v_q"], vq, (0, pos, 0, 0))
         cv_s = jax.lax.dynamic_update_slice(cache["v_s"], vs, (0, pos, 0, 0))
         new_cache = {"k_q": ck_q, "k_s": ck_s, "v_q": cv_q, "v_s": cv_s, "pos": pos + S}
-        k = ck_q.astype(jnp.float32) * ck_s
-        v = cv_q.astype(jnp.float32) * cv_s
+        L = attend_len or ck_q.shape[1]
+        k = ck_q[:, :L].astype(jnp.float32) * ck_s[:, :L]
+        v = cv_q[:, :L].astype(jnp.float32) * cv_s[:, :L]
         out = _cached_attention(q, k, v, positions, pos, S)
     elif cache is not None:
         pos = cache["pos"]
         ck = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, pos, 0, 0))
         cv = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, pos, 0, 0))
         new_cache = {"k": ck, "v": cv, "pos": pos + S}
-        out = _cached_attention(q, ck, cv, positions, pos, S)
+        L = attend_len or ck.shape[1]
+        out = _cached_attention(q, ck[:, :L], cv[:, :L], positions, pos, S)
     else:
         mask_mod = build_mask_mod(args)
         impl = attn_impl or args.attention_type
@@ -364,6 +370,7 @@ def transformer_block(
     positions: jnp.ndarray,
     cache: Optional[Dict[str, jnp.ndarray]] = None,
     attn_impl: Optional[str] = None,
+    attend_len: Optional[int] = None,
 ) -> Tuple[jnp.ndarray, Optional[Dict[str, jnp.ndarray]], jnp.ndarray]:
     """Pre-norm residual block (reference: models/llama.py:298-319).
 
@@ -371,7 +378,7 @@ def transformer_block(
     loss (0 for dense layers)."""
     h, new_cache = attention_block(
         p["attention"], rms_norm(x, p["attention_norm"]["weight"], args.rms_norm_eps),
-        args, positions, cache, attn_impl,
+        args, positions, cache, attn_impl, attend_len,
     )
     x = x + h
     normed = rms_norm(x, p["ffn_norm"]["weight"], args.rms_norm_eps)
@@ -397,6 +404,7 @@ def forward(
     remat: Optional[str] = None,
     remat_ratio: float = 1.0,
     return_aux: bool = False,
+    attend_len: Optional[int] = None,
 ) -> Tuple[jnp.ndarray, Optional[list]]:
     """tokens [B, S] int32 → (logits [B, S, V] fp32, new_cache | None).
 
@@ -404,7 +412,8 @@ def forward(
     corresponding policy; ``remat_ratio`` checkpoints only the first fraction
     of layers (reference: system.gradient_checkpointing_ratio).
     ``return_aux=True`` appends the summed MoE aux loss:
-    ``(logits, cache, aux)``.
+    ``(logits, cache, aux)``. ``attend_len`` (static) bounds cached decode
+    attention to a bucket of the cache — see :func:`attention_block`.
     """
     B, S = tokens.shape
     x = params["tok_embeddings"]["weight"].astype(compute_dtype)[tokens]
@@ -412,12 +421,12 @@ def forward(
 
     block = transformer_block
     if remat == "full":
-        block = jax.checkpoint(transformer_block, static_argnums=(2, 5))
+        block = jax.checkpoint(transformer_block, static_argnums=(2, 5, 6))
     elif remat == "dots":
         block = jax.checkpoint(
             transformer_block,
             policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
-            static_argnums=(2, 5),
+            static_argnums=(2, 5, 6),
         )
 
     cast = partial(jax.tree_util.tree_map, lambda a: a.astype(compute_dtype))
@@ -427,7 +436,7 @@ def forward(
     for i, layer in enumerate(params["layers"]):
         blk = block if (remat and i < n_remat) else transformer_block
         layer_cache = cache[i] if cache is not None else None
-        x, c, aux = blk(cast(layer), x, args, positions, layer_cache, None)
+        x, c, aux = blk(cast(layer), x, args, positions, layer_cache, None, attend_len)
         aux_total = aux_total + aux
         if new_cache is not None:
             new_cache.append(c)

@@ -4,15 +4,19 @@ The reference's "FlashAttention" materializes the full [B,H,S,S] score
 matrix ("Simple approach without tiling for now", reference:
 models/attention/flash_attention.py:100,134-151). This is the real thing:
 
-- forward: online-softmax accumulation over KV tiles in VMEM; scores never
-  exist beyond one [block_q, block_kv] tile; fp32 accumulators; MXU matmuls
-  via ``dot_general(..., preferred_element_type=f32)``;
+- forward: online-softmax accumulation with **KV streamed through the
+  grid** — K/V enter VMEM one [block_kv, D] tile at a time via the Pallas
+  pipeline (double-buffered HBM→VMEM DMA), so VMEM never holds the whole
+  sequence and max context is bounded by HBM, not VMEM; fp32 accumulators
+  live in VMEM scratch across the KV grid steps; MXU matmuls via
+  ``dot_general(..., preferred_element_type=f32)``;
 - block sparsity: per-mask-type KV tile ranges (causal skips the upper
   triangle, sliding-window skips everything outside the band) — skipped
-  tiles cost nothing;
+  tiles are gated with ``pl.when`` AND their index maps are clamped into
+  the live range, so the pipeline never fetches a tile it will not use;
 - backward: recomputation-based (saves only O and the logsumexp), split
-  into a dQ kernel (grid over Q tiles) and a dK/dV kernel (grid over KV
-  tiles), the standard flash-attention-2 decomposition;
+  into a dQ kernel (KV streamed, dQ in scratch) and a dK/dV kernel
+  (Q/dO streamed, dK/dV in scratch), the flash-attention-2 decomposition;
 - GQA: native — each query head reads its KV group's tile; dK/dV are
   accumulated per query head and group-reduced outside the kernel;
 - masks/score mods are traceable index-lattice functions (ops/masks.py)
@@ -27,6 +31,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Callable, Optional, Tuple
 
 import jax
@@ -43,6 +48,10 @@ except ImportError:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
+# Lane width of the TPU vector unit: scratch vectors are padded to a full
+# register row so stores never touch partial lanes.
+_LANES = 128
+
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
@@ -55,6 +64,24 @@ def _vmem_spec(block_shape=None, index_map=None):
     if block_shape is None:
         return pl.BlockSpec(**kwargs)
     return pl.BlockSpec(block_shape, index_map, **kwargs)
+
+
+def _scratch(shape, dtype=jnp.float32):
+    if pltpu is None:  # pragma: no cover - this jaxlib has pltpu even on CPU
+        raise RuntimeError(
+            "flash_attention needs jax.experimental.pallas.tpu (for VMEM "
+            "scratch shapes, also used by interpret mode); use "
+            "attention_type='simple' on builds without it")
+    return pltpu.VMEM(shape, dtype)
+
+
+def _compiler_params(n_parallel: int, n_total: int):
+    """Mark leading grid dims parallel, trailing (reduction) dims arbitrary
+    so Mosaic knows scratch state only flows along the last dim."""
+    if pltpu is None or _interpret():
+        return None
+    sem = ("parallel",) * n_parallel + ("arbitrary",) * (n_total - n_parallel)
+    return pltpu.CompilerParams(dimension_semantics=sem)
 
 
 # -- tile-range planners (block sparsity per mask type) ----------------------
@@ -102,66 +129,84 @@ def _q_range(mask_type: str, window: int, prefix_len: int, block_q: int, block_k
 
 
 # -- forward kernel ----------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_kv,
-                mask_fn, score_fn, kv_lo, kv_hi):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
+                scale, mask_fn, score_fn, kv_lo, kv_hi, nkv):
+    j = pl.program_id(3)
     qi = pl.program_id(2)
     h = pl.program_id(1)
-    # Matmul operands stay in their storage dtype (bf16 in training) so the
-    # MXU runs at full rate; accumulation is fp32 via preferred_element_type.
-    q = q_ref[0, 0]
-    bq, d = q.shape
-    row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_kv), 0)
+    bq = q_ref.shape[2]
+    bkv = k_ref.shape[2]
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, 0, pl.ds(j * block_kv, block_kv), :]
-        v = v_ref[0, 0, pl.ds(j * block_kv, block_kv), :]
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    @pl.when((j >= kv_lo(qi)) & (j < kv_hi(qi)))
+    def _compute():
+        # Matmul operands stay in their storage dtype (bf16 in training) so
+        # the MXU runs at full rate; accumulation is fp32.
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        col = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, (bq, block_kv), 1)
+        row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
+        col = j * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
         if score_fn is not None:
             s = score_fn(s, row, col, h)
         if mask_fn is not None:
             s = jnp.where(mask_fn(row, col), s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
+        m = m_scr[:, 0:1]                                    # [bq, 1]
+        l = l_scr[:, 0:1]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(kv_lo(qi), kv_hi(qi), body, (m0, l0, acc0))
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    # lse is laid out [B, H, 1, Sq]: the singleton dim keeps the block's
-    # second-to-last dim equal to the array dim, satisfying TPU (8, 128)
-    # tiling without padding lse out to 128 lanes.
-    lse_ref[0, 0, 0] = (m + jnp.log(l_safe)).astype(lse_ref.dtype)
+    @pl.when(j == nkv - 1)
+    def _finalize():
+        m = m_scr[:, 0]
+        l = l_scr[:, 0]
+        l_safe = jnp.maximum(l, 1e-30)
+        o_ref[0, 0] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
+        # lse is laid out [B, H, 1, Sq]: the singleton dim keeps the block's
+        # second-to-last dim equal to the array dim, satisfying TPU (8, 128)
+        # tiling without padding lse out to 128 lanes.
+        lse_ref[0, 0, 0] = (m + jnp.log(l_safe)).astype(lse_ref.dtype)
 
 
 # -- backward kernels --------------------------------------------------------
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-                   scale, block_kv, mask_fn, score_fn, kv_lo, kv_hi):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *,
+                   scale, mask_fn, score_fn, kv_lo, kv_hi, nkv):
+    j = pl.program_id(3)
     qi = pl.program_id(2)
     h = pl.program_id(1)
-    q = q_ref[0, 0]
-    do = do_ref[0, 0]
-    lse = lse_ref[0, 0, 0].astype(jnp.float32)
-    delta = delta_ref[0, 0, 0].astype(jnp.float32)
-    bq, d = q.shape
-    row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_kv), 0)
+    bq = q_ref.shape[2]
+    bkv = k_ref.shape[2]
 
-    def body(j, dq):
-        k = k_ref[0, 0, pl.ds(j * block_kv, block_kv), :]
-        v = v_ref[0, 0, pl.ds(j * block_kv, block_kv), :]
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    @pl.when((j >= kv_lo(qi)) & (j < kv_hi(qi)))
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0, 0].astype(jnp.float32)
+        delta = delta_ref[0, 0, 0].astype(jnp.float32)
         s_raw = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32) * scale
-        col = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, (bq, block_kv), 1)
+        row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
+        col = j * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
         s = score_fn(s_raw, row, col, h) if score_fn is not None else s_raw
         if mask_fn is not None:
             s = jnp.where(mask_fn(row, col), s, NEG_INF)
@@ -173,37 +218,47 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         if d_mod is not None:  # non-additive score mod: chain through its Jacobian
             ds = ds * d_mod(s_raw, row, col, h)
         ds = ds * scale
-        return dq + jax.lax.dot_general(ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
+        dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(kv_lo(qi), kv_hi(qi), body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+    @pl.when(j == nkv - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
-                    scale, block_q, mask_fn, score_fn, q_lo, q_hi):
-    ki = pl.program_id(2)
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                    dk_scr, dv_scr, *, scale, mask_fn, score_fn, q_lo, q_hi, nq):
+    j = pl.program_id(3)   # q tile (streamed)
+    ki = pl.program_id(2)  # kv tile (resident)
     h = pl.program_id(1)
-    k = k_ref[0, 0]
-    v = v_ref[0, 0]
-    bkv, d = k.shape
-    col = ki * bkv + jax.lax.broadcasted_iota(jnp.int32, (block_q, bkv), 1)
+    bq = q_ref.shape[2]
+    bkv = k_ref.shape[2]
 
-    def body(j, carry):
-        dk, dv = carry
-        q = q_ref[0, 0, pl.ds(j * block_q, block_q), :]
-        do = do_ref[0, 0, pl.ds(j * block_q, block_q), :]
-        lse = lse_ref[0, 0, 0, pl.ds(j * block_q, block_q)].astype(jnp.float32)
-        delta = delta_ref[0, 0, 0, pl.ds(j * block_q, block_q)].astype(jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    @pl.when((j >= q_lo(ki)) & (j < q_hi(ki)))
+    def _compute():
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        q = q_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0, 0].astype(jnp.float32)
+        delta = delta_ref[0, 0, 0].astype(jnp.float32)
         s_raw = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32) * scale
-        row = j * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bkv), 0)
+        row = j * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
+        col = ki * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
         s = score_fn(s_raw, row, col, h) if score_fn is not None else s_raw
         if mask_fn is not None:
             s = jnp.where(mask_fn(row, col), s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
-        dv = dv + jax.lax.dot_general(p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
+        dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
@@ -211,15 +266,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         if d_mod is not None:
             ds = ds * d_mod(s_raw, row, col, h)
         ds = ds * scale
-        dk = dk + jax.lax.dot_general(ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        return dk, dv
+        dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    dk0 = jnp.zeros((bkv, d), jnp.float32)
-    dv0 = jnp.zeros((bkv, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(q_lo(ki), q_hi(ki), body, (dk0, dv0))
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+    @pl.when(j == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 # -- host-side wrapper -------------------------------------------------------
@@ -247,25 +301,39 @@ def _attention_core(
         nq = Sq // bq
         nkv = Skv // bkv
         kv_lo, kv_hi = _kv_range(mask_type, window, prefix_len, bq, bkv, nkv)
+
+        def kv_index(b, h, i, j):
+            # Clamp skipped tiles into the live range so the pipeline never
+            # DMAs a tile the kernel will not touch (block sparsity saves
+            # bandwidth, not just FLOPs).
+            jc = jnp.clip(j, kv_lo(i), kv_hi(i) - 1)
+            return (b, h // G, jc, 0)
+
         kernel = functools.partial(
-            _fwd_kernel, scale=scale, block_kv=bkv, mask_fn=mask_fn,
-            score_fn=score_fn, kv_lo=kv_lo, kv_hi=kv_hi)
+            _fwd_kernel, scale=scale, mask_fn=mask_fn,
+            score_fn=score_fn, kv_lo=kv_lo, kv_hi=kv_hi, nkv=nkv)
         o, lse = pl.pallas_call(
             kernel,
-            grid=(B, Hq, nq),
+            grid=(B, Hq, nq, nkv),
             in_specs=[
-                _vmem_spec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
-                _vmem_spec((1, 1, Skv, D), lambda b, h, i: (b, h // G, 0, 0)),
-                _vmem_spec((1, 1, Skv, D), lambda b, h, i: (b, h // G, 0, 0)),
+                _vmem_spec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+                _vmem_spec((1, 1, bkv, D), kv_index),
+                _vmem_spec((1, 1, bkv, D), kv_index),
             ],
             out_specs=[
-                _vmem_spec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
-                _vmem_spec((1, 1, 1, bq), lambda b, h, i: (b, h, 0, i)),
+                _vmem_spec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+                _vmem_spec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i)),
             ],
             out_shape=[
                 jax.ShapeDtypeStruct((B, Hq, Sq, D), q.dtype),
                 jax.ShapeDtypeStruct((B, Hq, 1, Sq), jnp.float32),
             ],
+            scratch_shapes=[
+                _scratch((bq, _LANES)),      # running max
+                _scratch((bq, _LANES)),      # running denominator
+                _scratch((bq, D)),           # fp32 output accumulator
+            ],
+            compiler_params=_compiler_params(3, 4),
             interpret=_interpret(),
         )(q, k, v)
         return o, (q, k, v, o, lse)
@@ -283,46 +351,64 @@ def _attention_core(
                         axis=-1)[:, :, None, :]  # [B,Hq,1,Sq], lse layout
 
         kv_lo, kv_hi = _kv_range(mask_type, window, prefix_len, bq, bkv, nkv)
+
+        def kv_index(b, h, i, j):
+            jc = jnp.clip(j, kv_lo(i), kv_hi(i) - 1)
+            return (b, h // G, jc, 0)
+
         dq = pl.pallas_call(
-            functools.partial(_bwd_dq_kernel, scale=scale, block_kv=bkv,
+            functools.partial(_bwd_dq_kernel, scale=scale,
                               mask_fn=mask_fn, score_fn=score_fn,
-                              kv_lo=kv_lo, kv_hi=kv_hi),
-            grid=(B, Hq, nq),
+                              kv_lo=kv_lo, kv_hi=kv_hi, nkv=nkv),
+            grid=(B, Hq, nq, nkv),
             in_specs=[
-                _vmem_spec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
-                _vmem_spec((1, 1, Skv, D), lambda b, h, i: (b, h // G, 0, 0)),
-                _vmem_spec((1, 1, Skv, D), lambda b, h, i: (b, h // G, 0, 0)),
-                _vmem_spec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
-                _vmem_spec((1, 1, 1, bq), lambda b, h, i: (b, h, 0, i)),
-                _vmem_spec((1, 1, 1, bq), lambda b, h, i: (b, h, 0, i)),
+                _vmem_spec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+                _vmem_spec((1, 1, bkv, D), kv_index),
+                _vmem_spec((1, 1, bkv, D), kv_index),
+                _vmem_spec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+                _vmem_spec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i)),
+                _vmem_spec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i)),
             ],
-            out_specs=_vmem_spec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+            out_specs=_vmem_spec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
             out_shape=jax.ShapeDtypeStruct((B, Hq, Sq, D), q.dtype),
+            scratch_shapes=[_scratch((bq, D))],
+            compiler_params=_compiler_params(3, 4),
             interpret=_interpret(),
         )(q, k, v, g, lse, delta)
 
         q_lo, q_hi = _q_range(mask_type, window, prefix_len, bq, bkv, nq)
+
+        def q_index(b, h, i, j):
+            jc = jnp.clip(j, q_lo(i), q_hi(i) - 1)
+            return (b, h, jc, 0)
+
+        def stat_index(b, h, i, j):
+            jc = jnp.clip(j, q_lo(i), q_hi(i) - 1)
+            return (b, h, 0, jc)
+
         dk_h, dv_h = pl.pallas_call(
-            functools.partial(_bwd_dkv_kernel, scale=scale, block_q=bq,
+            functools.partial(_bwd_dkv_kernel, scale=scale,
                               mask_fn=mask_fn, score_fn=score_fn,
-                              q_lo=q_lo, q_hi=q_hi),
-            grid=(B, Hq, nkv),
+                              q_lo=q_lo, q_hi=q_hi, nq=nq),
+            grid=(B, Hq, nkv, nq),
             in_specs=[
-                _vmem_spec((1, 1, Sq, D), lambda b, h, i: (b, h, 0, 0)),
-                _vmem_spec((1, 1, bkv, D), lambda b, h, i: (b, h // G, i, 0)),
-                _vmem_spec((1, 1, bkv, D), lambda b, h, i: (b, h // G, i, 0)),
-                _vmem_spec((1, 1, Sq, D), lambda b, h, i: (b, h, 0, 0)),
-                _vmem_spec((1, 1, 1, Sq), lambda b, h, i: (b, h, 0, 0)),
-                _vmem_spec((1, 1, 1, Sq), lambda b, h, i: (b, h, 0, 0)),
+                _vmem_spec((1, 1, bq, D), q_index),
+                _vmem_spec((1, 1, bkv, D), lambda b, h, i, j: (b, h // G, i, 0)),
+                _vmem_spec((1, 1, bkv, D), lambda b, h, i, j: (b, h // G, i, 0)),
+                _vmem_spec((1, 1, bq, D), q_index),
+                _vmem_spec((1, 1, 1, bq), stat_index),
+                _vmem_spec((1, 1, 1, bq), stat_index),
             ],
             out_specs=[
-                _vmem_spec((1, 1, bkv, D), lambda b, h, i: (b, h, i, 0)),
-                _vmem_spec((1, 1, bkv, D), lambda b, h, i: (b, h, i, 0)),
+                _vmem_spec((1, 1, bkv, D), lambda b, h, i, j: (b, h, i, 0)),
+                _vmem_spec((1, 1, bkv, D), lambda b, h, i, j: (b, h, i, 0)),
             ],
             out_shape=[
                 jax.ShapeDtypeStruct((B, Hq, Skv, D), k.dtype),
                 jax.ShapeDtypeStruct((B, Hq, Skv, D), v.dtype),
             ],
+            scratch_shapes=[_scratch((bkv, D)), _scratch((bkv, D))],
+            compiler_params=_compiler_params(3, 4),
             interpret=_interpret(),
         )(q, k, v, g, lse, delta)
 
@@ -343,6 +429,13 @@ def _cached_core(mask_fn, score_fn, mask_type, window, prefix_len, block_q, bloc
     return _attention_core(mask_fn, score_fn, mask_type, window, prefix_len, block_q, block_kv, scale)
 
 
+# Defaults from an on-chip sweep (scripts/bench_attention.py) on TPU v5e:
+# (256, 512) is within noise of the best (block_q, block_kv) across
+# seq 1024-8192 for D in {64, 128}; override per-call or via env.
+_DEF_BLOCK_Q = int(os.environ.get("FLASH_BLOCK_Q", 256))
+_DEF_BLOCK_KV = int(os.environ.get("FLASH_BLOCK_KV", 512))
+
+
 def flash_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -351,8 +444,8 @@ def flash_attention(
     window_size: int = 512,
     prefix_len: int = 0,
     scale: Optional[float] = None,
-    block_q: int = 512,
-    block_kv: int = 1024,
+    block_q: int = _DEF_BLOCK_Q,
+    block_kv: int = _DEF_BLOCK_KV,
     mask_fn: Optional[Callable] = None,
     score_fn: Optional[Callable] = None,
 ) -> jnp.ndarray:

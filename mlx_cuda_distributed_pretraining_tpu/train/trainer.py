@@ -92,7 +92,18 @@ class Trainer:
         # -- model -----------------------------------------------------------
         arch = resolve_architecture(cfg.model.architecture)
         self.arch = arch
-        args = LlamaArgs.from_config(cfg.model, self.tokenizer.vocab_size)
+        vocab_size = self.tokenizer.vocab_size
+        if getattr(cfg.data, "source", None) == "token_shards":
+            # Pre-tokenized binary shards: the shard index's vocab is
+            # authoritative (the tokenizer is only used for sampling).
+            idx_dir = getattr(cfg.data, "input_file", None) or (
+                getattr(cfg.data, "streaming", {}) or {}).get("shard_dir")
+            if idx_dir:
+                idx_path = os.path.join(idx_dir, "index.json")
+                if os.path.isfile(idx_path):
+                    with open(idx_path) as f:
+                        vocab_size = int(json.load(f).get("vocab_size", vocab_size))
+        args = LlamaArgs.from_config(cfg.model, vocab_size)
         if arch.force_attention:
             args = args.__class__(**{**args.__dict__, "attention_type": arch.force_attention})
         self.model_args = args
@@ -254,6 +265,23 @@ class Trainer:
 
     # -- checkpointing ------------------------------------------------------
     def save_checkpoint(self, step) -> None:
+        # The host gather is a COLLECTIVE when state is sharded across
+        # processes (multi-host FSDP/ZeRO), so every process runs it; only
+        # process 0 touches the filesystem afterwards.
+        from ..checkpoint.manager import _to_numpy_tree
+
+        host_params = _to_numpy_tree(self._host_params())
+        host_opt = _to_numpy_tree(self._host_opt_state())
+        if jax.process_count() > 1 and self.data is not None:
+            # Data-loader position is PER HOST (each host consumes a
+            # disjoint stream); every process writes its own sidecar so
+            # resume restores each host's exact position, not process 0's.
+            os.makedirs(self.checkpoints.checkpoint_dir, exist_ok=True)
+            sidecar = os.path.join(
+                self.checkpoints.checkpoint_dir,
+                f"step_{step}_data_p{jax.process_index()}.json")
+            with open(sidecar, "w") as f:
+                json.dump(self.data.state_dict(), f)
         if jax.process_index() != 0:
             return
         training_state = {
@@ -264,7 +292,7 @@ class Trainer:
             "early_stopping": self.early_stopping.state_dict(),
         }
         self.checkpoints.save(
-            step, self._host_params(), self._host_opt_state(), training_state,
+            step, host_params, host_opt, training_state,
             metadata_extra={"total_tokens": int(self.total_tokens)},
         )
         self._write_metadata_summary()
@@ -318,7 +346,14 @@ class Trainer:
             self.total_tokens = int(tstate.get("total_tokens", 0))
             self.val_history = tstate.get("validation", self.val_history)
             if self.data:
-                self.data.load_state_dict(tstate)
+                data_state = tstate
+                sidecar = os.path.join(
+                    self.checkpoints.checkpoint_dir,
+                    f"step_{tag}_data_p{jax.process_index()}.json")
+                if jax.process_count() > 1 and os.path.isfile(sidecar):
+                    with open(sidecar) as f:
+                        data_state = json.load(f)
+                self.data.load_state_dict(data_state)
             self.early_stopping.load_state_dict(tstate.get("early_stopping", {}))
         self.logger.log(f"Resumed from checkpoint {tag} at step {self.start_step}")
 
@@ -341,10 +376,18 @@ class Trainer:
             return
         prompts = prompts or ["Once upon a time"]
         count = int(self.config.logging.log_samples_count or 1)
+        # Gather once (collective when params are process-sharded — all
+        # processes participate), then only the chief generates.
+        from ..checkpoint.manager import _to_numpy_tree
+
+        host_params = jax.tree_util.tree_map(
+            jnp.asarray, _to_numpy_tree(self._host_params()))
+        if jax.process_index() != 0:
+            return
         for prompt in prompts[:count]:
             try:
                 text = generate_text(
-                    self._host_params(), self.model_args, self.tokenizer, prompt,
+                    host_params, self.model_args, self.tokenizer, prompt,
                     max_new_tokens=max_new_tokens, temperature=0.0,
                 )
                 self.logger.log_sample(step, prompt, text)

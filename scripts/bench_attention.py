@@ -1,0 +1,134 @@
+"""Attention kernel microbenchmark on the real TPU chip.
+
+Compares the Pallas flash kernel (fwd+bwd) against XLA's fused attention
+(reference_attention: einsum + softmax, fully materialized scores) across
+sequence lengths, and sweeps (block_q, block_kv). The VERDICT r1 done-bar:
+flash >= XLA at seq 2048/4096/8192 and seq 16k running without OOM.
+
+Methodology: the axon tunnel makes ``block_until_ready`` a no-op and adds
+~70 ms dispatch latency per call, so each measurement jits an on-device
+``lax.fori_loop`` that chains N attention calls (output feeds the next
+query, so nothing is DCE'd), syncs via a 1-element ``device_get``, and
+reports (T(n_hi) - T(n_lo)) / (n_hi - n_lo) to cancel the fixed overhead.
+
+Usage (on TPU):  python scripts/bench_attention.py [--sweep]
+Writes results to stdout as JSON lines.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def timed_loop(step, q, k, v, n_lo=5, n_hi=25):
+    """step: (q, k, v) -> array shaped like q. Returns seconds per call."""
+
+    @partial(jax.jit, static_argnums=(3,))
+    def loop(q, k, v, iters):
+        return jax.lax.fori_loop(0, iters, lambda i, qq: step(qq, k, v), q)
+
+    def run(iters):
+        out = loop(q, k, v, iters)
+        jax.device_get(out[(0,) * (out.ndim - 1) + (slice(0, 1),)])
+
+    run(n_lo)  # compile both shapes
+    run(n_hi)
+    t0 = time.perf_counter()
+    run(n_lo)
+    t_lo = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    run(n_hi)
+    t_hi = time.perf_counter() - t0
+    return max((t_hi - t_lo) / (n_hi - n_lo), 1e-9)
+
+
+def attn_flops(B, H, Sq, Skv, D, causal=True):
+    # QK^T + PV, 2 matmuls of 2*S*S*D MACs each; causal halves the work.
+    f = 4.0 * B * H * Sq * Skv * D
+    return f / 2 if causal else f
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--sweep", action="store_true", help="sweep block sizes")
+    parser.add_argument("--dtype", default="bfloat16")
+    parser.add_argument("--head-dim", type=int, default=64)
+    parser.add_argument("--heads", type=int, default=16)
+    a = parser.parse_args()
+
+    from mlx_cuda_distributed_pretraining_tpu.ops import masks as M
+    from mlx_cuda_distributed_pretraining_tpu.ops.attention import reference_attention
+    from mlx_cuda_distributed_pretraining_tpu.ops.flash_attention import flash_attention
+
+    dtype = jnp.dtype(a.dtype)
+    H, D = a.heads, a.head_dim
+    dev = jax.devices()[0]
+    print(json.dumps({"device": str(dev), "dtype": str(dtype), "H": H, "D": D}))
+
+    def make_inputs(B, S, key=0):
+        ks = jax.random.split(jax.random.PRNGKey(key), 3)
+        shape = (B, S, H, D)
+        return tuple(jax.random.normal(k, shape, dtype) for k in ks)
+
+    def run_case(name, fn, q, k, v):
+        B, S = q.shape[0], q.shape[1]
+
+        def fwd_step(qq, kk, vv):
+            return fn(qq, kk, vv)
+
+        def bwd_step(qq, kk, vv):
+            # grad wrt q has q's shape: chain it as the next query
+            return jax.grad(lambda x: jnp.sum(fn(x, kk, vv).astype(jnp.float32)))(qq)
+
+        t_f = timed_loop(fwd_step, q, k, v)
+        t_b = timed_loop(bwd_step, q, k, v)
+        fl = attn_flops(B, H, S, S, D)
+        return {
+            "name": name, "B": B, "S": S,
+            "fwd_ms": round(t_f * 1e3, 3), "bwd_ms": round(t_b * 1e3, 3),
+            "fwd_tflops": round(fl / t_f / 1e12, 2),
+            # bwd step includes the fwd recompute + dQ/dK/dV (~3.5x fwd FLOPs)
+            "bwd_tflops": round(3.5 * fl / t_b / 1e12, 2),
+        }
+
+    if a.sweep:
+        for B, S in [(16, 2048), (8, 4096), (4, 8192)]:
+            q, k, v = make_inputs(B, S)
+            for bq in (128, 256, 512):
+                for bkv in (128, 256, 512, 1024):
+                    if bkv > S or bq > S:
+                        continue
+                    r = run_case(
+                        f"flash_bq{bq}_bkv{bkv}",
+                        lambda q, k, v, bq=bq, bkv=bkv: flash_attention(
+                            q, k, v, block_q=bq, block_kv=bkv),
+                        q, k, v)
+                    print(json.dumps(r), flush=True)
+        return
+
+    # tokens-per-batch held ~constant so memory stays bounded
+    cases = [(32, 1024), (16, 2048), (8, 4096), (4, 8192), (2, 16384), (1, 32768)]
+    for B, S in cases:
+        q, k, v = make_inputs(B, S)
+        r = run_case("flash", flash_attention, q, k, v)
+        print(json.dumps(r), flush=True)
+        if S <= 4096:  # XLA full-score attention OOMs/fails to compile beyond
+            try:
+                r = run_case("xla_fused", lambda q, k, v: reference_attention(
+                    q, k, v, mask_mod=M.causal()), q, k, v)
+                print(json.dumps(r), flush=True)
+            except Exception as e:  # noqa: BLE001
+                print(json.dumps({"name": "xla_fused", "B": B, "S": S,
+                                  "error": str(e)[:160]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

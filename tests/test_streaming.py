@@ -180,3 +180,108 @@ def test_trainer_with_streaming_source(tmp_path):
     result = tr.train()
     assert result["steps"] == 8
     assert np.isfinite(result["final_loss"])
+
+
+def _write_tar_shard(path, n_docs, prefix="tardoc", as_json=False):
+    import io
+    import tarfile
+
+    with tarfile.open(path, "w") as tf:
+        for i in range(n_docs):
+            if as_json:
+                payload = json.dumps({"text": f"{prefix} {i} " + "json body " * 10}).encode()
+                name = f"{i:06d}.json"
+            else:
+                payload = (f"{prefix} {i} " + "tar body " * 10).encode()
+                name = f"{i:06d}.txt"
+            info = tarfile.TarInfo(name)
+            info.size = len(payload)
+            tf.addfile(info, io.BytesIO(payload))
+
+
+def test_webdataset_tar_shard_streaming(tmp_path):
+    """WebDataset-style .tar shards stream like JSONL shards (reference:
+    fineweb_stream.py:18-57)."""
+    p_txt = str(tmp_path / "s0.tar")
+    p_json = str(tmp_path / "s1.tar")
+    _write_tar_shard(p_txt, 30, as_json=False)
+    _write_tar_shard(p_json, 30, as_json=True)
+    tok = _tokenizer(tmp_path)
+    cfg = _streaming_cfg(tmp_path, [p_txt, p_json])
+    mgr = StreamingDataManager(cfg, tok, batch_size=2, seq_len=32)
+    b = mgr.generate_batch(0)
+    assert b["inputs"].shape == (2, 32)
+    assert b["inputs"].dtype == np.int32
+    mgr.stop()
+
+    from mlx_cuda_distributed_pretraining_tpu.data.streaming import load_shard_docs
+
+    docs = load_shard_docs(p_txt)
+    assert len(docs) == 30 and docs[0].startswith("tardoc 0")
+    docs = load_shard_docs(p_json)
+    assert len(docs) == 30 and "json body" in docs[0]
+
+
+def test_streaming_exact_resume_batch_equality(tmp_path):
+    """Batch N+1 after resume == batch N+1 without resume, exactly, for
+    local shard sources (VERDICT r1 item 7)."""
+    shards = []
+    for s in range(3):
+        p = str(tmp_path / f"s{s}.jsonl")
+        _write_shard(p, 40, prefix=f"shard{s}")
+        shards.append(p)
+    tok = _tokenizer(tmp_path)
+    cfg = _streaming_cfg(tmp_path, shards)
+
+    # uninterrupted run: collect 6 batches
+    ref = StreamingDataManager(cfg, tok, batch_size=2, seq_len=32)
+    ref_batches = [ref.generate_batch(i) for i in range(6)]
+    ref.stop()
+
+    # interrupted run: 3 batches, checkpoint, resume, 3 more
+    a = StreamingDataManager(cfg, tok, batch_size=2, seq_len=32)
+    for i in range(3):
+        a.generate_batch(i)
+    state = a.state_dict()
+    a.stop()
+    assert "source" in state  # exact path, not skip-replay
+
+    b = StreamingDataManager(cfg, tok, batch_size=2, seq_len=32)
+    b.load_state_dict(state)
+    resumed = [b.generate_batch(i) for i in range(3)]
+    b.stop()
+
+    for got, want in zip(resumed, ref_batches[3:]):
+        np.testing.assert_array_equal(got["inputs"], want["inputs"])
+        np.testing.assert_array_equal(got["targets"], want["targets"])
+
+
+def test_seekable_source_deterministic_and_sharded(tmp_path):
+    from mlx_cuda_distributed_pretraining_tpu.data.streaming import SeekableShuffledSource
+
+    shards = []
+    for s in range(2):
+        p = str(tmp_path / f"s{s}.jsonl")
+        _write_shard(p, 10, prefix=f"sh{s}")
+        shards.append(p)
+
+    def take(src, n):
+        out = []
+        for doc in src:
+            out.append(doc)
+            if len(out) == n:
+                break
+        return out
+
+    a = take(SeekableShuffledSource(shards, seed=7), 15)
+    b = take(SeekableShuffledSource(shards, seed=7), 15)
+    assert a == b  # deterministic
+    c = take(SeekableShuffledSource(shards, seed=8), 15)
+    assert a != c  # seed-dependent
+
+    # two hosts partition one epoch (2 shards x 10 docs) exactly
+    full_epoch = take(SeekableShuffledSource(shards, seed=7), 20)
+    h0 = take(SeekableShuffledSource(shards, seed=7, process_index=0, process_count=2), 10)
+    h1 = take(SeekableShuffledSource(shards, seed=7, process_index=1, process_count=2), 10)
+    assert not (set(h0) & set(h1))
+    assert sorted(h0 + h1) == sorted(full_epoch)
