@@ -276,6 +276,165 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
+def fit_block(block: int, dim: int) -> int:
+    """Largest power-of-two block <= requested that divides the sequence;
+    128 is the TPU lane width / minimum tile. May still fail to divide for
+    dims like 192 — callers must check ``dim % fit_block(...) == 0`` and
+    fall back to a non-Pallas path."""
+    while block > 128 and dim % block:
+        block //= 2
+    return min(block, dim)
+
+
+def _check_divisible(Sq, bq, Skv, bkv):
+    if Sq % bq or Skv % bkv:
+        raise ValueError(
+            f"flash kernels need block-divisible sequences: Sq={Sq} % bq={bq}"
+            f" or Skv={Skv} % bkv={bkv} != 0 — pass fitted blocks "
+            "(fit_block) or use the reference path")
+
+
+# -- raw kernel entry points (reused by ring attention) ----------------------
+def flash_fwd(q, k, v, *, mask_fn=None, score_fn=None, mask_type="causal",
+              window=512, prefix_len=0, block_q=256, block_kv=512, scale=1.0):
+    """Raw tiled forward on [B, H, S, D] layout. Returns ``(o, lse)`` with
+    lse laid out [B, Hq, 1, Sq]. Building block for the custom-vjp wrapper
+    and for ring attention's per-chunk calls."""
+    B, Hq, Sq, D = q.shape
+    _, Hkv, Skv, _ = k.shape
+    G = Hq // Hkv
+    bq = min(block_q, Sq)
+    bkv = min(block_kv, Skv)
+    _check_divisible(Sq, bq, Skv, bkv)
+    nq = Sq // bq
+    nkv = Skv // bkv
+    kv_lo, kv_hi = _kv_range(mask_type, window, prefix_len, bq, bkv, nkv)
+
+    def kv_index(b, h, i, j):
+        # Clamp skipped tiles into the live range so the pipeline never
+        # DMAs a tile the kernel will not touch (block sparsity saves
+        # bandwidth, not just FLOPs).
+        jc = jnp.clip(j, kv_lo(i), kv_hi(i) - 1)
+        return (b, h // G, jc, 0)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, mask_fn=mask_fn,
+        score_fn=score_fn, kv_lo=kv_lo, kv_hi=kv_hi, nkv=nkv)
+    return pl.pallas_call(
+        kernel,
+        grid=(B, Hq, nq, nkv),
+        in_specs=[
+            _vmem_spec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            _vmem_spec((1, 1, bkv, D), kv_index),
+            _vmem_spec((1, 1, bkv, D), kv_index),
+        ],
+        out_specs=[
+            _vmem_spec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            _vmem_spec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hq, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, Hq, 1, Sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            _scratch((bq, _LANES)),      # running max
+            _scratch((bq, _LANES)),      # running denominator
+            _scratch((bq, D)),           # fp32 output accumulator
+        ],
+        compiler_params=_compiler_params(3, 4),
+        interpret=_interpret(),
+    )(q, k, v)
+
+
+def flash_bwd_dq(q, k, v, g, lse, delta, *, mask_fn=None, score_fn=None,
+                 mask_type="causal", window=512, prefix_len=0,
+                 block_q=256, block_kv=512, scale=1.0):
+    """Raw dQ kernel. ``lse``/``delta``: [B, Hq, 1, Sq] fp32."""
+    B, Hq, Sq, D = q.shape
+    _, Hkv, Skv, _ = k.shape
+    G = Hq // Hkv
+    bq = min(block_q, Sq)
+    bkv = min(block_kv, Skv)
+    _check_divisible(Sq, bq, Skv, bkv)
+    nq = Sq // bq
+    nkv = Skv // bkv
+    kv_lo, kv_hi = _kv_range(mask_type, window, prefix_len, bq, bkv, nkv)
+
+    def kv_index(b, h, i, j):
+        jc = jnp.clip(j, kv_lo(i), kv_hi(i) - 1)
+        return (b, h // G, jc, 0)
+
+    return pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale,
+                          mask_fn=mask_fn, score_fn=score_fn,
+                          kv_lo=kv_lo, kv_hi=kv_hi, nkv=nkv),
+        grid=(B, Hq, nq, nkv),
+        in_specs=[
+            _vmem_spec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            _vmem_spec((1, 1, bkv, D), kv_index),
+            _vmem_spec((1, 1, bkv, D), kv_index),
+            _vmem_spec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            _vmem_spec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i)),
+            _vmem_spec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i)),
+        ],
+        out_specs=_vmem_spec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, Sq, D), q.dtype),
+        scratch_shapes=[_scratch((bq, D))],
+        compiler_params=_compiler_params(3, 4),
+        interpret=_interpret(),
+    )(q, k, v, g, lse, delta)
+
+
+def flash_bwd_dkv(q, k, v, g, lse, delta, *, mask_fn=None, score_fn=None,
+                  mask_type="causal", window=512, prefix_len=0,
+                  block_q=256, block_kv=512, scale=1.0):
+    """Raw dK/dV kernel. Returns per-QUERY-head grads [B, Hq, Skv, D]
+    (caller reduces GQA groups)."""
+    B, Hq, Sq, D = q.shape
+    _, Hkv, Skv, _ = k.shape
+    G = Hq // Hkv
+    bq = min(block_q, Sq)
+    bkv = min(block_kv, Skv)
+    _check_divisible(Sq, bq, Skv, bkv)
+    nq = Sq // bq
+    nkv = Skv // bkv
+    q_lo, q_hi = _q_range(mask_type, window, prefix_len, bq, bkv, nq)
+
+    def q_index(b, h, i, j):
+        jc = jnp.clip(j, q_lo(i), q_hi(i) - 1)
+        return (b, h, jc, 0)
+
+    def stat_index(b, h, i, j):
+        jc = jnp.clip(j, q_lo(i), q_hi(i) - 1)
+        return (b, h, 0, jc)
+
+    return pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale,
+                          mask_fn=mask_fn, score_fn=score_fn,
+                          q_lo=q_lo, q_hi=q_hi, nq=nq),
+        grid=(B, Hq, nkv, nq),
+        in_specs=[
+            _vmem_spec((1, 1, bq, D), q_index),
+            _vmem_spec((1, 1, bkv, D), lambda b, h, i, j: (b, h // G, i, 0)),
+            _vmem_spec((1, 1, bkv, D), lambda b, h, i, j: (b, h // G, i, 0)),
+            _vmem_spec((1, 1, bq, D), q_index),
+            _vmem_spec((1, 1, 1, bq), stat_index),
+            _vmem_spec((1, 1, 1, bq), stat_index),
+        ],
+        out_specs=[
+            _vmem_spec((1, 1, bkv, D), lambda b, h, i, j: (b, h, i, 0)),
+            _vmem_spec((1, 1, bkv, D), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hq, Skv, D), k.dtype),
+            jax.ShapeDtypeStruct((B, Hq, Skv, D), v.dtype),
+        ],
+        scratch_shapes=[_scratch((bkv, D)), _scratch((bkv, D))],
+        compiler_params=_compiler_params(3, 4),
+        interpret=_interpret(),
+    )(q, k, v, g, lse, delta)
+
+
 # -- host-side wrapper -------------------------------------------------------
 def _attention_core(
     mask_fn, score_fn, mask_type: str, window: int, prefix_len: int,
@@ -286,6 +445,9 @@ def _attention_core(
     Inputs (to the returned fn): q [B, Hq, Sq, D], k/v [B, Hkv, Skv, D].
     Output: o [B, Hq, Sq, D]. ``scale`` is baked in (nondiff).
     """
+    kw = dict(mask_fn=mask_fn, score_fn=score_fn, mask_type=mask_type,
+              window=window, prefix_len=prefix_len, block_q=block_q,
+              block_kv=block_kv, scale=scale)
 
     @jax.custom_vjp
     def attn(q, k, v):
@@ -293,49 +455,7 @@ def _attention_core(
         return o
 
     def _fwd(q, k, v):
-        B, Hq, Sq, D = q.shape
-        _, Hkv, Skv, _ = k.shape
-        G = Hq // Hkv
-        bq = min(block_q, Sq)
-        bkv = min(block_kv, Skv)
-        nq = Sq // bq
-        nkv = Skv // bkv
-        kv_lo, kv_hi = _kv_range(mask_type, window, prefix_len, bq, bkv, nkv)
-
-        def kv_index(b, h, i, j):
-            # Clamp skipped tiles into the live range so the pipeline never
-            # DMAs a tile the kernel will not touch (block sparsity saves
-            # bandwidth, not just FLOPs).
-            jc = jnp.clip(j, kv_lo(i), kv_hi(i) - 1)
-            return (b, h // G, jc, 0)
-
-        kernel = functools.partial(
-            _fwd_kernel, scale=scale, mask_fn=mask_fn,
-            score_fn=score_fn, kv_lo=kv_lo, kv_hi=kv_hi, nkv=nkv)
-        o, lse = pl.pallas_call(
-            kernel,
-            grid=(B, Hq, nq, nkv),
-            in_specs=[
-                _vmem_spec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-                _vmem_spec((1, 1, bkv, D), kv_index),
-                _vmem_spec((1, 1, bkv, D), kv_index),
-            ],
-            out_specs=[
-                _vmem_spec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-                _vmem_spec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i)),
-            ],
-            out_shape=[
-                jax.ShapeDtypeStruct((B, Hq, Sq, D), q.dtype),
-                jax.ShapeDtypeStruct((B, Hq, 1, Sq), jnp.float32),
-            ],
-            scratch_shapes=[
-                _scratch((bq, _LANES)),      # running max
-                _scratch((bq, _LANES)),      # running denominator
-                _scratch((bq, D)),           # fp32 output accumulator
-            ],
-            compiler_params=_compiler_params(3, 4),
-            interpret=_interpret(),
-        )(q, k, v)
+        o, lse = flash_fwd(q, k, v, **kw)
         return o, (q, k, v, o, lse)
 
     def _bwd(res, g):
@@ -343,75 +463,10 @@ def _attention_core(
         B, Hq, Sq, D = q.shape
         _, Hkv, Skv, _ = k.shape
         G = Hq // Hkv
-        bq = min(block_q, Sq)
-        bkv = min(block_kv, Skv)
-        nq = Sq // bq
-        nkv = Skv // bkv
         delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
                         axis=-1)[:, :, None, :]  # [B,Hq,1,Sq], lse layout
-
-        kv_lo, kv_hi = _kv_range(mask_type, window, prefix_len, bq, bkv, nkv)
-
-        def kv_index(b, h, i, j):
-            jc = jnp.clip(j, kv_lo(i), kv_hi(i) - 1)
-            return (b, h // G, jc, 0)
-
-        dq = pl.pallas_call(
-            functools.partial(_bwd_dq_kernel, scale=scale,
-                              mask_fn=mask_fn, score_fn=score_fn,
-                              kv_lo=kv_lo, kv_hi=kv_hi, nkv=nkv),
-            grid=(B, Hq, nq, nkv),
-            in_specs=[
-                _vmem_spec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-                _vmem_spec((1, 1, bkv, D), kv_index),
-                _vmem_spec((1, 1, bkv, D), kv_index),
-                _vmem_spec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-                _vmem_spec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i)),
-                _vmem_spec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i)),
-            ],
-            out_specs=_vmem_spec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            out_shape=jax.ShapeDtypeStruct((B, Hq, Sq, D), q.dtype),
-            scratch_shapes=[_scratch((bq, D))],
-            compiler_params=_compiler_params(3, 4),
-            interpret=_interpret(),
-        )(q, k, v, g, lse, delta)
-
-        q_lo, q_hi = _q_range(mask_type, window, prefix_len, bq, bkv, nq)
-
-        def q_index(b, h, i, j):
-            jc = jnp.clip(j, q_lo(i), q_hi(i) - 1)
-            return (b, h, jc, 0)
-
-        def stat_index(b, h, i, j):
-            jc = jnp.clip(j, q_lo(i), q_hi(i) - 1)
-            return (b, h, 0, jc)
-
-        dk_h, dv_h = pl.pallas_call(
-            functools.partial(_bwd_dkv_kernel, scale=scale,
-                              mask_fn=mask_fn, score_fn=score_fn,
-                              q_lo=q_lo, q_hi=q_hi, nq=nq),
-            grid=(B, Hq, nkv, nq),
-            in_specs=[
-                _vmem_spec((1, 1, bq, D), q_index),
-                _vmem_spec((1, 1, bkv, D), lambda b, h, i, j: (b, h // G, i, 0)),
-                _vmem_spec((1, 1, bkv, D), lambda b, h, i, j: (b, h // G, i, 0)),
-                _vmem_spec((1, 1, bq, D), q_index),
-                _vmem_spec((1, 1, 1, bq), stat_index),
-                _vmem_spec((1, 1, 1, bq), stat_index),
-            ],
-            out_specs=[
-                _vmem_spec((1, 1, bkv, D), lambda b, h, i, j: (b, h, i, 0)),
-                _vmem_spec((1, 1, bkv, D), lambda b, h, i, j: (b, h, i, 0)),
-            ],
-            out_shape=[
-                jax.ShapeDtypeStruct((B, Hq, Skv, D), k.dtype),
-                jax.ShapeDtypeStruct((B, Hq, Skv, D), v.dtype),
-            ],
-            scratch_shapes=[_scratch((bkv, D)), _scratch((bkv, D))],
-            compiler_params=_compiler_params(3, 4),
-            interpret=_interpret(),
-        )(q, k, v, g, lse, delta)
-
+        dq = flash_bwd_dq(q, k, v, g, lse, delta, **kw)
+        dk_h, dv_h = flash_bwd_dkv(q, k, v, g, lse, delta, **kw)
         # GQA: reduce per-query-head dK/dV over each group
         if G > 1:
             dk = dk_h.reshape(B, Hkv, G, Skv, D).sum(axis=2).astype(k.dtype)
@@ -460,16 +515,8 @@ def flash_attention(
     _, Skv, Hkv, _ = k.shape
     scale = (D ** -0.5) if scale is None else scale
 
-    def _fit(block, dim):
-        # Largest power-of-two block <= requested that divides the sequence,
-        # so e.g. Sq=768 tiles at 256 instead of falling off to the O(S^2)
-        # reference path. 128 is the TPU lane width / minimum tile.
-        while block > 128 and dim % block:
-            block //= 2
-        return min(block, dim)
-
-    block_q = _fit(block_q, Sq)
-    block_kv = _fit(block_kv, Skv)
+    block_q = fit_block(block_q, Sq)
+    block_kv = fit_block(block_kv, Skv)
 
     from . import masks as M
 

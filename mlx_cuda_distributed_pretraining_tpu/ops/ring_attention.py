@@ -5,12 +5,24 @@ longest context 4096, sliding-window masks only). This implements
 blockwise ring attention (Liu et al.): the sequence dim is sharded over the
 ``sp`` mesh axis; each device keeps its Q shard and rotates KV shards
 around the ring with ``jax.lax.ppermute`` over ICI, accumulating an online
-softmax. Attention memory per chip is O(S_local²) and the KV transfer
-overlaps with compute under XLA's async collective scheduling.
+softmax. The KV transfer overlaps with compute under XLA's async
+collective scheduling.
 
-Differentiable by construction (pure jnp inside a ``lax.scan``; wrap in
-``jax.checkpoint`` upstream for long sequences). Exact — the chunk-level
-mask uses global positions, so causality across shards is preserved.
+Perf-grade path (causal — the training case): each rotation chunk runs the
+**tiled Pallas flash kernels** (ops/flash_attention.py flash_fwd /
+flash_bwd_*), so per-chip attention memory is O(block_q x block_kv), not
+O(S_local²), and scores ride the MXU. Chunk-level block sparsity comes
+free from the ring structure: the diagonal chunk uses the causal kernel,
+fully-visible chunks use the full-mask kernel, invisible chunks are
+``lax.cond``-skipped entirely. The whole op is one ``jax.custom_vjp``:
+forward saves (o, global lse) per flash-attention-2; backward re-runs the
+tiled kernels per chunk with the global statistics and rotates dK/dV
+accumulators around the ring alongside K/V, landing them back on their
+owner after sp hops.
+
+Arbitrary mask mods fall back to a pure-jnp chunk path (exact, memory
+O(S_local²)) — custom masks are an inference/research surface, causal is
+the hot one.
 """
 
 from __future__ import annotations
@@ -24,32 +36,165 @@ import jax.numpy as jnp
 from .masks import NEG_INF, MaskMod
 
 
+def _ring_perm(sp: int):
+    return [(j, (j + 1) % sp) for j in range(sp)]
+
+
+# ---------------------------------------------------------------------------
+# Flash-kernel causal path
+# ---------------------------------------------------------------------------
+def _ring_attention_flash(q, k, v, axis_name: str, scale: float,
+                          block_q: int, block_kv: int):
+    """Causal ring attention with Pallas-tiled chunk math. Runs INSIDE
+    shard_map; q/k/v are local shards [B, S_local, H, D]."""
+    from . import masks as M
+    from .flash_attention import flash_bwd_dkv, flash_bwd_dq, flash_fwd
+
+    _causal_mask = M.causal()
+
+    B, Sl, Hq, D = q.shape
+    Hkv = k.shape[2]
+    G = Hq // Hkv
+    sp = jax.lax.axis_size(axis_name)
+    kw = dict(block_q=block_q, block_kv=block_kv, scale=scale)
+
+    @jax.custom_vjp
+    def attn(q, k, v):
+        o, _ = _fwd(q, k, v)
+        return o
+
+    def _chunk_fwd(qt, kt, vt, src, my):
+        """(o_c, lse_c) for one rotation chunk; lse_c rows of invisible
+        chunks are NEG_INF so the merge treats them as weight zero."""
+
+        def causal_case(ops):
+            # diag chunk: causality is local (q_global-k_global = r-c)
+            return flash_fwd(*ops, mask_type="causal", mask_fn=_causal_mask, **kw)
+
+        def offdiag_case(ops):
+            def full_case(ops):
+                return flash_fwd(*ops, mask_type="full", mask_fn=None, **kw)
+
+            def skip_case(ops):
+                qt = ops[0]
+                return (jnp.zeros_like(qt),
+                        jnp.full((B, Hq, 1, Sl), NEG_INF, jnp.float32))
+
+            return jax.lax.cond(src < my, full_case, skip_case, ops)
+
+        return jax.lax.cond(src == my, causal_case, offdiag_case, (qt, kt, vt))
+
+    def _fwd(q, k, v):
+        # axis_index must be taken fresh in BOTH fwd and bwd: a custom_vjp
+        # bwd runs in its own trace, so a closed-over traced index leaks.
+        my = jax.lax.axis_index(axis_name)
+        qt = q.transpose(0, 2, 1, 3)  # [B, Hq, Sl, D]
+
+        def step(carry, i):
+            k_cur, v_cur, m, num, den = carry
+            src = (my - i) % sp
+            o_c, lse_c = _chunk_fwd(qt, k_cur.transpose(0, 2, 1, 3),
+                                    v_cur.transpose(0, 2, 1, 3), src, my)
+            lse_c = lse_c[:, :, 0]                      # [B, Hq, Sl]
+            m_new = jnp.maximum(m, lse_c)
+            w_old = jnp.exp(m - m_new)
+            w_new = jnp.exp(lse_c - m_new)
+            num = num * w_old[..., None] + o_c.astype(jnp.float32) * w_new[..., None]
+            den = den * w_old + w_new
+            k_nxt = jax.lax.ppermute(k_cur, axis_name, _ring_perm(sp))
+            v_nxt = jax.lax.ppermute(v_cur, axis_name, _ring_perm(sp))
+            return (k_nxt, v_nxt, m_new, num, den), None
+
+        m0 = jnp.full((B, Hq, Sl), NEG_INF, jnp.float32)
+        num0 = jnp.zeros((B, Hq, Sl, D), jnp.float32)
+        den0 = jnp.zeros((B, Hq, Sl), jnp.float32)
+        (k_last, v_last, m, num, den), _ = jax.lax.scan(
+            step, (k, v, m0, num0, den0), jnp.arange(sp, dtype=jnp.int32))
+        den_safe = jnp.maximum(den, 1e-30)
+        ot = (num / den_safe[..., None]).astype(q.dtype)   # [B, Hq, Sl, D]
+        lse_g = (m + jnp.log(den_safe))[:, :, None, :]     # [B, Hq, 1, Sl]
+        o = ot.transpose(0, 2, 1, 3)
+        return o, (q, k, v, o, lse_g)
+
+    def _bwd(res, g):
+        q, k, v, o, lse_g = res
+        my = jax.lax.axis_index(axis_name)
+        qt = q.transpose(0, 2, 1, 3)
+        gt = g.transpose(0, 2, 1, 3)
+        delta = jnp.sum(gt.astype(jnp.float32) *
+                        o.transpose(0, 2, 1, 3).astype(jnp.float32),
+                        axis=-1)[:, :, None, :]            # [B, Hq, 1, Sl]
+
+        def chunk_bwd(kt, vt, src, my):
+            def causal_case(_):
+                dq_c = flash_bwd_dq(qt, kt, vt, gt, lse_g, delta,
+                                    mask_type="causal", mask_fn=_causal_mask, **kw)
+                dk_h, dv_h = flash_bwd_dkv(qt, kt, vt, gt, lse_g, delta,
+                                           mask_type="causal", mask_fn=_causal_mask, **kw)
+                return dq_c, dk_h, dv_h
+
+            def offdiag(_):
+                def full_case(_):
+                    dq_c = flash_bwd_dq(qt, kt, vt, gt, lse_g, delta,
+                                        mask_type="full", mask_fn=None, **kw)
+                    dk_h, dv_h = flash_bwd_dkv(qt, kt, vt, gt, lse_g, delta,
+                                               mask_type="full", mask_fn=None, **kw)
+                    return dq_c, dk_h, dv_h
+
+                def skip(_):
+                    return (jnp.zeros_like(qt),
+                            jnp.zeros((B, Hq, Sl, D), kt.dtype),
+                            jnp.zeros((B, Hq, Sl, D), vt.dtype))
+
+                return jax.lax.cond(src < my, full_case, skip, None)
+
+            return jax.lax.cond(src == my, causal_case, offdiag, None)
+
+        def step(carry, i):
+            k_cur, v_cur, dk_cur, dv_cur, dq = carry
+            src = (my - i) % sp
+            dq_c, dk_h, dv_h = chunk_bwd(k_cur.transpose(0, 2, 1, 3),
+                                         v_cur.transpose(0, 2, 1, 3), src, my)
+            dq = dq + dq_c.astype(jnp.float32)
+            # per-query-head -> per-kv-head, back to [B, Sl, Hkv, D]
+            dk_c = dk_h.reshape(B, Hkv, G, Sl, D).sum(axis=2).transpose(0, 2, 1, 3)
+            dv_c = dv_h.reshape(B, Hkv, G, Sl, D).sum(axis=2).transpose(0, 2, 1, 3)
+            dk_cur = dk_cur + dk_c.astype(jnp.float32)
+            dv_cur = dv_cur + dv_c.astype(jnp.float32)
+            # dK/dV accumulators ride the ring WITH their K/V chunk: after
+            # sp hops they are back on the owning device.
+            perm = _ring_perm(sp)
+            k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+            dk_nxt = jax.lax.ppermute(dk_cur, axis_name, perm)
+            dv_nxt = jax.lax.ppermute(dv_cur, axis_name, perm)
+            return (k_nxt, v_nxt, dk_nxt, dv_nxt, dq), None
+
+        dq0 = jnp.zeros((B, Hq, Sl, D), jnp.float32)
+        dkv0 = jnp.zeros((B, Sl, Hkv, D), jnp.float32)
+        (_, _, dk, dv, dqt), _ = jax.lax.scan(
+            step, (k, v, dkv0, dkv0, dq0), jnp.arange(sp, dtype=jnp.int32))
+        dq = dqt.transpose(0, 2, 1, 3).astype(q.dtype)
+        return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+    attn.defvjp(_fwd, _bwd)
+    return attn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Generic-mask jnp path (exact, O(S_local²) chunk scores)
+# ---------------------------------------------------------------------------
 def _chunk_scores(q, k, scale):
     """q [B, Sq, Hkv, G, D] x k [B, Skv, Hkv, D] -> [B, Hkv, G, Sq, Skv] f32."""
     return jnp.einsum("bqhgd,bkhd->bhgqk", q, k).astype(jnp.float32) * scale
 
 
-def ring_attention(
-    q: jnp.ndarray,
-    k: jnp.ndarray,
-    v: jnp.ndarray,
-    axis_name: str = "sp",
-    mask_mod: Optional[MaskMod] = None,
-    scale: Optional[float] = None,
-) -> jnp.ndarray:
-    """Runs INSIDE shard_map. q/k/v: local shards [B, S_local, H, D] with the
-    global sequence laid out contiguously across the axis. ``mask_mod``
-    takes GLOBAL (q_idx, kv_idx). Default mask is causal."""
+def _ring_attention_jnp(q, k, v, axis_name, mask_mod, scale):
     B, Sl, Hq, D = q.shape
     _, _, Hkv, _ = k.shape
     G = Hq // Hkv
-    scale = (D ** -0.5) if scale is None else scale
     sp = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
-    if mask_mod is None:
-        from . import masks as M
-
-        mask_mod = M.causal()
 
     qg = q.reshape(B, Sl, Hkv, G, D)
     q_idx = my * Sl + jnp.arange(Sl, dtype=jnp.int32)
@@ -73,10 +218,8 @@ def ring_attention(
     def step(carry, i):
         k_cur, v_cur, m, l, acc = carry
         m, l, acc = accumulate(m, l, acc, k_cur, v_cur, i)
-        # rotate KV around the ring (device d sends to d+1)
-        perm = [(j, (j + 1) % sp) for j in range(sp)]
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, _ring_perm(sp))
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, _ring_perm(sp))
         return (k_nxt, v_nxt, m, l, acc), None
 
     m0 = jnp.full((B, Hkv, G, Sl), NEG_INF, jnp.float32)
@@ -94,8 +237,37 @@ def ring_attention(
     return out.astype(q.dtype)
 
 
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str = "sp",
+    mask_mod: Optional[MaskMod] = None,
+    scale: Optional[float] = None,
+    block_q: int = 256,
+    block_kv: int = 512,
+) -> jnp.ndarray:
+    """Runs INSIDE shard_map. q/k/v: local shards [B, S_local, H, D] with the
+    global sequence laid out contiguously across the axis. ``mask_mod``
+    takes GLOBAL (q_idx, kv_idx). Default mask is causal (flash-kernel
+    path); non-causal mods use the exact jnp chunk path."""
+    from .flash_attention import fit_block
+
+    Sl, D = q.shape[1], q.shape[-1]
+    scale = (D ** -0.5) if scale is None else scale
+    plan = getattr(mask_mod, "_plan", None) if mask_mod is not None else ("causal", 0, 0)
+    bq = fit_block(block_q, Sl)
+    bkv = fit_block(block_kv, Sl)
+    if plan is not None and plan[0] == "causal" and Sl % bq == 0 and Sl % bkv == 0:
+        return _ring_attention_flash(q, k, v, axis_name, scale, bq, bkv)
+    from . import masks as M
+
+    return _ring_attention_jnp(q, k, v, axis_name, mask_mod or M.causal(), scale)
+
+
 def make_ring_attention(mesh, axis_name: str = "sp", mask_mod: Optional[MaskMod] = None,
-                        batch_axes=("dp", "fsdp")):
+                        batch_axes=("dp", "fsdp"), block_q: int = 256,
+                        block_kv: int = 512):
     """shard_map wrapper: [B, S_global, H, D] (sharded batch over dp/fsdp,
     sequence over sp) -> same. Heads/D replicated across sp."""
     from jax.sharding import PartitionSpec as P
@@ -104,7 +276,8 @@ def make_ring_attention(mesh, axis_name: str = "sp", mask_mod: Optional[MaskMod]
     data_spec = data if data else None
     spec = P(data_spec, axis_name, None, None)
 
-    fn = partial(ring_attention, axis_name=axis_name, mask_mod=mask_mod)
+    fn = partial(ring_attention, axis_name=axis_name, mask_mod=mask_mod,
+                 block_q=block_q, block_kv=block_kv)
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False,
     )

@@ -113,3 +113,24 @@ def test_ring_model_level_end_to_end():
         }
         state, metrics = step(state, batch)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_ring_non_divisible_shard_falls_back_exactly():
+    """S_local not tileable by the Pallas blocks (e.g. 24 rows) must route
+    to the exact jnp path, not silently truncate (r2 review finding)."""
+    mesh = _mesh({"sp": 4})
+    q, k, v = _qkv(s=768)  # S_local = 192: fit_block gives 128, 192 % 128 != 0
+    ring = make_ring_attention(mesh, mask_mod=M.causal())
+    out = jax.jit(ring)(q, k, v)
+    ref = reference_attention(q, k, v, mask_mod=M.causal())
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_flash_raw_entries_reject_non_divisible():
+    import pytest as _pytest
+
+    from mlx_cuda_distributed_pretraining_tpu.ops.flash_attention import flash_fwd
+
+    q = jnp.zeros((1, 2, 640, 16), jnp.float32)
+    with _pytest.raises(ValueError, match="block-divisible"):
+        flash_fwd(q, q, q, block_q=256, block_kv=256)
