@@ -338,11 +338,12 @@ def make_pipeline_train_step(
     remat: Optional[str] = None,
     zero_level: int = 0,
     params_like: Optional[Params] = None,
+    log_grad_norm: bool = False,
 ) -> Tuple[Callable, Any]:
     """Jitted ``step(state, batch) -> (state, metrics)`` with stacked params
     sharded over pp (plus the usual auto axes). ``params_like`` is the
     standard (list-of-layers) param tree used to derive shapes."""
-    from ..optim.base import apply_updates
+    from ..optim.base import apply_updates, global_norm
     from ..train.train_step import init_train_state
 
     assert params_like is not None
@@ -360,6 +361,10 @@ def make_pipeline_train_step(
             "toks": toks,
             "nonfinite": jnp.logical_not(jnp.isfinite(loss)).astype(jnp.int32),
         }
+        if log_grad_norm:
+            # grads are the global stacked tree; global_norm is exact under
+            # GSPMD (XLA inserts the cross-shard reductions).
+            metrics["grad_norm"] = global_norm(grads)
         return {"params": new_params, "opt_state": opt_state, "step": state["step"] + 1}, metrics
 
     stacked_like = jax.eval_shape(stack_layers, params_like)

@@ -187,8 +187,6 @@ class Trainer:
                     f"pipeline: gradient_accumulation_steps={self.accum_steps} folded "
                     f"into {self.microbatches} microbatches"
                 )
-            if cfg.logging.log_gradient_norm:
-                self.logger.log("pipeline: log_gradient_norm is not supported; ignoring")
             if cfg.training.batch_size % self.microbatches != 0:
                 raise ValueError(
                     f"batch_size {cfg.training.batch_size} must be divisible by "
@@ -203,6 +201,7 @@ class Trainer:
                 compute_dtype=self.compute_dtype, remat=self.remat,
                 zero_level=cfg.system.zero_optimization_level,
                 params_like=self.params,
+                log_grad_norm=cfg.logging.log_gradient_norm,
             )
             self.eval_step = jax.jit(make_pipeline_loss(
                 args, self.mesh, self.microbatches,
@@ -404,8 +403,11 @@ class Trainer:
         if not lf.get("enabled") or self.start_step > 0:
             return None
         if self.pipeline:
-            self.logger.log("LR finder is not supported with pipeline parallelism; skipping")
-            return None
+            raise ValueError(
+                "training.lr_finder.enabled is not supported with pipeline "
+                "parallelism (system.mesh.pp > 1) — run the finder on a "
+                "dense mesh and set the LR explicitly"
+            )
         self.logger.log("Running LR finder sweep")
         suggested, _, _ = run_lr_finder(
             self.state["params"], self.loss_fn,
