@@ -229,14 +229,28 @@ class SystemConfig:
     # Pipeline parallelism (pp mesh axis): microbatches per step. 0 means
     # 2 * pp-size (keeps the GPipe bubble fraction under 1/3).
     pipeline_microbatches: int = 0
+    # Fused chunked cross-entropy (ops/fused_ce.py): rows per chunk.
+    # 0 = always materialize full logits; -1 = auto (enable when the
+    # [B, S, V] logits tensor would be HBM-significant); >0 = fixed chunk.
+    fused_ce_chunk: int = -1
+    # Compute dtype. None derives it from mixed_precision; an explicit value
+    # is validated and normalized (float16 maps to bfloat16: TPUs have
+    # native bf16 MXU support and no fp16 fast path).
+    compute_dtype: Optional[str] = None
 
-    @property
-    def compute_dtype(self) -> str:
-        if not self.mixed_precision:
-            return "float32"
-        # float16 requested by legacy configs is mapped to bfloat16: TPUs have
-        # native bf16 MXU support and no fp16 fast path.
-        return "bfloat16"
+    def __post_init__(self):
+        if self.compute_dtype is None:
+            self.compute_dtype = "bfloat16" if self.mixed_precision else "float32"
+        else:
+            norm = str(self.compute_dtype).lower()
+            if norm in ("bfloat16", "bf16", "float16", "fp16", "half"):
+                self.compute_dtype = "bfloat16"
+            elif norm in ("float32", "fp32", "float"):
+                self.compute_dtype = "float32"
+            else:
+                raise ValueError(
+                    f"unknown system.compute_dtype: {self.compute_dtype!r} "
+                    "(expected bfloat16/float16/float32)")
 
 
 @dataclass

@@ -27,6 +27,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..ops import fused_ce
 from ..ops import masks as masks_lib
 from ..ops.attention import reference_attention
 
@@ -405,6 +406,7 @@ def forward(
     remat_ratio: float = 1.0,
     return_aux: bool = False,
     attend_len: Optional[int] = None,
+    return_hidden: bool = False,
 ) -> Tuple[jnp.ndarray, Optional[list]]:
     """tokens [B, S] int32 → (logits [B, S, V] fp32, new_cache | None).
 
@@ -414,6 +416,10 @@ def forward(
     ``return_aux=True`` appends the summed MoE aux loss:
     ``(logits, cache, aux)``. ``attend_len`` (static) bounds cached decode
     attention to a bucket of the cache — see :func:`attention_block`.
+    ``return_hidden=True`` skips the output projection and returns the
+    final normed hidden states [B, S, D] in compute dtype instead of
+    logits (the fused-CE loss folds the projection into the loss —
+    ops/fused_ce.py).
     """
     B, S = tokens.shape
     x = params["tok_embeddings"]["weight"].astype(compute_dtype)[tokens]
@@ -442,11 +448,27 @@ def forward(
             new_cache.append(c)
 
     x = rms_norm(x, params["norm"]["weight"], args.rms_norm_eps)
+    if return_hidden:
+        if return_aux:
+            return x, new_cache, aux_total
+        return x, new_cache
+    # Output projection accumulates in fp32 (preferred_element_type) so the
+    # logits never round through bf16 — bit-identical to the fused-CE path
+    # (ops/fused_ce.py) under any compute dtype.
     if args.tie_word_embeddings or "output" not in params:
-        logits = x @ params["tok_embeddings"]["weight"].astype(compute_dtype).T
+        logits = jax.lax.dot_general(
+            x, params["tok_embeddings"]["weight"].astype(compute_dtype),
+            (((2,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+        )
     else:
-        logits = _linear(x, cast(params["output"]))
-    logits = logits.astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            x, params["output"]["weight"].astype(compute_dtype),
+            (((2,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        if "bias" in params["output"]:
+            # Raw fp32 bias (not rounded through compute_dtype) — keeps this
+            # path bit-identical to fused_cross_entropy's bias handling.
+            logits = logits + params["output"]["bias"].astype(jnp.float32)
     if args.logit_scale:
         logits = logits * args.logit_scale
     if return_aux:
@@ -495,23 +517,54 @@ def loss_fn(
     remat: Optional[str] = None,
     remat_ratio: float = 1.0,
     include_aux: bool = True,
+    ce_chunk: int = -1,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Masked mean cross-entropy in fp32 (reference: core/training.py
     compute_loss :1195-1260). Returns (loss, token_count). MoE models add
     the pre-scaled router aux losses when ``include_aux`` (training); eval
     passes ``include_aux=False`` so val loss/ppl stay pure LM cross-entropy,
-    comparable with dense baselines."""
-    logits, _, aux = forward(
-        params, batch["inputs"], args, compute_dtype=compute_dtype,
-        remat=remat, remat_ratio=remat_ratio, return_aux=True,
-    )
+    comparable with dense baselines.
+
+    ``ce_chunk``: rows per fused-CE chunk (ops/fused_ce.py — folds the
+    output projection into a chunked loss, never materializing [B,S,V]
+    logits). 0 disables; -1 (default) auto-enables when the logits tensor
+    would be HBM-significant. Both paths run the projection with fp32
+    accumulation and reduce in fp32, so toggling ce_chunk changes memory
+    behavior only, not the computed loss."""
     targets = batch["targets"]
     mask = batch["mask"].astype(jnp.float32)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    nll = (logz - gold) * mask
     count = jnp.maximum(mask.sum(), 1.0)
-    loss = nll.sum() / count
+
+    B, S = batch["inputs"].shape
+    if ce_chunk < 0:
+        ce_chunk = fused_ce.auto_chunk(B, S, args.vocab_size)
+    untied = not args.tie_word_embeddings and "output" in params
+    if ce_chunk > 0:
+        hidden, _, aux = forward(
+            params, batch["inputs"], args, compute_dtype=compute_dtype,
+            remat=remat, remat_ratio=remat_ratio, return_aux=True,
+            return_hidden=True,
+        )
+        if untied:
+            w_vd = params["output"]["weight"].astype(compute_dtype).T
+            bias = params["output"].get("bias")
+        else:
+            w_vd = params["tok_embeddings"]["weight"].astype(compute_dtype)
+            bias = None
+        nll_sum = fused_ce.fused_cross_entropy(
+            hidden, w_vd, targets, mask, bias_v=bias,
+            logit_scale=args.logit_scale, chunk=ce_chunk,
+        )
+        loss = nll_sum / count
+    else:
+        logits, _, aux = forward(
+            params, batch["inputs"], args, compute_dtype=compute_dtype,
+            remat=remat, remat_ratio=remat_ratio, return_aux=True,
+        )
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+        nll = (logz - gold) * mask
+        loss = nll.sum() / count
     if args.is_moe and include_aux:
         loss = loss + aux  # pre-scaled inside moe_block
     return loss, mask.sum()

@@ -201,18 +201,22 @@ def make_pipeline_loss(
     compute_dtype=jnp.float32,
     remat: Optional[str] = None,
     include_aux: bool = True,
+    ce_chunk: int = -1,
 ) -> Callable:
     """Build ``loss(stacked_params, batch) -> (loss, token_count)`` running
     the GPipe schedule over the mesh's pp axis.
 
     ``batch`` leaves are [B, S(+1)]-shaped like the standard loss; B must be
-    divisible by ``num_microbatches``.
+    divisible by ``num_microbatches``. ``ce_chunk`` selects the fused
+    chunked CE for the last stage's vocab head (ops/fused_ce.py semantics:
+    0 = full logits, -1 = auto by microbatch logits size, >0 = fixed).
     """
     if getattr(args, "attention_type", "simple") == "ring":
         raise ValueError("ring (sp) attention inside a pipeline stage is not supported")
     P_stages = mesh.shape["pp"]
     M = num_microbatches
     from ..models.llama import transformer_block, rms_norm, _linear
+    from ..ops import fused_ce
 
     def stage_apply(layers_loc, x, positions):
         cast = partial(jax.tree_util.tree_map, lambda a: a.astype(compute_dtype))
@@ -232,7 +236,7 @@ def make_pipeline_loss(
         (x, aux), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)), layers_loc)
         return x, aux
 
-    def inner(layers_loc, embed_w, norm_w, out_w, tokens, targets, mask):
+    def inner(ce_rows, layers_loc, embed_w, norm_w, out_w, tokens, targets, mask):
         # layers_loc: stage slab [L/P, ...]; everything else replicated
         # w.r.t. pp (GSPMD may still shard over tp/fsdp).
         p = jax.lax.axis_index("pp")
@@ -248,7 +252,17 @@ def make_pipeline_loss(
 
         def head_nll(out, tgt, msk):
             h = rms_norm(out, norm_w, args.rms_norm_eps)
-            logits = (h @ out_w.astype(compute_dtype)).astype(jnp.float32)
+            if ce_rows > 0:
+                nll = fused_ce.fused_cross_entropy(
+                    h, out_w.astype(compute_dtype).T, tgt, msk,
+                    logit_scale=args.logit_scale, chunk=ce_rows,
+                )
+                return nll, msk.sum()
+            # fp32-accumulated projection — matches the non-pp loss exactly.
+            logits = jax.lax.dot_general(
+                h, out_w.astype(compute_dtype), (((2,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
             if args.logit_scale:
                 logits = logits * args.logit_scale
             logz = jax.nn.logsumexp(logits, axis=-1)
@@ -306,10 +320,14 @@ def make_pipeline_loss(
         else:
             out_w = stacked_params["output"]["weight"]
 
+        B, S = batch["inputs"].shape
+        ce_rows = ce_chunk
+        if ce_rows < 0:
+            ce_rows = fused_ce.auto_chunk(B // M, S, args.vocab_size)
         layer_in_specs = jax.tree_util.tree_map(lambda _: P("pp"), layers)
         bspec = P()  # batch enters replicated w.r.t. pp (auto axes may shard)
         sm = jax.shard_map(
-            inner,
+            partial(inner, ce_rows),
             mesh=mesh,
             in_specs=(layer_in_specs, P(), P(), P(), bspec, bspec, bspec),
             out_specs=(P(), P(), P()),
@@ -339,6 +357,7 @@ def make_pipeline_train_step(
     zero_level: int = 0,
     params_like: Optional[Params] = None,
     log_grad_norm: bool = False,
+    ce_chunk: int = -1,
 ) -> Tuple[Callable, Any]:
     """Jitted ``step(state, batch) -> (state, metrics)`` with stacked params
     sharded over pp (plus the usual auto axes). ``params_like`` is the
@@ -348,7 +367,8 @@ def make_pipeline_train_step(
 
     assert params_like is not None
     loss_fn = make_pipeline_loss(
-        args, mesh, num_microbatches, compute_dtype=compute_dtype, remat=remat
+        args, mesh, num_microbatches, compute_dtype=compute_dtype, remat=remat,
+        ce_chunk=ce_chunk,
     )
 
     def train_step(state, batch):

@@ -82,14 +82,24 @@ def main(argv=None):
     out_dir = a.output
     special = None
     if a.config:
+        import yaml
+
         from ..config import Config
 
-        cfg = Config.from_yaml(a.config)
+        with open(a.config) as f:
+            raw = yaml.safe_load(f) or {}
+        cfg = Config.from_dict(raw)
         tok_cfg = dict(cfg.data.tokenizer or {})
+        # Reference-compatible top-level `tokenizer:` section (reference:
+        # configs/tokenizer-config-sample.yaml — vocab_size/output_dir live
+        # outside the data section there).
+        top_tok = dict(raw.get("tokenizer") or {})
         if not inputs and cfg.data.input_file:
             inputs = [cfg.data.input_file]
-        vocab_size = vocab_size or int(tok_cfg.get("vocab_size", 32000))
-        out_dir = out_dir or cfg.data.tokenizer_path or "tokenizer"
+        vocab_size = vocab_size or int(
+            top_tok.get("vocab_size") or tok_cfg.get("vocab_size", 32000))
+        out_dir = (out_dir or top_tok.get("output_dir")
+                   or cfg.data.tokenizer_path or "tokenizer")
         st = tok_cfg.get("special_tokens")
         if st:
             special = list(st.values())

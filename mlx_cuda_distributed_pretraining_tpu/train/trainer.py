@@ -118,10 +118,13 @@ class Trainer:
         self.remat = remat
         self.remat_ratio = float(cfg.system.gradient_checkpointing_ratio)
 
+        ce_chunk = int(getattr(cfg.system, "fused_ce_chunk", -1))
+
         def loss_fn(params, batch):
             return arch.loss_fn(
                 params, batch, args, compute_dtype=self.compute_dtype,
                 remat=self.remat, remat_ratio=self.remat_ratio,
+                ce_chunk=ce_chunk,
             )
 
         # Validation excludes MoE router aux terms: val loss / ppl stay pure
@@ -129,7 +132,7 @@ class Trainer:
         def eval_loss_fn(params, batch):
             return arch.loss_fn(
                 params, batch, args, compute_dtype=self.compute_dtype,
-                include_aux=False,
+                include_aux=False, ce_chunk=ce_chunk,
             )
 
         self.loss_fn = loss_fn
@@ -202,10 +205,12 @@ class Trainer:
                 zero_level=cfg.system.zero_optimization_level,
                 params_like=self.params,
                 log_grad_norm=cfg.logging.log_gradient_norm,
+                ce_chunk=ce_chunk,
             )
             self.eval_step = jax.jit(make_pipeline_loss(
                 args, self.mesh, self.microbatches,
                 compute_dtype=self.compute_dtype, include_aux=False,
+                ce_chunk=ce_chunk,
             ))
             self.state = init_train_state(stack_layers(self.params), self.optimizer)
             self.state = jax.device_put(self.state, self.state_shardings)

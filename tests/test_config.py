@@ -131,3 +131,20 @@ def test_mesh_config():
         {"name": "t", "system": {"seed": 0, "device": "tpu", "mixed_precision": True, "precision": "float16"}}
     )
     assert cfg2.system.compute_dtype == "bfloat16"  # fp16 mapped to bf16 on TPU
+
+
+def test_system_compute_dtype_explicit_key():
+    """system.compute_dtype in YAML is honored even though the dataclass
+    derives it (it lands in _extras — the bench trainer config relies on
+    this)."""
+    from mlx_cuda_distributed_pretraining_tpu.config import Config
+
+    cfg = Config.from_dict({
+        "name": "t", "system": {"compute_dtype": "bfloat16"},
+    })
+    assert cfg.system.compute_dtype == "bfloat16"
+    cfg2 = Config.from_dict({"name": "t", "system": {}})
+    assert cfg2.system.compute_dtype == "float32"
+    cfg3 = Config.from_dict({"name": "t", "system": {"mixed_precision": True}})
+    assert cfg3.system.compute_dtype == "bfloat16"
+    assert cfg3.system.fused_ce_chunk == -1

@@ -9,13 +9,23 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_all_shipped_configs_load():
-    paths = glob.glob(os.path.join(REPO, "configs", "*.yaml"))
-    assert len(paths) >= 6
+    """Every shipped preset (incl. configs/models/ and configs/optimizers/)
+    loads, resolves model args, and builds its optimizer."""
+    from mlx_cuda_distributed_pretraining_tpu.models.llama import LlamaArgs
+    from mlx_cuda_distributed_pretraining_tpu.optim import build_optimizer
+
+    paths = glob.glob(os.path.join(REPO, "configs", "**", "*.yaml"), recursive=True)
+    assert len(paths) >= 25
     for p in paths:
         cfg = Config.from_yaml(p)
         assert cfg.name
+        if "tokenizer-config" in p:
+            continue  # tokenizer-training preset: no model/training sections
         assert cfg.model.hidden_size > 0
         assert cfg.training.batch_size > 0
+        args = LlamaArgs.from_config(cfg.model, vocab_size=259)
+        assert args.hidden_size == cfg.model.hidden_size
+        assert build_optimizer(cfg.training, 100) is not None
 
 
 def test_dryrun_multichip_8():

@@ -134,3 +134,81 @@ def test_tied_vs_untied_embeddings():
     assert "output" in p
     logits, _ = llama.forward(p, jnp.ones((1, 4), jnp.int32), untied)
     assert logits.shape == (1, 4, 64)
+
+
+def _batch_for(args, B=2, S=16, seed=3):
+    rng = np.random.default_rng(seed)
+    x = rng.integers(1, args.vocab_size - 1, size=(B, S + 1)).astype(np.int32)
+    mask = np.ones((B, S), np.float32)
+    mask[-1, S // 2:] = 0.0  # exercise masked positions
+    return {
+        "inputs": jnp.asarray(x[:, :-1]),
+        "targets": jnp.asarray(x[:, 1:]),
+        "mask": jnp.asarray(mask),
+    }
+
+
+def test_fused_ce_matches_unfused_loss_and_grads():
+    """Fused chunked CE (ops/fused_ce.py) is exact: same loss and same
+    gradients as the materialized-logits path, including a chunk size that
+    does not divide B*S (padding path)."""
+    params = llama.init_params(jax.random.PRNGKey(0), ARGS)
+    batch = _batch_for(ARGS)
+
+    def loss_unfused(p):
+        return llama.loss_fn(p, batch, ARGS, ce_chunk=0)[0]
+
+    for chunk in (8, 12, 64):  # 12 does not divide 32 -> padded rows
+        def loss_fused(p, c=chunk):
+            return llama.loss_fn(p, batch, ARGS, ce_chunk=c)[0]
+
+        l0, g0 = jax.value_and_grad(loss_unfused)(params)
+        l1, g1 = jax.value_and_grad(loss_fused)(params)
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5), g0, g1
+        )
+
+
+def test_fused_ce_untied_with_bias_and_logit_scale():
+    args = LlamaArgs(
+        vocab_size=64, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=8, max_position_embeddings=32,
+        tie_word_embeddings=False, logit_scale=0.5,
+    )
+    params = llama.init_params(jax.random.PRNGKey(1), args)
+    params["output"]["bias"] = jnp.asarray(
+        np.random.default_rng(0).normal(size=(64,)).astype(np.float32) * 0.1
+    )
+    batch = _batch_for(args)
+    l0, g0 = jax.value_and_grad(lambda p: llama.loss_fn(p, batch, args, ce_chunk=0)[0])(params)
+    l1, g1 = jax.value_and_grad(lambda p: llama.loss_fn(p, batch, args, ce_chunk=8)[0])(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5), g0, g1
+    )
+
+
+def test_fused_ce_auto_chunk_policy():
+    from mlx_cuda_distributed_pretraining_tpu.ops.fused_ce import auto_chunk
+
+    assert auto_chunk(2, 16, 64) == 0           # tiny: stays unfused
+    assert auto_chunk(16, 2048, 32768) == 2048  # bench shape: fused
+
+
+def test_fused_ce_bit_identical_bf16():
+    """Under bf16 compute the fused and unfused paths still agree: both run
+    the projection with fp32 accumulation and add the raw fp32 bias."""
+    args = LlamaArgs(
+        vocab_size=64, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=8, max_position_embeddings=32,
+        tie_word_embeddings=False,
+    )
+    params = llama.init_params(jax.random.PRNGKey(1), args)
+    params["output"]["bias"] = jnp.asarray(
+        np.random.default_rng(0).normal(size=(64,)).astype(np.float32)
+    )
+    batch = _batch_for(args)
+    l0 = llama.loss_fn(params, batch, args, compute_dtype=jnp.bfloat16, ce_chunk=0)[0]
+    l1 = llama.loss_fn(params, batch, args, compute_dtype=jnp.bfloat16, ce_chunk=8)[0]
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)

@@ -233,3 +233,18 @@ def test_trainer_pipeline_end_to_end(tmp_path):
         for x in jax.tree_util.tree_leaves(t3.state["opt_state"])
     ]
     assert sum(mu_leaves) > 0, "optimizer moments were lost across layouts"
+
+
+def test_pipeline_fused_ce_matches_unfused():
+    """ce_chunk threads through the pipeline head: fused chunked CE on the
+    last stage equals the full-logits pipeline loss and the single-device
+    reference (incl. a chunk that does not divide the microbatch rows)."""
+    mesh = _mesh()
+    params = llama.init_params(jax.random.PRNGKey(0), ARGS)
+    batch = _batch()
+    ref, _ = llama.loss_fn(params, batch, ARGS, ce_chunk=0)
+    stacked = pl.stack_layers(params)
+    for chunk in (8, 24):  # mb rows = (8/4)*16 = 32; 24 pads
+        loss_fn = pl.make_pipeline_loss(ARGS, mesh, num_microbatches=4, ce_chunk=chunk)
+        got, _ = jax.jit(loss_fn)(stacked, batch)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
