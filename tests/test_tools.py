@@ -390,3 +390,44 @@ def test_moe_import_from_hf_roundtrip(moe_run, tmp_path):
     for k in a:
         np.testing.assert_allclose(np.asarray(a[k]), np.asarray(b[k]), rtol=1e-6,
                                    err_msg=k)
+
+
+def test_prepare_dataset_one_command(tmp_path):
+    """Dataset onboarding in one command (reference:
+    prepare_tinystories_data.py flow): 'story'-keyed JSONL -> train/val
+    JSONL + trained tokenizer + runnable config."""
+    import json
+
+    from mlx_cuda_distributed_pretraining_tpu.config import Config
+    from mlx_cuda_distributed_pretraining_tpu.tools.prepare_dataset import (
+        prepare_dataset,
+    )
+
+    src = tmp_path / "stories.jsonl"
+    with open(src, "w") as f:
+        for i in range(120):
+            f.write(json.dumps({"story": f"Once upon a time number {i}. "
+                                          "The cat sat on the mat. " * 4}) + "\n")
+
+    out = str(tmp_path / "prepared")
+    manifest = prepare_dataset(str(src), out, vocab_size=300,
+                               val_fraction=0.1, seed=0, context_size=128)
+    assert manifest["text_key"] == "story"
+
+    n_train = sum(1 for _ in open(manifest["train"]))
+    n_val = sum(1 for _ in open(manifest["val"]))
+    assert n_train + n_val == 120 and n_val > 0
+    # every produced line is {"text": ...} regardless of the source key
+    first = json.loads(open(manifest["train"]).readline())
+    assert "text" in first and "Once upon a time" in first["text"]
+
+    assert os.path.isfile(os.path.join(manifest["tokenizer"], "tokenizer.json"))
+
+    cfg = Config.from_yaml(manifest["config"])
+    assert cfg.data.input_file == manifest["train"]
+    assert cfg.data.tokenizer_path == manifest["tokenizer"]
+    from mlx_cuda_distributed_pretraining_tpu.tokenizer import TokenizerManager
+
+    tok = TokenizerManager(cfg.data)
+    ids = tok.tokenize_doc("Once upon a time")
+    assert len(ids) > 0
