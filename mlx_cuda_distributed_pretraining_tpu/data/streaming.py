@@ -321,8 +321,15 @@ class HFStreamSource:
                                     streaming=True, cache_dir=cache_dir)
 
         ds = ds_factory()
-        if shuffle_buffer and shuffle_buffer > 1 and hasattr(ds, "shuffle"):
-            ds = ds.shuffle(seed=seed, buffer_size=shuffle_buffer)
+        if shuffle_buffer and shuffle_buffer > 1:
+            if hasattr(ds, "shuffle"):
+                ds = ds.shuffle(seed=seed, buffer_size=shuffle_buffer)
+            else:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "hf_stream: dataset object has no .shuffle; streaming "
+                    "UNSHUFFLED (shuffle_buffer=%d requested)", shuffle_buffer)
         self._manual_shard = False
         if process_count > 1:
             try:
@@ -539,7 +546,12 @@ class StreamingDataManager:
         try:
             stream = self._doc_stream()  # sets self._seekable/_hf_source
             if self._resume_state is not None and (
-                    self._seekable is not None or self._hf_resumed):
+                    (self._seekable is not None and "source" in self._resume_state)
+                    or self._hf_resumed):
+                # Guarded on the snapshot actually matching the source type:
+                # an hf-state checkpoint resumed into a local-shard run (or
+                # vice versa) must NOT splice a foreign token buffer onto a
+                # from-scratch stream.
                 # Exact resume: the source already seeked; restore the
                 # partial token buffer captured with the last served batch,
                 # so packing continues mid-stream bit-exactly.

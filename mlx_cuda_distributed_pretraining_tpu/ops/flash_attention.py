@@ -128,9 +128,34 @@ def _q_range(mask_type: str, window: int, prefix_len: int, block_q: int, block_k
     return lo, hi
 
 
+def _full_tile_fn(mask_type: str, window: int, prefix_len: int,
+                  block_q: int, block_kv: int):
+    """(qi, j) -> traced bool: is the whole [block_q, block_kv] tile valid
+    under the canonical mask? Interior tiles skip the iota/compare/select
+    mask work on the VPU entirely (the exp/matmul path is identical), which
+    matters because the kernel is VPU-bound between MXU calls — on a causal
+    mask roughly half the live tiles are interior. Only canonical masks
+    qualify; custom flex mask programs always evaluate in-tile."""
+    if mask_type not in ("causal", "sliding_window", "prefix_lm"):
+        return None
+
+    def full(qi, j):
+        min_row = qi * block_q
+        max_row = qi * block_q + block_q - 1
+        max_col = j * block_kv + block_kv - 1
+        causal_ok = max_col <= min_row
+        if mask_type == "causal":
+            return causal_ok
+        if mask_type == "sliding_window":
+            return causal_ok & (max_row - j * block_kv <= window - 1)
+        return causal_ok | (max_col < prefix_len)  # prefix_lm
+
+    return full
+
+
 # -- forward kernel ----------------------------------------------------------
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
-                scale, mask_fn, score_fn, kv_lo, kv_hi, nkv):
+                scale, mask_fn, score_fn, kv_lo, kv_hi, nkv, full_tile=None):
     j = pl.program_id(3)
     qi = pl.program_id(2)
     h = pl.program_id(1)
@@ -143,8 +168,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
         acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
 
-    @pl.when((j >= kv_lo(qi)) & (j < kv_hi(qi)))
-    def _compute():
+    def _compute(apply_mask):
         # Matmul operands stay in their storage dtype (bf16 in training) so
         # the MXU runs at full rate; accumulation is fp32.
         q = q_ref[0, 0]
@@ -152,12 +176,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         v = v_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
-        col = j * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
-        if score_fn is not None:
-            s = score_fn(s, row, col, h)
-        if mask_fn is not None:
-            s = jnp.where(mask_fn(row, col), s, NEG_INF)
+        if score_fn is not None or apply_mask:
+            row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
+            col = j * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
+            if score_fn is not None:
+                s = score_fn(s, row, col, h)
+            if apply_mask:
+                s = jnp.where(mask_fn(row, col), s, NEG_INF)
         m = m_scr[:, 0:1]                                    # [bq, 1]
         l = l_scr[:, 0:1]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -169,6 +194,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
             preferred_element_type=jnp.float32)
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    live = (j >= kv_lo(qi)) & (j < kv_hi(qi))
+    if mask_fn is None or full_tile is None:
+        @pl.when(live)
+        def _one_path():
+            _compute(apply_mask=mask_fn is not None)
+    else:
+        full = full_tile(qi, j)
+
+        @pl.when(live & full)
+        def _interior():
+            _compute(apply_mask=False)
+
+        @pl.when(live & jnp.logical_not(full))
+        def _edge():
+            _compute(apply_mask=True)
 
     @pl.when(j == nkv - 1)
     def _finalize():
@@ -184,7 +225,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
 
 # -- backward kernels --------------------------------------------------------
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *,
-                   scale, mask_fn, score_fn, kv_lo, kv_hi, nkv):
+                   scale, mask_fn, score_fn, kv_lo, kv_hi, nkv, full_tile=None):
     j = pl.program_id(3)
     qi = pl.program_id(2)
     h = pl.program_id(1)
@@ -195,8 +236,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
     def _init():
         dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
 
-    @pl.when((j >= kv_lo(qi)) & (j < kv_hi(qi)))
-    def _compute():
+    def _compute(apply_mask):
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
@@ -205,10 +245,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
         delta = delta_ref[0, 0, 0].astype(jnp.float32)
         s_raw = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32) * scale
-        row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
-        col = j * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
+        if score_fn is not None or apply_mask:
+            row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
+            col = j * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
         s = score_fn(s_raw, row, col, h) if score_fn is not None else s_raw
-        if mask_fn is not None:
+        if apply_mask:
             s = jnp.where(mask_fn(row, col), s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -222,13 +263,30 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
+    live = (j >= kv_lo(qi)) & (j < kv_hi(qi))
+    if mask_fn is None or full_tile is None:
+        @pl.when(live)
+        def _one_path():
+            _compute(apply_mask=mask_fn is not None)
+    else:
+        full = full_tile(qi, j)
+
+        @pl.when(live & full)
+        def _interior():
+            _compute(apply_mask=False)
+
+        @pl.when(live & jnp.logical_not(full))
+        def _edge():
+            _compute(apply_mask=True)
+
     @pl.when(j == nkv - 1)
     def _finalize():
         dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                    dk_scr, dv_scr, *, scale, mask_fn, score_fn, q_lo, q_hi, nq):
+                    dk_scr, dv_scr, *, scale, mask_fn, score_fn, q_lo, q_hi, nq,
+                    full_tile=None):
     j = pl.program_id(3)   # q tile (streamed)
     ki = pl.program_id(2)  # kv tile (resident)
     h = pl.program_id(1)
@@ -240,8 +298,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
         dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
 
-    @pl.when((j >= q_lo(ki)) & (j < q_hi(ki)))
-    def _compute():
+    def _compute(apply_mask):
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         q = q_ref[0, 0]
@@ -250,10 +307,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         delta = delta_ref[0, 0, 0].astype(jnp.float32)
         s_raw = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32) * scale
-        row = j * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
-        col = ki * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
+        if score_fn is not None or apply_mask:
+            row = j * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
+            col = ki * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
         s = score_fn(s_raw, row, col, h) if score_fn is not None else s_raw
-        if mask_fn is not None:
+        if apply_mask:
             s = jnp.where(mask_fn(row, col), s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
         dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
@@ -269,6 +327,24 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    live = (j >= q_lo(ki)) & (j < q_hi(ki))
+    # Tile geometry here is (q tile j, kv tile ki): same predicate with the
+    # roles passed in that order.
+    if mask_fn is None or full_tile is None:
+        @pl.when(live)
+        def _one_path():
+            _compute(apply_mask=mask_fn is not None)
+    else:
+        full = full_tile(j, ki)
+
+        @pl.when(live & full)
+        def _interior():
+            _compute(apply_mask=False)
+
+        @pl.when(live & jnp.logical_not(full))
+        def _edge():
+            _compute(apply_mask=True)
 
     @pl.when(j == nq - 1)
     def _finalize():
@@ -296,10 +372,14 @@ def _check_divisible(Sq, bq, Skv, bkv):
 
 # -- raw kernel entry points (reused by ring attention) ----------------------
 def flash_fwd(q, k, v, *, mask_fn=None, score_fn=None, mask_type="causal",
-              window=512, prefix_len=0, block_q=256, block_kv=512, scale=1.0):
+              window=512, prefix_len=0, block_q=256, block_kv=512, scale=1.0,
+              canonical_mask=False):
     """Raw tiled forward on [B, H, S, D] layout. Returns ``(o, lse)`` with
     lse laid out [B, Hq, 1, Sq]. Building block for the custom-vjp wrapper
-    and for ring attention's per-chunk calls."""
+    and for ring attention's per-chunk calls. ``canonical_mask`` asserts
+    that ``mask_fn`` computes exactly the ``mask_type`` predicate, enabling
+    the interior-tile fast path (skip in-tile masking where the tile is
+    provably fully valid)."""
     B, Hq, Sq, D = q.shape
     _, Hkv, Skv, _ = k.shape
     G = Hq // Hkv
@@ -309,6 +389,8 @@ def flash_fwd(q, k, v, *, mask_fn=None, score_fn=None, mask_type="causal",
     nq = Sq // bq
     nkv = Skv // bkv
     kv_lo, kv_hi = _kv_range(mask_type, window, prefix_len, bq, bkv, nkv)
+    full_tile = (_full_tile_fn(mask_type, window, prefix_len, bq, bkv)
+                 if canonical_mask else None)
 
     def kv_index(b, h, i, j):
         # Clamp skipped tiles into the live range so the pipeline never
@@ -319,7 +401,8 @@ def flash_fwd(q, k, v, *, mask_fn=None, score_fn=None, mask_type="causal",
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, mask_fn=mask_fn,
-        score_fn=score_fn, kv_lo=kv_lo, kv_hi=kv_hi, nkv=nkv)
+        score_fn=score_fn, kv_lo=kv_lo, kv_hi=kv_hi, nkv=nkv,
+        full_tile=full_tile)
     return pl.pallas_call(
         kernel,
         grid=(B, Hq, nq, nkv),
@@ -348,7 +431,7 @@ def flash_fwd(q, k, v, *, mask_fn=None, score_fn=None, mask_type="causal",
 
 def flash_bwd_dq(q, k, v, g, lse, delta, *, mask_fn=None, score_fn=None,
                  mask_type="causal", window=512, prefix_len=0,
-                 block_q=256, block_kv=512, scale=1.0):
+                 block_q=256, block_kv=512, scale=1.0, canonical_mask=False):
     """Raw dQ kernel. ``lse``/``delta``: [B, Hq, 1, Sq] fp32."""
     B, Hq, Sq, D = q.shape
     _, Hkv, Skv, _ = k.shape
@@ -359,6 +442,8 @@ def flash_bwd_dq(q, k, v, g, lse, delta, *, mask_fn=None, score_fn=None,
     nq = Sq // bq
     nkv = Skv // bkv
     kv_lo, kv_hi = _kv_range(mask_type, window, prefix_len, bq, bkv, nkv)
+    full_tile = (_full_tile_fn(mask_type, window, prefix_len, bq, bkv)
+                 if canonical_mask else None)
 
     def kv_index(b, h, i, j):
         jc = jnp.clip(j, kv_lo(i), kv_hi(i) - 1)
@@ -367,7 +452,8 @@ def flash_bwd_dq(q, k, v, g, lse, delta, *, mask_fn=None, score_fn=None,
     return pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale,
                           mask_fn=mask_fn, score_fn=score_fn,
-                          kv_lo=kv_lo, kv_hi=kv_hi, nkv=nkv),
+                          kv_lo=kv_lo, kv_hi=kv_hi, nkv=nkv,
+                          full_tile=full_tile),
         grid=(B, Hq, nq, nkv),
         in_specs=[
             _vmem_spec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
@@ -387,7 +473,7 @@ def flash_bwd_dq(q, k, v, g, lse, delta, *, mask_fn=None, score_fn=None,
 
 def flash_bwd_dkv(q, k, v, g, lse, delta, *, mask_fn=None, score_fn=None,
                   mask_type="causal", window=512, prefix_len=0,
-                  block_q=256, block_kv=512, scale=1.0):
+                  block_q=256, block_kv=512, scale=1.0, canonical_mask=False):
     """Raw dK/dV kernel. Returns per-QUERY-head grads [B, Hq, Skv, D]
     (caller reduces GQA groups)."""
     B, Hq, Sq, D = q.shape
@@ -399,6 +485,8 @@ def flash_bwd_dkv(q, k, v, g, lse, delta, *, mask_fn=None, score_fn=None,
     nq = Sq // bq
     nkv = Skv // bkv
     q_lo, q_hi = _q_range(mask_type, window, prefix_len, bq, bkv, nq)
+    full_tile = (_full_tile_fn(mask_type, window, prefix_len, bq, bkv)
+                 if canonical_mask else None)
 
     def q_index(b, h, i, j):
         jc = jnp.clip(j, q_lo(i), q_hi(i) - 1)
@@ -411,7 +499,8 @@ def flash_bwd_dkv(q, k, v, g, lse, delta, *, mask_fn=None, score_fn=None,
     return pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale,
                           mask_fn=mask_fn, score_fn=score_fn,
-                          q_lo=q_lo, q_hi=q_hi, nq=nq),
+                          q_lo=q_lo, q_hi=q_hi, nq=nq,
+                          full_tile=full_tile),
         grid=(B, Hq, nkv, nq),
         in_specs=[
             _vmem_spec((1, 1, bq, D), q_index),
@@ -438,7 +527,7 @@ def flash_bwd_dkv(q, k, v, g, lse, delta, *, mask_fn=None, score_fn=None,
 # -- host-side wrapper -------------------------------------------------------
 def _attention_core(
     mask_fn, score_fn, mask_type: str, window: int, prefix_len: int,
-    block_q: int, block_kv: int, scale: float,
+    block_q: int, block_kv: int, scale: float, canonical_mask: bool = False,
 ):
     """Build the custom-vjp flash attention for a fixed mask/score program.
 
@@ -447,7 +536,7 @@ def _attention_core(
     """
     kw = dict(mask_fn=mask_fn, score_fn=score_fn, mask_type=mask_type,
               window=window, prefix_len=prefix_len, block_q=block_q,
-              block_kv=block_kv, scale=scale)
+              block_kv=block_kv, scale=scale, canonical_mask=canonical_mask)
 
     @jax.custom_vjp
     def attn(q, k, v):
@@ -480,8 +569,10 @@ def _attention_core(
 
 
 @functools.lru_cache(maxsize=64)
-def _cached_core(mask_fn, score_fn, mask_type, window, prefix_len, block_q, block_kv, scale):
-    return _attention_core(mask_fn, score_fn, mask_type, window, prefix_len, block_q, block_kv, scale)
+def _cached_core(mask_fn, score_fn, mask_type, window, prefix_len, block_q,
+                 block_kv, scale, canonical_mask=False):
+    return _attention_core(mask_fn, score_fn, mask_type, window, prefix_len,
+                           block_q, block_kv, scale, canonical_mask)
 
 
 # Defaults from an on-chip sweep (scripts/bench_attention.py) on TPU v5e:
@@ -520,6 +611,17 @@ def flash_attention(
 
     from . import masks as M
 
+    # Canonical = the in-tile predicate provably equals the mask_type plan:
+    # either we derive it here, or the caller (flex path) passes a
+    # builder-tagged mod whose _plan matches (masks.py tags every named
+    # builder) — then interior tiles may skip in-tile masking.
+    plan = getattr(mask_fn, "_plan", None)
+    canonical = mask_fn is None or (
+        plan is not None
+        and plan[0] == mask_type
+        and (mask_type != "sliding_window" or plan[1] == window_size)
+        and (mask_type != "prefix_lm" or plan[2] == prefix_len)
+    )
     if mask_fn is None:
         mask_fn = {
             "causal": M.causal(),
@@ -548,7 +650,7 @@ def flash_attention(
         return reference_attention(q, k, v, mask_mod=mask_fn, score_mod=ref_score, scale=scale)
 
     core = _cached_core(mask_fn, score_fn, mask_type, window_size, prefix_len,
-                        block_q, block_kv, float(scale))
+                        block_q, block_kv, float(scale), canonical)
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)

@@ -220,3 +220,34 @@ def test_model_level_flash_matches_simple():
     l_simple, _ = llama.forward(params, tokens, base)
     l_flash, _ = llama.forward(params, tokens, flash)
     np.testing.assert_allclose(np.asarray(l_simple), np.asarray(l_flash), atol=1e-3, rtol=1e-3)
+
+
+def test_interior_tile_fast_path_matches():
+    """canonical_mask=True (interior tiles skip in-tile masking) produces
+    identical outputs to the always-masked path for every canonical mask."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlx_cuda_distributed_pretraining_tpu.ops import masks as M
+    from mlx_cuda_distributed_pretraining_tpu.ops.flash_attention import flash_fwd
+
+    B, H, S, D = 1, 2, 512, 32
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (B, H, S, D), jnp.float32)
+    k = jax.random.normal(kk, (B, H, S, D), jnp.float32)
+    v = jax.random.normal(kv, (B, H, S, D), jnp.float32)
+    cases = [
+        ("causal", M.causal(), {}),
+        ("sliding_window", M.sliding_window(96), {"window": 96}),
+        ("prefix_lm", M.prefix_lm(130), {"prefix_len": 130}),
+    ]
+    for mask_type, mask_fn, kw in cases:
+        o0, l0 = flash_fwd(q, k, v, mask_fn=mask_fn, mask_type=mask_type,
+                           block_q=128, block_kv=128, canonical_mask=False, **kw)
+        o1, l1 = flash_fwd(q, k, v, mask_fn=mask_fn, mask_type=mask_type,
+                           block_q=128, block_kv=128, canonical_mask=True, **kw)
+        np.testing.assert_allclose(np.asarray(o0), np.asarray(o1), atol=1e-6,
+                                   err_msg=mask_type)
+        np.testing.assert_allclose(np.asarray(l0), np.asarray(l1), atol=1e-6,
+                                   err_msg=mask_type)

@@ -384,3 +384,29 @@ def test_hf_stream_skip_replay_fallback(tmp_path):
 
     doc_ids = [int(m) for m in re.findall(r"plaindoc (\d+)", text)]
     assert doc_ids and min(doc_ids) >= state["docs_consumed"] - 1
+
+
+def test_cross_source_resume_does_not_splice_foreign_buffer(tmp_path):
+    """Resuming an hf_stream checkpoint into a local-shard run must not
+    restore the foreign packer buffer — the shard run starts clean."""
+    tok = _tokenizer(tmp_path)
+    a = StreamingDataManager(_hf_cfg(_FakeHubDS), tok, batch_size=2, seq_len=32)
+    for i in range(2):
+        a.generate_batch(i)
+    hf_state = a.state_dict()
+    a.stop()
+    assert "hf" in hf_state
+
+    p = str(tmp_path / "s0.jsonl")
+    _write_shard(p, 40)
+    fresh = StreamingDataManager(_streaming_cfg(tmp_path, [p]), tok,
+                                 batch_size=2, seq_len=32)
+    want = fresh.generate_batch(0)
+    fresh.stop()
+
+    resumed = StreamingDataManager(_streaming_cfg(tmp_path, [p]), tok,
+                                   batch_size=2, seq_len=32)
+    resumed.load_state_dict(hf_state)
+    got = resumed.generate_batch(0)
+    resumed.stop()
+    np.testing.assert_array_equal(got["inputs"], want["inputs"])
