@@ -222,13 +222,31 @@ def bench_decode_case(scale_key, vocab, prompt=512, max_len=2048,
     )
     params = llama.init_params(jax.random.PRNGKey(0), args)
     B, P = 8, prompt
+    # Chunked prefill: feeding the whole prompt through the cached-attention
+    # path at once would materialize [B, H, P, P] scores (26 GB at P=8192);
+    # chunks of 512 keep the transient to [B, H, 512, attend].
+    PREFILL_CHUNK = min(512, P)
+    assert P % PREFILL_CHUNK == 0, (
+        f"prompt {P} must be a multiple of the prefill chunk {PREFILL_CHUNK}"
+        " (floor-divided chunks would silently drop the prompt tail)")
 
     @partial(jax.jit, static_argnums=(2,))
     def prefill_fwd(params, toks, attend_len):
         cache = llama.init_cache(args, B, max_len=max_len, dtype=jnp.bfloat16,
                                  quantize=quantize)
-        logits, cache = llama.forward(params, toks, args, cache=cache,
-                                      start_pos=0, attend_len=attend_len)
+        n_chunks = toks.shape[1] // PREFILL_CHUNK
+
+        def body(i, carry):
+            cache, logits = carry
+            chunk = jax.lax.dynamic_slice_in_dim(toks, i * PREFILL_CHUNK,
+                                                 PREFILL_CHUNK, axis=1)
+            logits, cache = llama.forward(params, chunk, args, cache=cache,
+                                          start_pos=i * PREFILL_CHUNK,
+                                          attend_len=attend_len)
+            return cache, logits
+
+        logits0 = jnp.zeros((B, PREFILL_CHUNK, vocab), jnp.float32)
+        cache, logits = jax.lax.fori_loop(0, n_chunks, body, (cache, logits0))
         return logits, cache
 
     @partial(jax.jit, static_argnums=(3, 4))
@@ -263,7 +281,9 @@ def bench_decode_case(scale_key, vocab, prompt=512, max_len=2048,
         sync(prefill_chain(params, toks, n))
         ts[n] = time.perf_counter() - t0
     prefill_s = (ts[6] - ts[2]) / 4
-    prefill_tok_s = B * P / max(prefill_s, 1e-9)
+    # Two-point differences can come out ~0 on degenerate timers; report
+    # null rather than an absurd number.
+    prefill_tok_s = round(B * P / prefill_s, 0) if prefill_s > 1e-5 else None
 
     _, cache = prefill_fwd(params, toks, P)
     tok0 = jnp.ones((B,), jnp.int32)
@@ -274,12 +294,13 @@ def bench_decode_case(scale_key, vocab, prompt=512, max_len=2048,
         sync(decode_chain(params, cache, tok0, n, attend))
         ts[n] = time.perf_counter() - t0
     per_step = (ts[40] - ts[8]) / 32
+    ok = per_step > 1e-6
     return {
         "case": name or f"decode_{scale_key}", "batch": B, "prompt": P,
         "max_len": max_len, "attend_bucket": attend, "kv_int8": quantize,
-        "decode_tok_s": round(B / max(per_step, 1e-9), 1),
-        "decode_step_ms": round(per_step * 1e3, 2),
-        "prefill_tok_s": round(prefill_tok_s, 0),
+        "decode_tok_s": round(B / per_step, 1) if ok else None,
+        "decode_step_ms": round(per_step * 1e3, 2) if ok else None,
+        "prefill_tok_s": prefill_tok_s,
     }
 
 

@@ -153,6 +153,27 @@ def _full_tile_fn(mask_type: str, window: int, prefix_len: int,
     return full
 
 
+def _tile_dispatch(live, full, compute, masked):
+    """Shared live/interior/edge tile dispatch for all three kernels.
+
+    ``compute(apply_mask)`` runs the tile body; ``full`` is the traced
+    is-fully-valid predicate for THIS tile (None = no fast path) and
+    ``masked`` whether a mask program exists at all. Interior tiles skip
+    the in-tile mask work; edge tiles mask as usual."""
+    if not masked or full is None:
+        @pl.when(live)
+        def _one_path():
+            compute(apply_mask=masked)
+    else:
+        @pl.when(live & full)
+        def _interior():
+            compute(apply_mask=False)
+
+        @pl.when(live & jnp.logical_not(full))
+        def _edge():
+            compute(apply_mask=True)
+
+
 # -- forward kernel ----------------------------------------------------------
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
                 scale, mask_fn, score_fn, kv_lo, kv_hi, nkv, full_tile=None):
@@ -196,20 +217,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
     live = (j >= kv_lo(qi)) & (j < kv_hi(qi))
-    if mask_fn is None or full_tile is None:
-        @pl.when(live)
-        def _one_path():
-            _compute(apply_mask=mask_fn is not None)
-    else:
-        full = full_tile(qi, j)
-
-        @pl.when(live & full)
-        def _interior():
-            _compute(apply_mask=False)
-
-        @pl.when(live & jnp.logical_not(full))
-        def _edge():
-            _compute(apply_mask=True)
+    _tile_dispatch(live, full_tile(qi, j) if full_tile else None,
+                   _compute, mask_fn is not None)
 
     @pl.when(j == nkv - 1)
     def _finalize():
@@ -264,20 +273,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
             preferred_element_type=jnp.float32)
 
     live = (j >= kv_lo(qi)) & (j < kv_hi(qi))
-    if mask_fn is None or full_tile is None:
-        @pl.when(live)
-        def _one_path():
-            _compute(apply_mask=mask_fn is not None)
-    else:
-        full = full_tile(qi, j)
-
-        @pl.when(live & full)
-        def _interior():
-            _compute(apply_mask=False)
-
-        @pl.when(live & jnp.logical_not(full))
-        def _edge():
-            _compute(apply_mask=True)
+    _tile_dispatch(live, full_tile(qi, j) if full_tile else None,
+                   _compute, mask_fn is not None)
 
     @pl.when(j == nkv - 1)
     def _finalize():
@@ -329,22 +326,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
             preferred_element_type=jnp.float32)
 
     live = (j >= q_lo(ki)) & (j < q_hi(ki))
-    # Tile geometry here is (q tile j, kv tile ki): same predicate with the
-    # roles passed in that order.
-    if mask_fn is None or full_tile is None:
-        @pl.when(live)
-        def _one_path():
-            _compute(apply_mask=mask_fn is not None)
-    else:
-        full = full_tile(j, ki)
-
-        @pl.when(live & full)
-        def _interior():
-            _compute(apply_mask=False)
-
-        @pl.when(live & jnp.logical_not(full))
-        def _edge():
-            _compute(apply_mask=True)
+    # Tile geometry here is (q tile j, kv tile ki): full_tile takes
+    # (query tile, kv tile) in that order.
+    _tile_dispatch(live, full_tile(j, ki) if full_tile else None,
+                   _compute, mask_fn is not None)
 
     @pl.when(j == nq - 1)
     def _finalize():

@@ -119,6 +119,14 @@ class Trainer:
         self.remat_ratio = float(cfg.system.gradient_checkpointing_ratio)
 
         ce_chunk = int(getattr(cfg.system, "fused_ce_chunk", -1))
+        if (ce_chunk == -1 and self.mesh is not None
+                and "sp" in self.mesh.axis_names and self.mesh.shape["sp"] > 1):
+            # Fused CE chunks over flattened B*S rows; with the sequence dim
+            # sharded (sp) that reshape has no valid GSPMD sharding and would
+            # all-gather the hidden states. Auto mode therefore stays off on
+            # sp meshes (explicit fused_ce_chunk > 0 is respected if set).
+            ce_chunk = 0
+            self.logger.log("fused CE auto-disabled on sp mesh (sequence-sharded)")
 
         def loss_fn(params, batch):
             return arch.loss_fn(
