@@ -87,10 +87,16 @@ def _compiler_params(n_parallel: int, n_total: int):
 # -- tile-range planners (block sparsity per mask type) ----------------------
 def _kv_range(mask_type: str, window: int, prefix_len: int, block_q: int, block_kv: int,
               num_kv_blocks: int):
-    """(qi -> lo, qi -> hi) KV-tile bounds for a given query tile."""
+    """(qi -> lo, qi -> hi) KV-tile bounds for a given query tile.
+
+    ``band`` is the sliding-window left edge alone — valid iff
+    ``row - col < window`` with NO causal bound (window may be <= 0):
+    the shape of an off-diagonal rotation chunk in sliding-window ring
+    attention, where the inter-chunk offset already guarantees causality.
+    """
 
     def lo(qi):
-        if mask_type == "sliding_window":
+        if mask_type in ("sliding_window", "band"):
             # row_min = qi*bq; cols >= row_min - window + 1 can contribute,
             # but the prefix region [0, prefix) never applies here.
             return jnp.maximum((qi * block_q - window + 1) // block_kv, 0)
@@ -102,7 +108,7 @@ def _kv_range(mask_type: str, window: int, prefix_len: int, block_q: int, block_
         if mask_type == "prefix_lm":
             causal_hi = pl.cdiv(qi * block_q + block_q, block_kv)
             return jnp.minimum(jnp.maximum(causal_hi, pl.cdiv(prefix_len, block_kv)), num_kv_blocks)
-        return jnp.int32(num_kv_blocks)
+        return jnp.int32(num_kv_blocks)  # full / band
 
     return lo, hi
 
@@ -115,14 +121,16 @@ def _q_range(mask_type: str, window: int, prefix_len: int, block_q: int, block_k
         if mask_type in ("causal", "sliding_window"):
             # first q row that can see this kv tile is its own diagonal row
             return (ki * block_kv) // block_q
-        # full / prefix_lm: every q tile can reach every kv tile
+        # full / prefix_lm / band: every q tile can reach every kv tile
+        # (band: rows below the edge are bounded by hi, not lo)
         return jnp.int32(0)
 
     def hi(ki):
-        if mask_type == "sliding_window":
+        if mask_type in ("sliding_window", "band"):
             # rows < col_max + window
-            return jnp.minimum(pl.cdiv(ki * block_kv + block_kv - 1 + window, block_q) + 1,
-                               num_q_blocks)
+            return jnp.maximum(jnp.minimum(
+                pl.cdiv(ki * block_kv + block_kv - 1 + window, block_q) + 1,
+                num_q_blocks), 0)
         return jnp.int32(num_q_blocks)
 
     return lo, hi
@@ -136,7 +144,7 @@ def _full_tile_fn(mask_type: str, window: int, prefix_len: int,
     matters because the kernel is VPU-bound between MXU calls — on a causal
     mask roughly half the live tiles are interior. Only canonical masks
     qualify; custom flex mask programs always evaluate in-tile."""
-    if mask_type not in ("causal", "sliding_window", "prefix_lm"):
+    if mask_type not in ("causal", "sliding_window", "prefix_lm", "band"):
         return None
 
     def full(qi, j):
@@ -148,6 +156,8 @@ def _full_tile_fn(mask_type: str, window: int, prefix_len: int,
             return causal_ok
         if mask_type == "sliding_window":
             return causal_ok & (max_row - j * block_kv <= window - 1)
+        if mask_type == "band":  # row - col < window, no causal bound
+            return max_row - j * block_kv <= window - 1
         return causal_ok | (max_col < prefix_len)  # prefix_lm
 
     return full
@@ -380,8 +390,12 @@ def flash_fwd(q, k, v, *, mask_fn=None, score_fn=None, mask_type="causal",
     def kv_index(b, h, i, j):
         # Clamp skipped tiles into the live range so the pipeline never
         # DMAs a tile the kernel will not touch (block sparsity saves
-        # bandwidth, not just FLOPs).
-        jc = jnp.clip(j, kv_lo(i), kv_hi(i) - 1)
+        # bandwidth, not just FLOPs). Empty ranges (possible for band
+        # masks: lo can exceed nkv-1, hi-1 can go below lo) are clamped
+        # into [0, nkv-1] from BOTH sides — jnp.clip resolves inverted
+        # bounds toward the upper one, which is always in range.
+        jc = jnp.clip(j, jnp.minimum(kv_lo(i), nkv - 1),
+                      jnp.maximum(kv_hi(i) - 1, 0))
         return (b, h // G, jc, 0)
 
     kernel = functools.partial(
@@ -431,7 +445,8 @@ def flash_bwd_dq(q, k, v, g, lse, delta, *, mask_fn=None, score_fn=None,
                  if canonical_mask else None)
 
     def kv_index(b, h, i, j):
-        jc = jnp.clip(j, kv_lo(i), kv_hi(i) - 1)
+        jc = jnp.clip(j, jnp.minimum(kv_lo(i), nkv - 1),
+                      jnp.maximum(kv_hi(i) - 1, 0))
         return (b, h // G, jc, 0)
 
     return pl.pallas_call(
@@ -474,11 +489,13 @@ def flash_bwd_dkv(q, k, v, g, lse, delta, *, mask_fn=None, score_fn=None,
                  if canonical_mask else None)
 
     def q_index(b, h, i, j):
-        jc = jnp.clip(j, q_lo(i), q_hi(i) - 1)
+        jc = jnp.clip(j, jnp.minimum(q_lo(i), nq - 1),
+                      jnp.maximum(q_hi(i) - 1, 0))
         return (b, h, jc, 0)
 
     def stat_index(b, h, i, j):
-        jc = jnp.clip(j, q_lo(i), q_hi(i) - 1)
+        jc = jnp.clip(j, jnp.minimum(q_lo(i), nq - 1),
+                      jnp.maximum(q_hi(i) - 1, 0))
         return (b, h, 0, jc)
 
     return pl.pallas_call(

@@ -71,6 +71,20 @@ def sliding_window(window: int, causal_: bool = True) -> MaskMod:
 
 
 @lru_cache(maxsize=None)
+def band(window: int) -> MaskMod:
+    """Left band alone: valid iff ``q - k < window``, NO causal bound
+    (window may be <= 0). The shape of an off-diagonal rotation chunk in
+    sliding-window ring attention, where the inter-chunk offset already
+    guarantees causality (ops/ring_attention.py)."""
+
+    def mod(q, k):
+        return (q - k) < window
+
+    mod._plan = ("band", window, 0)
+    return mod
+
+
+@lru_cache(maxsize=None)
 def prefix_lm(prefix_len: int) -> MaskMod:
     """Bidirectional over the first ``prefix_len`` tokens, causal after."""
 

@@ -8,7 +8,8 @@ around the ring with ``jax.lax.ppermute`` over ICI, accumulating an online
 softmax. The KV transfer overlaps with compute under XLA's async
 collective scheduling.
 
-Perf-grade path (causal — the training case): each rotation chunk runs the
+Perf-grade paths (causal AND sliding-window — the training cases): each
+rotation chunk runs the
 **tiled Pallas flash kernels** (ops/flash_attention.py flash_fwd /
 flash_bwd_*), so per-chip attention memory is O(block_q x block_kv), not
 O(S_local²), and scores ride the MXU. Chunk-level block sparsity comes
@@ -20,9 +21,13 @@ tiled kernels per chunk with the global statistics and rotates dK/dV
 accumulators around the ring alongside K/V, landing them back on their
 owner after sp hops.
 
+Sliding-window rings additionally stop rotating once the window is
+exhausted (_ring_attention_flash_sw) — a 1024-token window on a 32k
+sequence over sp=8 does 1-2 KV hops instead of 8.
+
 Arbitrary mask mods fall back to a pure-jnp chunk path (exact, memory
-O(S_local²)) — custom masks are an inference/research surface, causal is
-the hot one.
+O(S_local²)) — custom masks are an inference/research surface; causal and
+sliding-window are the hot ones.
 """
 
 from __future__ import annotations
@@ -38,6 +43,23 @@ from .masks import NEG_INF, MaskMod
 
 def _ring_perm(sp: int):
     return [(j, (j + 1) % sp) for j in range(sp)]
+
+
+def _merge_chunk(m, num, den, o_c, lse_c):
+    """Online-softmax merge of one chunk's (o, lse) into the running
+    (max, numerator, denominator). lse_c: [B, Hq, Sl] (invisible chunks
+    carry NEG_INF rows => weight exp(NEG_INF - m_new) == 0)."""
+    m_new = jnp.maximum(m, lse_c)
+    w_old = jnp.exp(m - m_new)
+    w_new = jnp.exp(lse_c - m_new)
+    num = num * w_old[..., None] + o_c.astype(jnp.float32) * w_new[..., None]
+    den = den * w_old + w_new
+    return m_new, num, den
+
+
+def _gqa_reduce(d_h, B, Hkv, G, Sl, D):
+    """Per-query-head dK/dV [B, Hq, Sl, D] -> per-kv-head [B, Sl, Hkv, D]."""
+    return d_h.reshape(B, Hkv, G, Sl, D).sum(axis=2).transpose(0, 2, 1, 3)
 
 
 # ---------------------------------------------------------------------------
@@ -95,15 +117,10 @@ def _ring_attention_flash(q, k, v, axis_name: str, scale: float,
             src = (my - i) % sp
             o_c, lse_c = _chunk_fwd(qt, k_cur.transpose(0, 2, 1, 3),
                                     v_cur.transpose(0, 2, 1, 3), src, my)
-            lse_c = lse_c[:, :, 0]                      # [B, Hq, Sl]
-            m_new = jnp.maximum(m, lse_c)
-            w_old = jnp.exp(m - m_new)
-            w_new = jnp.exp(lse_c - m_new)
-            num = num * w_old[..., None] + o_c.astype(jnp.float32) * w_new[..., None]
-            den = den * w_old + w_new
+            m, num, den = _merge_chunk(m, num, den, o_c, lse_c[:, :, 0])
             k_nxt = jax.lax.ppermute(k_cur, axis_name, _ring_perm(sp))
             v_nxt = jax.lax.ppermute(v_cur, axis_name, _ring_perm(sp))
-            return (k_nxt, v_nxt, m_new, num, den), None
+            return (k_nxt, v_nxt, m, num, den), None
 
         m0 = jnp.full((B, Hq, Sl), NEG_INF, jnp.float32)
         num0 = jnp.zeros((B, Hq, Sl, D), jnp.float32)
@@ -157,10 +174,8 @@ def _ring_attention_flash(q, k, v, axis_name: str, scale: float,
                                          v_cur.transpose(0, 2, 1, 3), src, my)
             dq = dq + dq_c.astype(jnp.float32)
             # per-query-head -> per-kv-head, back to [B, Sl, Hkv, D]
-            dk_c = dk_h.reshape(B, Hkv, G, Sl, D).sum(axis=2).transpose(0, 2, 1, 3)
-            dv_c = dv_h.reshape(B, Hkv, G, Sl, D).sum(axis=2).transpose(0, 2, 1, 3)
-            dk_cur = dk_cur + dk_c.astype(jnp.float32)
-            dv_cur = dv_cur + dv_c.astype(jnp.float32)
+            dk_cur = dk_cur + _gqa_reduce(dk_h, B, Hkv, G, Sl, D).astype(jnp.float32)
+            dv_cur = dv_cur + _gqa_reduce(dv_h, B, Hkv, G, Sl, D).astype(jnp.float32)
             # dK/dV accumulators ride the ring WITH their K/V chunk: after
             # sp hops they are back on the owning device.
             perm = _ring_perm(sp)
@@ -176,6 +191,137 @@ def _ring_attention_flash(q, k, v, axis_name: str, scale: float,
             step, (k, v, dkv0, dkv0, dq0), jnp.arange(sp, dtype=jnp.int32))
         dq = dqt.transpose(0, 2, 1, 3).astype(q.dtype)
         return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+    attn.defvjp(_fwd, _bwd)
+    return attn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Flash-kernel sliding-window path
+# ---------------------------------------------------------------------------
+def _ring_attention_flash_sw(q, k, v, axis_name: str, scale: float,
+                             block_q: int, block_kv: int, window: int):
+    """Sliding-window ring attention with Pallas-tiled chunk math.
+
+    The ring loop is **statically unrolled over the rotation distance** i,
+    which makes each chunk's band offset ``window - i*S_local`` a Python
+    constant — so every chunk runs a tiled kernel with exact banded block
+    sparsity instead of the O(S_local²) jnp fallback:
+
+    - i == 0 (diagonal): canonical sliding_window kernel;
+    - 0 < i, chunk fully inside the window: full (unmasked) kernel;
+    - band edge: ``band`` kernel, valid iff row-col < window - i*S_local
+      (the inter-chunk offset already guarantees causality);
+    - i*S_local >= window + S_local - 1: statically skipped — AND the ring
+      stops rotating, so a 1024-window over a 32k sequence on sp=8 does 1-2
+      hops, not 8.
+
+    Runtime gating on wraparound (src > my ⇒ future tokens) via lax.cond.
+    """
+    from . import masks as M
+    from .flash_attention import flash_bwd_dkv, flash_bwd_dq, flash_fwd
+
+    B, Sl, Hq, D = q.shape
+    Hkv = k.shape[2]
+    G = Hq // Hkv
+    sp = jax.lax.axis_size(axis_name)
+    kw = dict(block_q=block_q, block_kv=block_kv, scale=scale)
+    # distances with any visible element: i*Sl < window + Sl - 1
+    n_live = min(sp, (window + Sl - 2) // Sl + 1)
+    perm = _ring_perm(sp)
+
+    def _chunk_kw(i: int) -> dict:
+        shift = i * Sl
+        if i == 0:
+            return dict(mask_type="sliding_window", window=window,
+                        mask_fn=M.sliding_window(window), canonical_mask=True)
+        if shift + Sl - 1 < window:
+            return dict(mask_type="full", mask_fn=None)
+        t = window - shift  # may be <= 0: band clipped to the top-right corner
+        return dict(mask_type="band", window=t, mask_fn=M.band(t),
+                    canonical_mask=True)
+
+    @jax.custom_vjp
+    def attn(q, k, v):
+        o, _ = _fwd(q, k, v)
+        return o
+
+    def _fwd(q, k, v):
+        my = jax.lax.axis_index(axis_name)
+        qt = q.transpose(0, 2, 1, 3)
+        m = jnp.full((B, Hq, Sl), NEG_INF, jnp.float32)
+        num = jnp.zeros((B, Hq, Sl, D), jnp.float32)
+        den = jnp.zeros((B, Hq, Sl), jnp.float32)
+        k_cur, v_cur = k, v
+        for i in range(n_live):
+            ckw = _chunk_kw(i)
+
+            def live_case(ops, ckw=ckw):
+                return flash_fwd(*ops, **ckw, **kw)
+
+            def skip_case(ops):
+                return (jnp.zeros_like(qt),
+                        jnp.full((B, Hq, 1, Sl), NEG_INF, jnp.float32))
+
+            o_c, lse_c = jax.lax.cond(
+                my >= i, live_case, skip_case,
+                (qt, k_cur.transpose(0, 2, 1, 3), v_cur.transpose(0, 2, 1, 3)))
+            m, num, den = _merge_chunk(m, num, den, o_c, lse_c[:, :, 0])
+            if i + 1 < n_live:  # no transfer for chunks that are never used
+                k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+                v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        den_safe = jnp.maximum(den, 1e-30)
+        ot = (num / den_safe[..., None]).astype(q.dtype)
+        lse_g = (m + jnp.log(den_safe))[:, :, None, :]
+        return ot.transpose(0, 2, 1, 3), (q, k, v, ot.transpose(0, 2, 1, 3), lse_g)
+
+    def _bwd(res, g):
+        q, k, v, o, lse_g = res
+        my = jax.lax.axis_index(axis_name)
+        qt = q.transpose(0, 2, 1, 3)
+        gt = g.transpose(0, 2, 1, 3)
+        delta = jnp.sum(gt.astype(jnp.float32) *
+                        o.transpose(0, 2, 1, 3).astype(jnp.float32),
+                        axis=-1)[:, :, None, :]
+
+        dq = jnp.zeros((B, Hq, Sl, D), jnp.float32)
+        dk_cur = jnp.zeros((B, Sl, Hkv, D), jnp.float32)
+        dv_cur = jnp.zeros((B, Sl, Hkv, D), jnp.float32)
+        k_cur, v_cur = k, v
+        for i in range(n_live):
+            ckw = _chunk_kw(i)
+
+            def live_case(ops, ckw=ckw):
+                kt, vt = ops
+                dq_c = flash_bwd_dq(qt, kt, vt, gt, lse_g, delta, **ckw, **kw)
+                dk_h, dv_h = flash_bwd_dkv(qt, kt, vt, gt, lse_g, delta, **ckw, **kw)
+                return dq_c, dk_h, dv_h
+
+            def skip_case(ops):
+                return (jnp.zeros_like(qt),
+                        jnp.zeros((B, Hq, Sl, D), k.dtype),
+                        jnp.zeros((B, Hq, Sl, D), v.dtype))
+
+            dq_c, dk_h, dv_h = jax.lax.cond(
+                my >= i, live_case, skip_case,
+                (k_cur.transpose(0, 2, 1, 3), v_cur.transpose(0, 2, 1, 3)))
+            dq = dq + dq_c.astype(jnp.float32)
+            dk_cur = dk_cur + _gqa_reduce(dk_h, B, Hkv, G, Sl, D).astype(jnp.float32)
+            dv_cur = dv_cur + _gqa_reduce(dv_h, B, Hkv, G, Sl, D).astype(jnp.float32)
+            if i + 1 < n_live:
+                k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+                v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+                dk_cur = jax.lax.ppermute(dk_cur, axis_name, perm)
+                dv_cur = jax.lax.ppermute(dv_cur, axis_name, perm)
+        # accumulators sit (n_live-1) hops ahead of their owner; one
+        # corrective ppermute lands them home (identity when n_live == sp).
+        home = (n_live - 1) % sp
+        if home:
+            back = [(j, (j + sp - home) % sp) for j in range(sp)]
+            dk_cur = jax.lax.ppermute(dk_cur, axis_name, back)
+            dv_cur = jax.lax.ppermute(dv_cur, axis_name, back)
+        return (dq.transpose(0, 2, 1, 3).astype(q.dtype),
+                dk_cur.astype(k.dtype), dv_cur.astype(v.dtype))
 
     attn.defvjp(_fwd, _bwd)
     return attn(q, k, v)
@@ -258,8 +404,12 @@ def ring_attention(
     plan = getattr(mask_mod, "_plan", None) if mask_mod is not None else ("causal", 0, 0)
     bq = fit_block(block_q, Sl)
     bkv = fit_block(block_kv, Sl)
-    if plan is not None and plan[0] == "causal" and Sl % bq == 0 and Sl % bkv == 0:
-        return _ring_attention_flash(q, k, v, axis_name, scale, bq, bkv)
+    if plan is not None and Sl % bq == 0 and Sl % bkv == 0:
+        if plan[0] == "causal":
+            return _ring_attention_flash(q, k, v, axis_name, scale, bq, bkv)
+        if plan[0] == "sliding_window":
+            return _ring_attention_flash_sw(q, k, v, axis_name, scale, bq, bkv,
+                                            window=plan[1])
     from . import masks as M
 
     return _ring_attention_jnp(q, k, v, axis_name, mask_mod or M.causal(), scale)

@@ -251,3 +251,41 @@ def test_interior_tile_fast_path_matches():
                                    err_msg=mask_type)
         np.testing.assert_allclose(np.asarray(l0), np.asarray(l1), atol=1e-6,
                                    err_msg=mask_type)
+
+
+def test_band_mask_multiblock_matches_reference():
+    """Band masks (sliding-window ring chunks) with negative/partial edges
+    across MULTIPLE kv blocks — exercises empty tile ranges whose index
+    maps must stay in [0, n_blocks-1] (OOB DMA regression guard)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlx_cuda_distributed_pretraining_tpu.ops import masks as M
+    from mlx_cuda_distributed_pretraining_tpu.ops.attention import reference_attention
+    from mlx_cuda_distributed_pretraining_tpu.ops.flash_attention import flash_fwd
+
+    B, H, S, D = 1, 2, 512, 16
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(kq, (B, H, S, D), jnp.float32)
+    k = jax.random.normal(kk, (B, H, S, D), jnp.float32)
+    v = jax.random.normal(kv, (B, H, S, D), jnp.float32)
+    for t in (-384, -100, 64, 700):  # deep-negative edge, partial, beyond-S
+        o, lse = flash_fwd(q, k, v, mask_type="band", window=t,
+                           mask_fn=M.band(t), canonical_mask=True,
+                           block_q=128, block_kv=128, scale=D ** -0.5)
+        # reference with the same band mask; rows with no valid key carry
+        # weight ~0 in lse -- compare only rows that have any valid key.
+        ref = reference_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), mask_mod=M.band(t),
+        ).transpose(0, 2, 1, 3)
+        rows = np.arange(S)
+        valid = rows < (S - 1 + t)  # row - col < t has a solution c <= S-1
+        if valid.any():
+            np.testing.assert_allclose(np.asarray(o)[:, :, valid],
+                                       np.asarray(ref)[:, :, valid],
+                                       atol=1e-5, err_msg=f"t={t}")
+        # fully-masked rows must report lse ~ NEG_INF (zero merge weight)
+        if (~valid).any():
+            assert np.all(np.asarray(lse)[:, :, 0][:, :, ~valid] < -1e29)
