@@ -73,20 +73,20 @@ def write_outputs(results: Dict[str, Dict[str, Any]], out_dir: str) -> str:
 
         matplotlib.use("Agg")
         import matplotlib.pyplot as plt
+    except ImportError:
+        return csv_path
 
-        fig, ax = plt.subplots(figsize=(8, 5))
-        for n in names:
-            ax.plot(results[n]["steps"], results[n]["losses"], label=n, linewidth=1.2)
-        ax.set_xlabel("step")
-        ax.set_ylabel("train loss")
-        ax.set_title("Optimizer comparison (same model/data/seed)")
-        ax.legend()
-        ax.grid(alpha=0.3)
-        fig.tight_layout()
-        fig.savefig(os.path.join(out_dir, "optimizer_comparison.png"), dpi=120)
-        plt.close(fig)
-    except Exception:  # pragma: no cover - matplotlib is optional
-        pass
+    fig, ax = plt.subplots(figsize=(8, 5))
+    for n in names:
+        ax.plot(results[n]["steps"], results[n]["losses"], label=n, linewidth=1.2)
+    ax.set_xlabel("step")
+    ax.set_ylabel("train loss")
+    ax.set_title("Optimizer comparison (same model/data/seed)")
+    ax.legend()
+    ax.grid(alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(os.path.join(out_dir, "optimizer_comparison.png"), dpi=120)
+    plt.close(fig)
     return csv_path
 
 

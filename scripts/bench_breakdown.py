@@ -5,7 +5,7 @@ shape bench.py uses, the cost of
   - loss forward only,
   - forward+backward (value_and_grad),
   - the full optimizer step,
-  - the attention stack alone (L x flash fwd / fwd+bwd),
+  - the attention stack alone (L x flash fwd; one-layer fwd+bwd),
   - the CE head alone (fused and unfused),
 so fwd / bwd / optimizer / attention / CE shares can be read directly.
 
@@ -22,7 +22,6 @@ import json
 import os
 import sys
 import time
-from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -82,10 +81,15 @@ def main():
         results[name] = sec * 1e3
         print(json.dumps({"component": name, "ms": round(sec * 1e3, 2)}), flush=True)
 
-    # full optimizer step (fused CE)
+    # full optimizer step (fused CE). The jitted step donates its state
+    # buffers, so every timed section gets a FRESH params/state tree —
+    # reusing a donated tree raises 'Array has been deleted' on device.
     opt = build_optimizer(TrainingConfig(
         hyperparameters={"learning_rate": 1e-3}, scheduler={"type": "cosine"},
         optimization={"optimizer": "adamw"}), 1000)
+
+    def fresh_params():
+        return llama.init_params(jax.random.PRNGKey(0), args)
 
     def loss_fused(p, b):
         return llama.loss_fn(p, b, args, compute_dtype=jnp.bfloat16,
@@ -96,11 +100,16 @@ def main():
                              remat=remat, ce_chunk=0)
 
     step, _ = make_train_step(loss_fused, opt)
-    state = init_train_state(params, opt)
-    report("full_step_fused_ce", chain_time(lambda s: step(s, batch)[0], state, a.steps))
+    report("full_step_fused_ce",
+           chain_time(lambda s: step(s, batch)[0],
+                      init_train_state(fresh_params(), opt), a.steps))
 
     step_u, _ = make_train_step(loss_unfused, opt)
-    report("full_step_unfused_ce", chain_time(lambda s: step_u(s, batch)[0], state, a.steps))
+    report("full_step_unfused_ce",
+           chain_time(lambda s: step_u(s, batch)[0],
+                      init_train_state(fresh_params(), opt), a.steps))
+
+    params = fresh_params()  # non-donating sections below share this tree
 
     # forward-only loss (chained by feeding loss into a dummy param perturbation)
     @jax.jit
@@ -131,6 +140,8 @@ def main():
 
     @jax.jit
     def attn_stack_bwd(q):
+        # one layer under grad (key: attention_one_layer_fwd_bwd — multiply
+        # by L for the stack share)
         g = jax.grad(lambda z: flash_attention(z, z, z).astype(jnp.float32).sum())(q)
         return q + 0 * g
 
