@@ -69,6 +69,10 @@ SCALES = {
                             num_heads=16, num_kv_heads=16, head_dim=64),
                  batch=16, seq=2048, remat="dots"),
 }
+# MFU-chasing variant: remat trades FLOPs for memory so the batch can
+# double again — higher arithmetic intensity per HBM byte. Derived from
+# the 100m shape so the comparison stays same-model by construction.
+SCALES["100m_bs64"] = dict(SCALES["100m"], batch=64, remat="dots")
 
 _T_START = time.monotonic()
 _BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1200"))
@@ -470,6 +474,11 @@ def main() -> None:
         run_case("decode_100m_16k_int8", bench_decode_case, "100m", vocab,
                  prompt=8192, max_len=16384, attend=8192 + 64, quantize=True,
                  name="decode_100m_16k_int8", reserve=200)
+    if "100m" in wanted:
+        # after decode/longctx: a redundant train variant must not starve
+        # unique case families under a tight budget
+        run_case("100m_bs64_remat", bench_train_case, "100m_bs64_remat", "100m_bs64",
+                 "flash", vocab, steps, reserve=150)
     if "simple" in wanted:
         run_case("2m_simple", bench_train_case, "2m_simple", "2m", "simple", vocab,
                  steps, reserve=90)

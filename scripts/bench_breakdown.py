@@ -109,7 +109,8 @@ def main():
            chain_time(lambda s: step_u(s, batch)[0],
                       init_train_state(fresh_params(), opt), a.steps))
 
-    params = fresh_params()  # non-donating sections below share this tree
+    # non-donating sections below reuse the module-level params (never
+    # donated: both full-step sections built their own trees)
 
     # forward-only loss (chained by feeding loss into a dummy param perturbation)
     @jax.jit
