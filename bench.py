@@ -14,7 +14,13 @@ timeout before printing anything):
 - cases run cheap-and-diverse-first (2m, decode_2m, 100m, trainer, 40m,
   400m, ...) so a partial run still covers every case *family*;
 - each case retries once on transient remote-compile / connection errors
-  (the r2 run lost 40m/400m to HTTP 500 flakes while 100m compiled fine).
+  (the r2 run lost 40m/400m to HTTP 500 flakes while 100m compiled fine);
+- **each case runs in its own subprocess under a hard timeout** (parent
+  holds no TPU client): a remote-compile hang blocks inside a C call
+  where Python signal handlers never fire — observed live in r3, a
+  trainer-case compile sat 15+ min ignoring SIGTERM — so in-process
+  alarms cannot bound a case; SIGKILLing a child can.  Set
+  ``BENCH_INPROC=1`` to fall back to single-process mode.
 
 The matrix: {2M, 40M, 100M, 400M} params x flash attention at a realistic
 32,768 vocab (fused chunked CE — ops/fused_ce.py), with simple-attention
@@ -118,8 +124,21 @@ def emit(reason: str = "final") -> None:
     }), flush=True)
 
 
+_ACTIVE_CHILD = None  # Popen of the in-flight --one case, if any
+
+
 def _on_signal(signum, frame):  # noqa: ARG001
     log(f"[bench] caught signal {signum} at t={elapsed():.0f}s — emitting partial matrix")
+    if _ACTIVE_CHILD is not None and _ACTIVE_CHILD.poll() is None:
+        # The child holds the TPU client; leaving it orphaned would hog the
+        # tunnel for any subsequent bench invocation. TERM first: the
+        # trainer child's own handler saves a preemption checkpoint on
+        # SIGTERM — give it a moment before the hard kill.
+        _ACTIVE_CHILD.terminate()
+        try:
+            _ACTIVE_CHILD.wait(timeout=10)
+        except Exception:  # noqa: BLE001
+            _ACTIVE_CHILD.kill()
     emit(reason=f"signal_{signum}")
     # Re-raise default behavior so the exit code still reflects the kill.
     signal.signal(signum, signal.SIG_DFL)
@@ -291,13 +310,20 @@ def bench_decode_case(scale_key, vocab, prompt=512, max_len=2048,
 
     _, cache = prefill_fwd(params, toks, P)
     tok0 = jnp.ones((B,), jnp.int32)
+    # Long chains + min-of-3: through the tunnel each sync carries ~tens of
+    # ms of RTT jitter, so a 32-step difference was regularly swallowed by
+    # noise (r3: decode_2m reported null). 512 steps of difference with the
+    # minimum-duration estimator puts the signal well above the jitter.
     ts = {}
-    for n in (8, 40):
+    for n in (32, 544):
         sync(decode_chain(params, cache, tok0, n, attend))  # compile
-        t0 = time.perf_counter()
-        sync(decode_chain(params, cache, tok0, n, attend))
-        ts[n] = time.perf_counter() - t0
-    per_step = (ts[40] - ts[8]) / 32
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            sync(decode_chain(params, cache, tok0, n, attend))
+            best = min(best, time.perf_counter() - t0)
+        ts[n] = best
+    per_step = (ts[544] - ts[32]) / 512
     ok = per_step > 1e-6
     return {
         "case": name or f"decode_{scale_key}", "batch": B, "prompt": P,
@@ -379,13 +405,6 @@ def bench_trainer_case(vocab, workdir="/tmp/bench_trainer"):
     t0 = time.perf_counter()
     t.train()
     dt = time.perf_counter() - t0
-    if getattr(t, "_preempted", False):
-        # The Trainer's own SIGTERM handler consumed the driver's kill
-        # signal (it saves and exits cleanly); surface it so run_case stops
-        # the bench and emits the partial matrix instead of running on.
-        global _TERMINATING
-        _TERMINATING = True
-
     # parse steady-state tok/s from log.txt (last report line)
     tok_s = None
     log_path = os.path.join(workdir, "runs", "bench-trainer", "log.txt")
@@ -396,100 +415,228 @@ def bench_trainer_case(vocab, workdir="/tmp/bench_trainer"):
     return {
         "case": "trainer_40m_flash_e2e", "batch": batch, "seq": seq,
         "vocab": vocab, "tok_s": tok_s, "wall_s": round(dt, 1),
+        # The Trainer's own SIGTERM handler consumed a kill signal (it
+        # saves and exits cleanly); run_case reads this flag — in
+        # subprocess mode it is the only way the signal reaches the
+        # parent — and stops the bench instead of running on.
+        "preempted": bool(getattr(t, "_preempted", False)),
     }
 
 
-def run_case(name, fn, *a, reserve=90.0, **kw):
+def build_plan(vocab, steps):
+    """Ordered case plan shared by the parent orchestrator and ``--one``
+    children. Cheap-and-diverse first: a budget-truncated run still covers
+    every case family. (trainer before 40m: it IS a 40m e2e run.)
+    Each entry: (case_id, family, thunk, reserve_s)."""
+    return [
+        ("2m_flash", "2m",
+         lambda: bench_train_case("2m_flash", "2m", "flash", vocab, steps), 90),
+        ("decode_2m", "decode", lambda: bench_decode_case("2m", vocab), 120),
+        ("100m_flash", "100m",
+         lambda: bench_train_case("100m_flash", "100m", "flash", vocab, steps), 150),
+        ("trainer", "trainer", lambda: bench_trainer_case(vocab), 240),
+        ("40m_flash", "40m",
+         lambda: bench_train_case("40m_flash", "40m", "flash", vocab, steps), 120),
+        ("400m_flash", "400m",
+         lambda: bench_train_case("400m_flash", "400m", "flash", vocab, steps), 240),
+        ("decode_100m", "decode", lambda: bench_decode_case("100m", vocab), 150),
+        ("decode_100m_16k_int8", "longctx",
+         lambda: bench_decode_case("100m", vocab, prompt=8192, max_len=16384,
+                                   attend=8192 + 64, quantize=True,
+                                   name="decode_100m_16k_int8"), 200),
+        # after decode/longctx: a redundant train variant must not starve
+        # unique case families under a tight budget
+        ("100m_bs64_remat", "100m",
+         lambda: bench_train_case("100m_bs64_remat", "100m_bs64", "flash",
+                                  vocab, steps), 150),
+        ("2m_simple", "simple",
+         lambda: bench_train_case("2m_simple", "2m", "simple", vocab, steps), 90),
+        ("40m_simple", "simple",
+         lambda: bench_train_case("40m_simple", "40m", "simple", vocab, steps), 150),
+    ]
+
+
+_CASE_MARK = "BENCHCASE "
+
+
+def probe_child() -> None:
+    """--probe mode: one tiny matmul proves the TPU tunnel is alive."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    float((x @ x).sum())
+    print(_CASE_MARK + json.dumps({"probe": "ok", "device": str(jax.devices()[0])}),
+          flush=True)
+
+
+def ensure_device() -> bool:
+    """Block until the device tunnel answers a probe, or the budget is
+    nearly gone. The axon tunnel dies and recovers on its own timescale
+    (observed in r2 and r3); when it is down, every case would burn its
+    full timeout — waiting on a cheap probe is the correct use of budget
+    because nothing else can make progress anyway."""
+    import subprocess
+
+    global _DEVICE
+    while not _TERMINATING:
+        remaining = _BUDGET_S - elapsed()
+        if remaining < 60:
+            return False
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--probe"],
+                capture_output=True, text=True, timeout=min(90, remaining - 30),
+            )
+            line = next((ln for ln in proc.stdout.splitlines()
+                         if ln.startswith(_CASE_MARK)), None)
+            if line:
+                _DEVICE = json.loads(line[len(_CASE_MARK):]).get("device", _DEVICE)
+                return True
+            log(f"[bench] device probe failed (rc={proc.returncode}); retrying"
+                f" — {proc.stderr[-200:].strip()}")
+        except subprocess.TimeoutExpired:
+            log(f"[bench] device probe hung >90s at t={elapsed():.0f}s; tunnel down, retrying")
+        time.sleep(20)
+    return False
+
+
+def run_child(case_id) -> None:
+    """--one CASE_ID mode: run a single case in this process and print its
+    result as a marked stdout line for the parent to collect."""
+    vocab = int(os.environ.get("BENCH_VOCAB", "32768"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    plan = {cid: thunk for cid, _, thunk, _ in build_plan(vocab, steps)}
+    import jax
+
+    t0 = time.perf_counter()
+    r = plan[case_id]()
+    r["bench_wall_s"] = round(time.perf_counter() - t0, 1)
+    r["device"] = str(jax.devices()[0])
+    print(_CASE_MARK + json.dumps(r), flush=True)
+
+
+def run_case(case_id, reserve, inproc_thunk=None):
     """Run one case with budget check + one retry on transient errors.
 
     ``reserve`` is the case's expected worst-case wall time (compile via the
     remote-compile tunnel + measurement); the case is skipped unless that
-    much budget remains, so an admitted case finishes inside the budget."""
+    much budget remains, so an admitted case finishes inside the budget.
+    The case runs in a subprocess under ``2*reserve + 90`` seconds of hard
+    timeout unless ``inproc_thunk`` is given (BENCH_INPROC=1)."""
+    import subprocess
+
+    global _DEVICE, _ACTIVE_CHILD, _TERMINATING
     if _TERMINATING:
-        _MATRIX.append({"case": name, "skipped": "terminating (signal consumed)"})
-        log(f"[bench] {name} SKIPPED: termination signal observed")
+        _MATRIX.append({"case": case_id, "skipped": "terminating (signal consumed)"})
+        log(f"[bench] {case_id} SKIPPED: termination signal observed")
         return
     remaining = _BUDGET_S - elapsed()
     if remaining < reserve:
-        _MATRIX.append({"case": name, "skipped": f"budget ({remaining:.0f}s left, needs ~{reserve:.0f}s)"})
-        log(f"[bench] {name} SKIPPED: {remaining:.0f}s of budget left, needs ~{reserve:.0f}s")
+        _MATRIX.append({"case": case_id, "skipped": f"budget ({remaining:.0f}s left, needs ~{reserve:.0f}s)"})
+        log(f"[bench] {case_id} SKIPPED: {remaining:.0f}s of budget left, needs ~{reserve:.0f}s")
         return
     for attempt in (1, 2):
+        # Recomputed per attempt: a retry must fit what is left of the
+        # budget, not what was left when the case was first admitted.
+        timeout_s = min(2 * reserve + 90,
+                        max(_BUDGET_S - elapsed() - 15, 30.0))
         t0 = time.perf_counter()
         try:
-            r = fn(*a, **kw)
-            r["bench_wall_s"] = round(time.perf_counter() - t0, 1)
+            if inproc_thunk is not None:
+                r = inproc_thunk()
+                r["bench_wall_s"] = round(time.perf_counter() - t0, 1)
+            else:
+                _ACTIVE_CHILD = subprocess.Popen(
+                    [sys.executable, os.path.abspath(__file__), "--one", case_id],
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                )
+                try:
+                    out, err = _ACTIVE_CHILD.communicate(timeout=timeout_s)
+                finally:
+                    if _ACTIVE_CHILD.poll() is None:
+                        _ACTIVE_CHILD.kill()
+                        _ACTIVE_CHILD.communicate()
+                    rc = _ACTIVE_CHILD.returncode
+                    _ACTIVE_CHILD = None
+                sys.stderr.write(err[-4000:])
+                line = next((ln for ln in out.splitlines()
+                             if ln.startswith(_CASE_MARK)), None)
+                if line is None:
+                    raise RuntimeError(
+                        f"child rc={rc}, no result line; "
+                        f"stderr tail: {err[-300:]}")
+                r = json.loads(line[len(_CASE_MARK):])
+                _DEVICE = r.pop("device", _DEVICE)
+            if r.pop("preempted", False):
+                # The child's Trainer consumed a SIGTERM meant for the whole
+                # bench: stop launching cases and let emit() report what we
+                # have (in subprocess mode the child's _TERMINATING flag
+                # cannot reach us directly, so it rides the result dict).
+                _TERMINATING = True
             _MATRIX.append(r)
             log(f"[bench] {json.dumps(r)}")
             return
         except Exception as e:  # noqa: BLE001 - one OOM must not kill the bench
-            msg = str(e)[:300]
-            transient = any(m in msg for m in _TRANSIENT_MARKERS)
+            if isinstance(e, subprocess.TimeoutExpired):
+                msg = f"case timeout after {timeout_s:.0f}s (child SIGKILLed)"
+                transient = True  # hung compile service sometimes recovers
+                # A hang usually means the tunnel died mid-case; wait for it
+                # to answer a probe again before retrying or moving on.
+                ensure_device()
+            else:
+                # Classify against the FULL message — the marker (e.g. an
+                # HTTP 500 in the child's stderr tail) often sits past any
+                # truncation point.
+                full = str(e)
+                transient = any(m in full for m in _TRANSIENT_MARKERS)
+                msg = full[:300]
             if attempt == 1 and transient and not _TERMINATING \
                     and (_BUDGET_S - elapsed()) > reserve:
-                log(f"[bench] {name} attempt 1 transient failure, retrying: {msg}")
+                log(f"[bench] {case_id} attempt 1 transient failure, retrying: {msg}")
                 time.sleep(5)
                 continue
-            _MATRIX.append({"case": name, "error": msg})
-            log(f"[bench] {name} FAILED: {msg}")
+            _MATRIX.append({"case": case_id, "error": msg})
+            log(f"[bench] {case_id} FAILED: {msg}")
             return
 
 
 def main() -> None:
-    global _DEVICE, _VOCAB
-    import jax
-
+    global _VOCAB, _DEVICE
     _VOCAB = vocab = int(os.environ.get("BENCH_VOCAB", "32768"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     cases_env = os.environ.get(
         "BENCH_CASES", "2m,40m,100m,400m,simple,decode,longctx,trainer")
     wanted = set(cases_env.split(","))
+    inproc = os.environ.get("BENCH_INPROC") == "1"
 
-    device = jax.devices()[0]
-    _DEVICE = str(device)
-    log(f"[bench] device={device} vocab={vocab} steps={steps} "
-        f"cases={sorted(wanted)} budget={_BUDGET_S:.0f}s")
+    log(f"[bench] vocab={vocab} steps={steps} cases={sorted(wanted)} "
+        f"budget={_BUDGET_S:.0f}s mode={'inproc' if inproc else 'subprocess'}")
 
-    # Cheap-and-diverse first: a budget-truncated run still covers every
-    # case family. (trainer before 40m: it IS a 40m e2e run.)
-    if "2m" in wanted:
-        run_case("2m_flash", bench_train_case, "2m_flash", "2m", "flash", vocab, steps,
-                 reserve=90)
-    if "decode" in wanted:
-        run_case("decode_2m", bench_decode_case, "2m", vocab, reserve=120)
-    if "100m" in wanted:
-        run_case("100m_flash", bench_train_case, "100m_flash", "100m", "flash", vocab,
-                 steps, reserve=150)
-    if "trainer" in wanted:
-        run_case("trainer", bench_trainer_case, vocab, reserve=240)
-    if "40m" in wanted:
-        run_case("40m_flash", bench_train_case, "40m_flash", "40m", "flash", vocab,
-                 steps, reserve=120)
-    if "400m" in wanted:
-        run_case("400m_flash", bench_train_case, "400m_flash", "400m", "flash", vocab,
-                 steps, reserve=240)
-    if "decode" in wanted:
-        run_case("decode_100m", bench_decode_case, "100m", vocab, reserve=150)
-    if "longctx" in wanted:
-        run_case("decode_100m_16k_int8", bench_decode_case, "100m", vocab,
-                 prompt=8192, max_len=16384, attend=8192 + 64, quantize=True,
-                 name="decode_100m_16k_int8", reserve=200)
-    if "100m" in wanted:
-        # after decode/longctx: a redundant train variant must not starve
-        # unique case families under a tight budget
-        run_case("100m_bs64_remat", bench_train_case, "100m_bs64_remat", "100m_bs64",
-                 "flash", vocab, steps, reserve=150)
-    if "simple" in wanted:
-        run_case("2m_simple", bench_train_case, "2m_simple", "2m", "simple", vocab,
-                 steps, reserve=90)
-        run_case("40m_simple", bench_train_case, "40m_simple", "40m", "simple", vocab,
-                 steps, reserve=150)
+    if inproc:
+        import jax
+
+        _DEVICE = str(jax.devices()[0])
+        log(f"[bench] device={_DEVICE}")
+    elif not ensure_device():
+        log("[bench] device never answered a probe within budget")
+    else:
+        log(f"[bench] device={_DEVICE}")
+
+    for case_id, family, thunk, reserve in build_plan(vocab, steps):
+        if family in wanted:
+            run_case(case_id, reserve, inproc_thunk=thunk if inproc else None)
 
     emit(reason="final")
 
 
 if __name__ == "__main__":
-    atexit.register(emit, "atexit")
-    signal.signal(signal.SIGTERM, _on_signal)
-    signal.signal(signal.SIGINT, _on_signal)
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--one":
+        run_child(sys.argv[2])
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--probe":
+        probe_child()
+    else:
+        atexit.register(emit, "atexit")
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
+        main()

@@ -713,9 +713,11 @@ def build_data_manager(
             )
         if not os.path.isabs(shard_dir):
             shard_dir = os.path.join(base_dir, shard_dir)
+        val_fraction = float(streaming_cfg.get("val_fraction", 0.01))
         return TokenShardDataManager(
             shard_dir, batch_size, seq_len or data_cfg.max_context_size,
             seed=seed, process_index=process_index, process_count=process_count,
+            val_fraction=val_fraction,
         )
     if source in ("hf_stream", "synthetic", "webdataset") or streaming_cfg.get("shards"):
         return StreamingDataManager(

@@ -166,9 +166,16 @@ class TokenShardDataManager:
     def iter_validation(self, cap: int = 50):
         for i in range(0, min(len(self.val_starts), cap * self.per_host), self.per_host):
             chunk = self.val_starts[i : i + self.per_host]
+            b = self._batch_from_starts(chunk)
             if len(chunk) < self.per_host:
-                break
-            yield self._batch_from_starts(chunk)
+                # Pad the tail chunk to the fixed batch shape with
+                # zero-masked rows (exact: eval counts tokens via mask).
+                # Dropping it instead made validation silently empty when
+                # the val split was smaller than one batch.
+                pad = self.per_host - len(chunk)
+                b = {k: np.concatenate([v, np.zeros((pad,) + v.shape[1:], v.dtype)])
+                     for k, v in b.items()}
+            yield b
 
     def state_dict(self) -> Dict[str, Any]:
         return {"val_ptr": 0}

@@ -63,14 +63,69 @@ def detect_text_key(source: str, hf_split: str = "train") -> str:
     return "text"
 
 
-def _write_config(out_dir: str, name: str, ctx: int, tokenizer_dir: Optional[str]) -> str:
+def _write_shards(out_dir: str, train_path: str, val_path: str,
+                  tokenizer_dir: Optional[str]) -> dict:
+    """Tokenize the prepared splits into binary token shards (the
+    reference's bulk-download flow ends in processed tokens too —
+    reference: download_and_process_llm_data.py:1-85). Train docs are
+    written first and val docs last, so the tail-window validation split
+    of ``TokenShardDataManager`` lands on actual held-out documents; the
+    exact boundary is returned as ``val_fraction``."""
+    from ..data.token_shards import write_token_shards
+    from ..tokenizer import ByteTokenizer, HFTokenizer
+    from .train_tokenizer import _iter_texts
+
+    tok_file = os.path.join(tokenizer_dir, "tokenizer.json") if tokenizer_dir else None
+    tok = HFTokenizer(tok_file) if tok_file and os.path.isfile(tok_file) else ByteTokenizer()
+
+    # Each split's docs flow through the shard writer exactly once; the
+    # adapter counts train tokens as it tokenizes (prepared splits always
+    # store the doc under "text": prepare_split normalizes the key).
+    state = {"in_train": True, "train_tokens": 0}
+
+    class _Adapter:  # write_token_shards wants .tokenize/.vocab_size/.eos_id
+        vocab_size = tok.vocab_size
+        eos_id = tok.eos_id
+
+        @staticmethod
+        def tokenize(text):
+            ids = tok.encode(text)
+            if state["in_train"]:
+                state["train_tokens"] += len(ids) + 1  # +1: appended eos
+            return ids
+
+    def _docs():
+        yield from _iter_texts([train_path])
+        state["in_train"] = False
+        yield from _iter_texts([val_path])
+
+    shard_dir = os.path.join(out_dir, "shards")
+    index = write_token_shards(_docs(), _Adapter(), shard_dir)
+    total = max(1, index["total_tokens"])
+    val_fraction = round(max(0.0, 1.0 - state["train_tokens"] / total), 6)
+    return {"shard_dir": shard_dir, "val_fraction": val_fraction,
+            "total_tokens": total}
+
+
+def _write_config(out_dir: str, name: str, ctx: int, tokenizer_dir: Optional[str],
+                  shards: Optional[dict] = None) -> str:
     """Emit a runnable training config pointing at the prepared files."""
     import yaml
 
-    cfg = {
-        "name": name,
-        "overwrite": True,
-        "data": {
+    if shards:
+        data_section = {
+            "source": "token_shards",
+            "input_file": shards["shard_dir"],
+            "tokenizer_path": tokenizer_dir,
+            "preprocessing": {"max_context_size": ctx},
+            "streaming": {"val_fraction": shards["val_fraction"]},
+            "tokenizer": {
+                "normal_vocab_size": 256,
+                "special_tokens": {"pad": "<pad>", "bos": "<bos>", "eos": "<eos>"},
+            },
+        }
+    else:
+        data_section = {
             "input_file": os.path.join(out_dir, "train.jsonl"),
             "validation_file": os.path.join(out_dir, "val.jsonl"),
             "tokenizer_path": tokenizer_dir,
@@ -79,7 +134,11 @@ def _write_config(out_dir: str, name: str, ctx: int, tokenizer_dir: Optional[str
                 "normal_vocab_size": 256,
                 "special_tokens": {"pad": "<pad>", "bos": "<bos>", "eos": "<eos>"},
             },
-        },
+        }
+    cfg = {
+        "name": name,
+        "overwrite": True,
+        "data": data_section,
         "model": {
             "architecture": "llama",
             "dimensions": {"hidden_size": 512, "intermediate_size": 1536,
@@ -125,6 +184,7 @@ def prepare_dataset(
     seed: int = 42,
     train_tokenizer: bool = True,
     context_size: int = 1024,
+    token_shards: bool = False,
 ) -> dict:
     """Run the whole onboarding flow; returns a manifest of produced paths."""
     if text_key == "auto":
@@ -141,8 +201,15 @@ def prepare_dataset(
         tokenizer_dir = os.path.join(out_dir, "tokenizer")
         out_file = _train_tok([train_path], tokenizer_dir, vocab_size=vocab_size)
         print(f"Trained tokenizer ({vocab_size} vocab) -> {out_file}")
+    shards = None
+    if token_shards:
+        shards = _write_shards(out_dir, train_path, val_path, tokenizer_dir)
+        print(f"Wrote token shards -> {shards['shard_dir']} "
+              f"({shards['total_tokens']} tokens, "
+              f"val_fraction={shards['val_fraction']})")
     name = os.path.basename(os.path.normpath(out_dir)) or "prepared"
-    cfg_path = _write_config(out_dir, name, context_size, tokenizer_dir)
+    cfg_path = _write_config(out_dir, name, context_size, tokenizer_dir,
+                             shards=shards)
     print(f"Wrote config -> {cfg_path}")
     manifest = {
         "train": train_path,
@@ -150,6 +217,7 @@ def prepare_dataset(
         "tokenizer": tokenizer_dir,
         "config": cfg_path,
         "text_key": text_key,
+        "shards": shards,
     }
     with open(os.path.join(out_dir, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=2)
@@ -170,12 +238,15 @@ def main(argv=None):
     p.add_argument("--context-size", type=int, default=1024)
     p.add_argument("--no-tokenizer", action="store_true",
                    help="skip tokenizer training (byte-level fallback)")
+    p.add_argument("--token-shards", action="store_true",
+                   help="also tokenize splits into binary token shards and "
+                        "point the emitted config at them (fastest train path)")
     a = p.parse_args(argv)
     prepare_dataset(
         a.source, a.out, vocab_size=a.vocab_size, val_fraction=a.val_fraction,
         max_docs=a.max_docs, text_key=a.text_key, hf_split=a.hf_split,
         seed=a.seed, train_tokenizer=not a.no_tokenizer,
-        context_size=a.context_size,
+        context_size=a.context_size, token_shards=a.token_shards,
     )
     return 0
 

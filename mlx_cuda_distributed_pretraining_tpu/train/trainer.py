@@ -378,7 +378,9 @@ class Trainer:
             loss, toks = self.eval_step(self.state["params"], _device_batch(batch))
             total_nll += float(loss) * float(toks)
             total_toks += float(toks)
-        return total_nll / max(total_toks, 1.0)
+        if total_toks == 0:  # no usable batches — report "no signal", not 0.0
+            return None
+        return total_nll / total_toks
 
     # -- sample generation (reference: :1818-1904) --------------------------
     def generate_samples(self, step: int, prompts=None, max_new_tokens: int = 48) -> None:
