@@ -538,9 +538,13 @@ def run_case(case_id, reserve, inproc_thunk=None):
         return
     for attempt in (1, 2):
         # Recomputed per attempt: a retry must fit what is left of the
-        # budget, not what was left when the case was first admitted.
+        # budget, not what was left when the case was first admitted. An
+        # admitted case always gets at least its reserve — clamping below
+        # it would guarantee a kill for a case admission said could finish
+        # (worst case it ends ~reserve-15s past budget, well inside the
+        # driver-timeout slack the budget leaves).
         timeout_s = min(2 * reserve + 90,
-                        max(_BUDGET_S - elapsed() - 15, 30.0))
+                        max(_BUDGET_S - elapsed() - 15, reserve))
         t0 = time.perf_counter()
         try:
             if inproc_thunk is not None:

@@ -100,10 +100,14 @@ def main():
         }
 
     if a.sweep:
+        # Large bkv included deliberately: KV for one head at seq 2048 is
+        # only 512 KB bf16 — VMEM-resident KV (bkv == S) collapses the
+        # streamed inner grid dim entirely, trading in-tile causal masking
+        # work for ~8x fewer grid steps and no KV re-reads.
         for B, S in [(16, 2048), (8, 4096), (4, 8192)]:
             q, k, v = make_inputs(B, S)
-            for bq in (128, 256, 512):
-                for bkv in (128, 256, 512, 1024):
+            for bq in (128, 256, 512, 1024):
+                for bkv in (256, 512, 1024, 2048, 4096):
                     if bkv > S or bq > S:
                         continue
                     r = run_case(
