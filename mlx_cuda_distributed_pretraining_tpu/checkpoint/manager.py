@@ -54,6 +54,14 @@ class CheckpointManager:
     def __init__(self, run_dir: str):
         self.run_dir = run_dir
         self.checkpoint_dir = os.path.join(run_dir, "checkpoints")
+        self._writer = None          # lazy background writer thread
+        self._write_error: Optional[Exception] = None
+        import threading
+
+        # metadata.json is read-modify-written by both the background
+        # writer (ledger append) and the trainer (summary fields) — one
+        # lock serializes every access.
+        self._meta_lock = threading.Lock()
 
     # -- run dir lifecycle --------------------------------------------------
     @staticmethod
@@ -82,13 +90,20 @@ class CheckpointManager:
         opt_state: Optional[Any] = None,
         training_state: Optional[Dict[str, Any]] = None,
         metadata_extra: Optional[Dict[str, Any]] = None,
+        blocking: bool = True,
     ) -> Dict[str, str]:
-        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        """Write the step triplet. ``blocking=False`` hands the disk write
+        to a single background thread and returns as soon as the host
+        copies exist — the device-to-host gather (a collective under
+        multi-host sharding) always happens on the caller thread, only the
+        serialization/IO moves. Writes are strictly FIFO; a failed
+        background write re-raises on the next ``save``/``wait``."""
         model_path, opt_path, state_path = self.paths_for_step(step)
 
+        # Gather + flatten on the caller thread (collective-safe; also
+        # snapshots the arrays so the trainer can mutate state immediately).
         flat_params = flatten_dict(_to_numpy_tree(params))
-        save_safetensors(model_path, flat_params, metadata={"format": "pt"})
-
+        arrays = scalars = None
         if opt_state is not None:
             flat_opt = flatten_dict(_to_numpy_tree(opt_state))
             arrays = {k: v for k, v in flat_opt.items() if isinstance(v, np.ndarray)}
@@ -97,32 +112,97 @@ class CheckpointManager:
                 for k, v in flat_opt.items()
                 if not isinstance(v, np.ndarray)
             }
-            save_safetensors(opt_path, arrays, metadata={"scalars": json.dumps(scalars)})
-
         training_state = dict(training_state or {})
         training_state.setdefault("step", int(step) if str(step).isdigit() else step)
-        with open(state_path, "w") as f:
-            json.dump(training_state, f, indent=2)
+        payload = (step, model_path, opt_path, state_path, flat_params,
+                   arrays, scalars, training_state, metadata_extra)
 
-        self._append_metadata(step, model_path, metadata_extra)
+        if blocking:
+            self.wait()  # keep FIFO order with any pending async writes
+            self._write(payload)
+        else:
+            if self._writer is None:
+                import queue
+                import threading
+
+                # Depth 1: overlapping the write of step N with training is
+                # the whole benefit; deeper queues only pin more full host
+                # copies of params+opt state (GBs each at 100M+).
+                self._queue: Any = queue.Queue(maxsize=1)
+                self._writer = threading.Thread(
+                    target=self._writer_loop, name="ckpt-writer", daemon=True)
+                self._writer.start()
+            self._raise_pending()
+            self._queue.put(payload)
         return {"model": model_path, "optimizer": opt_path, "state": state_path}
 
-    def _append_metadata(self, step, model_path: str, extra: Optional[Dict[str, Any]]) -> None:
+    def _write(self, payload) -> None:
+        (step, model_path, opt_path, state_path, flat_params,
+         arrays, scalars, training_state, metadata_extra) = payload
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        save_safetensors(model_path, flat_params, metadata={"format": "pt"})
+        if arrays is not None:
+            save_safetensors(opt_path, arrays,
+                             metadata={"scalars": json.dumps(scalars)})
+        with open(state_path, "w") as f:
+            json.dump(training_state, f, indent=2)
+        self._append_metadata(step, model_path, metadata_extra)
+
+    def _writer_loop(self) -> None:
+        while True:
+            payload = self._queue.get()
+            if payload is None:
+                self._queue.task_done()
+                return
+            try:
+                self._write(payload)
+            except Exception as e:  # noqa: BLE001 - surfaced on next save/wait
+                with self._meta_lock:
+                    self._write_error = e
+            finally:
+                self._queue.task_done()
+
+    def _raise_pending(self) -> None:
+        with self._meta_lock:  # vs the writer thread's concurrent store
+            err, self._write_error = self._write_error, None
+        if err is not None:
+            raise RuntimeError(f"background checkpoint write failed: {err}") from err
+
+    def wait(self) -> None:
+        """Drain pending background writes; re-raise any write failure."""
+        if self._writer is not None:
+            self._queue.join()
+        self._raise_pending()
+
+    def _load_ledger(self) -> Dict[str, Any]:
         meta_path = os.path.join(self.run_dir, "metadata.json")
-        ledger: Dict[str, Any] = {}
         if os.path.exists(meta_path):
             try:
                 with open(meta_path) as f:
-                    ledger = json.load(f)
+                    return json.load(f)
             except (json.JSONDecodeError, OSError):
-                ledger = {}
-        entries = ledger.setdefault("checkpoints", [])
-        entry = {"step": step, "path": model_path, "timestamp": time.time()}
-        if extra:
-            entry.update(extra)
-        entries.append(entry)
-        with open(meta_path, "w") as f:
-            json.dump(ledger, f, indent=2)
+                pass
+        return {}
+
+    def _append_metadata(self, step, model_path: str, extra: Optional[Dict[str, Any]]) -> None:
+        with self._meta_lock:
+            ledger = self._load_ledger()
+            entries = ledger.setdefault("checkpoints", [])
+            entry = {"step": step, "path": model_path, "timestamp": time.time()}
+            if extra:
+                entry.update(extra)
+            entries.append(entry)
+            with open(os.path.join(self.run_dir, "metadata.json"), "w") as f:
+                json.dump(ledger, f, indent=2)
+
+    def update_ledger(self, **fields: Any) -> None:
+        """Merge top-level fields into metadata.json under the same lock
+        the background writer's ledger appends take."""
+        with self._meta_lock:
+            ledger = self._load_ledger()
+            ledger.update(fields)
+            with open(os.path.join(self.run_dir, "metadata.json"), "w") as f:
+                json.dump(ledger, f, indent=2)
 
     # -- load ---------------------------------------------------------------
     def load(

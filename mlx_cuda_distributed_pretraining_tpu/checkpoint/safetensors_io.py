@@ -12,6 +12,7 @@ bfloat16 is supported via ``ml_dtypes`` (ships with jaxlib).
 from __future__ import annotations
 
 import json
+import os
 import struct
 from typing import Any, Dict, Optional, Tuple
 
@@ -69,11 +70,16 @@ def save_safetensors(
     pad = (8 - len(header_bytes) % 8) % 8
     header_bytes += b" " * pad
 
-    with open(path, "wb") as f:
+    # Write-to-temp then atomic rename: an interrupted write (crash, killed
+    # background checkpoint thread) must never shadow a good checkpoint
+    # with a truncated file.
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
         f.write(struct.pack("<Q", len(header_bytes)))
         f.write(header_bytes)
         for data in blobs:
             f.write(data)
+    os.replace(tmp, path)
 
 
 def load_safetensors(path: str) -> Tuple[Dict[str, np.ndarray], Dict[str, str]]:

@@ -237,6 +237,9 @@ class SystemConfig:
     # is validated and normalized (float16 maps to bfloat16: TPUs have
     # native bf16 MXU support and no fp16 fast path).
     compute_dtype: Optional[str] = None
+    # Interval checkpoints hand the disk write to a background thread so
+    # the train loop keeps stepping (final/preemption saves stay blocking).
+    async_checkpointing: bool = True
 
     def __post_init__(self):
         if self.compute_dtype is None:

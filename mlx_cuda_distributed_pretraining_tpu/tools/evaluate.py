@@ -49,6 +49,9 @@ def _iter_docs(path: str) -> Iterator[Dict[str, Any]]:
                 obj = {"text": line}
             if isinstance(obj, str):
                 obj = {"text": obj}
+            elif not isinstance(obj, dict):
+                # scalar/array JSON lines ('42', '[1,2]') are plain text
+                obj = {"text": line}
             yield obj
 
 
@@ -200,13 +203,16 @@ def evaluate_mc(params, args, tok, data_path: str, limit: int = 0,
             # leading space: the choice continues the question text
             ch_ids = _tok_ids(tok, " " + ch.strip())
             ids = (ctx_ids + ch_ids)[-max_len:]
-            start = len(ids) - len(ch_ids)
+            # Clamp: a choice longer than max_len must not swallow context
+            # positions into its score (position 0 has no target anyway).
+            start = max(len(ids) - len(ch_ids), 1)
+            n_scored = len(ids) - start
             bucket = _round_up_pow2(len(ids) + 1)
             pad = np.zeros((1, bucket), np.int32)
             pad[0, : len(ids)] = ids
             lp = float(choice_lp(params, jnp.asarray(pad), start, len(ids)))
             scores.append(lp)
-            scores_n.append(lp / max(len(ch_ids), 1))
+            scores_n.append(lp / max(n_scored, 1))
         if not scores:
             continue
         n += 1

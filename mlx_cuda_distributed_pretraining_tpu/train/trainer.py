@@ -276,7 +276,7 @@ class Trainer:
         return self.state["opt_state"]
 
     # -- checkpointing ------------------------------------------------------
-    def save_checkpoint(self, step) -> None:
+    def save_checkpoint(self, step, blocking: bool = True) -> None:
         # The host gather is a COLLECTIVE when state is sharded across
         # processes (multi-host FSDP/ZeRO), so every process runs it; only
         # process 0 touches the filesystem afterwards.
@@ -306,23 +306,15 @@ class Trainer:
         self.checkpoints.save(
             step, host_params, host_opt, training_state,
             metadata_extra={"total_tokens": int(self.total_tokens)},
+            blocking=blocking,
         )
         self._write_metadata_summary()
-        self.logger.log(f"Saved checkpoint at step {step}")
+        self.logger.log(f"Saved checkpoint at step {step}"
+                        + ("" if blocking else " (async write)"))
 
     def _write_metadata_summary(self) -> None:
-        meta_path = os.path.join(self.run_dir, "metadata.json")
-        ledger = {}
-        if os.path.exists(meta_path):
-            try:
-                with open(meta_path) as f:
-                    ledger = json.load(f)
-            except (json.JSONDecodeError, OSError):
-                ledger = {}
-        ledger["validation"] = self.val_history
-        ledger["total_tokens"] = int(self.total_tokens)
-        with open(meta_path, "w") as f:
-            json.dump(ledger, f, indent=2)
+        self.checkpoints.update_ledger(
+            validation=self.val_history, total_tokens=int(self.total_tokens))
 
     def _resume(self) -> None:
         """Resume from ``resume.checkpoint`` (reference: :1545-1564 with
@@ -568,7 +560,10 @@ class Trainer:
 
                 saved_this_step = bool(ckpt_int and step % ckpt_int == 0)
                 if saved_this_step:
-                    self.save_checkpoint(step)
+                    # Interval saves overlap the disk write with training;
+                    # final/preemption saves below stay blocking.
+                    self.save_checkpoint(
+                        step, blocking=not cfg.system.async_checkpointing)
 
                 if self._preempted:
                     self.logger.log(
@@ -582,6 +577,14 @@ class Trainer:
                     break
 
         finally:
+            # Drain pending async checkpoint writes even when an exception
+            # escapes the loop — the interpreter would otherwise kill the
+            # daemon writer mid-file (temp+rename makes that safe for the
+            # file; draining makes the checkpoint actually exist).
+            try:
+                self.checkpoints.wait()
+            except RuntimeError as e:
+                self.logger.log(str(e))
             if prof_active:
                 import jax.profiler as _prof
 
@@ -602,7 +605,7 @@ class Trainer:
                 self.logger.log_validation(step, final_val)
                 self.val_history["steps"].append(step)
                 self.val_history["losses"].append(final_val)
-        self.save_checkpoint("final")
+        self.save_checkpoint("final")  # blocking: drains pending async writes first
         if hasattr(self.data, "stop"):
             self.data.stop()  # streaming sources run a prefetch thread
         if self.stats_client is not None:
