@@ -50,6 +50,17 @@ def _to_numpy_tree(tree: Any) -> Any:
     return jax.tree_util.tree_map(to_host, tree)
 
 
+
+def _atomic_json(path: str, obj: Any) -> None:
+    """Temp-file + rename: JSON sidecars get the same crash safety as the
+    safetensors files (an interrupted rewrite must not truncate a good
+    file — a corrupt metadata.json would silently reset the ledger)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=2)
+    os.replace(tmp, path)
+
+
 class CheckpointManager:
     def __init__(self, run_dir: str):
         self.run_dir = run_dir
@@ -144,8 +155,7 @@ class CheckpointManager:
         if arrays is not None:
             save_safetensors(opt_path, arrays,
                              metadata={"scalars": json.dumps(scalars)})
-        with open(state_path, "w") as f:
-            json.dump(training_state, f, indent=2)
+        _atomic_json(state_path, training_state)
         self._append_metadata(step, model_path, metadata_extra)
 
     def _writer_loop(self) -> None:
@@ -192,8 +202,7 @@ class CheckpointManager:
             if extra:
                 entry.update(extra)
             entries.append(entry)
-            with open(os.path.join(self.run_dir, "metadata.json"), "w") as f:
-                json.dump(ledger, f, indent=2)
+            _atomic_json(os.path.join(self.run_dir, "metadata.json"), ledger)
 
     def update_ledger(self, **fields: Any) -> None:
         """Merge top-level fields into metadata.json under the same lock
@@ -201,8 +210,7 @@ class CheckpointManager:
         with self._meta_lock:
             ledger = self._load_ledger()
             ledger.update(fields)
-            with open(os.path.join(self.run_dir, "metadata.json"), "w") as f:
-                json.dump(ledger, f, indent=2)
+            _atomic_json(os.path.join(self.run_dir, "metadata.json"), ledger)
 
     # -- load ---------------------------------------------------------------
     def load(

@@ -555,3 +555,40 @@ def test_evaluate_ppl_and_mc(tmp_path):
             f.write(json.dumps({"text": "the quick brown fox jumps. " * 40}) + "\n")
     rp = evaluate_ppl(params, args, tok, str(txt), seq_len=64, batch_size=2)
     assert rp["tokens"] > 0 and 1.0 < rp["ppl"] < 10 * args.vocab_size
+
+
+def test_make_cloze_eval(tmp_path):
+    """Offline cloze-eval generator: records are evaluate.py-compatible,
+    deterministic under seed, and the gold is recoverable from choices."""
+    import json
+
+    from mlx_cuda_distributed_pretraining_tpu.tools.evaluate import _mc_records
+    from mlx_cuda_distributed_pretraining_tpu.tools.make_cloze_eval import build_cloze
+
+    src = tmp_path / "corpus.jsonl"
+    base = ("apple banana cherry dragonfruit elderberry fig grape honeydew "
+            "kiwi lemon mango nectarine orange papaya quince raspberry").split()
+    words = [f"{w}{sfx}" for w in base for sfx in ("", "tree", "seed", "leaf")]
+    with open(src, "w") as f:
+        for i in range(600):
+            sent = " ".join(words[(i * 7 + j) % len(words)] for j in range(10))
+            f.write(json.dumps({"text": sent.capitalize() + "."}) + "\n")
+
+    recs = build_cloze(str(src), n=50, n_choices=4, seed=3)
+    assert len(recs) == 50
+    for r in recs:
+        assert set(r) == {"question", "choices", "answer"}
+        assert len(r["choices"]) == 4
+        assert 0 <= r["answer"] < 4
+        assert len(r["question"].split()) >= 6
+    # deterministic
+    assert build_cloze(str(src), n=50, n_choices=4, seed=3) == recs
+    assert build_cloze(str(src), n=50, n_choices=4, seed=4) != recs
+
+    # evaluate.py parses them
+    out = tmp_path / "cloze.jsonl"
+    with open(out, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    parsed = list(_mc_records(str(out)))
+    assert len(parsed) == 50
