@@ -22,7 +22,7 @@ timeout before printing anything):
   alarms cannot bound a case; SIGKILLing a child can.  Set
   ``BENCH_INPROC=1`` to fall back to single-process mode.
 
-The matrix: {2M, 40M, 100M, 400M} params x flash attention at a realistic
+The matrix: {2M, 40M, 100M, 400M, 650M} params x flash attention at a realistic
 32,768 vocab (fused chunked CE — ops/fused_ce.py), with simple-attention
 comparison points, each entry carrying tok/s, step_ms and MFU; plus
 decode/prefill throughput incl. a 16k-context bucketed+int8-KV decode, and
@@ -74,11 +74,20 @@ SCALES = {
     "400m": dict(shape=dict(hidden_size=1024, intermediate_size=4096, num_layers=24,
                             num_heads=16, num_kv_heads=16, head_dim=64),
                  batch=16, seq=2048, remat="dots"),
+    # Largest single-chip point with full AdamW state (fp32 master+m+v is
+    # ~8 GB of the 16 GB HBM): extends the measured ladder toward the 1B
+    # north star; full remat keeps activations out of the way.
+    "650m": dict(shape=dict(hidden_size=1536, intermediate_size=4096, num_layers=20,
+                            num_heads=24, num_kv_heads=24, head_dim=64),
+                 batch=8, seq=2048, remat="full"),
 }
 # MFU-chasing variant: remat trades FLOPs for memory so the batch can
 # double again — higher arithmetic intensity per HBM byte. Derived from
 # the 100m shape so the comparison stays same-model by construction.
 SCALES["100m_bs64"] = dict(SCALES["100m"], batch=64, remat="dots")
+# Simple (full-score) attention at 40m needs a smaller batch: [B,H,S,S]
+# fp32 scores at bs32 are ~4.3 GB in the forward alone.
+SCALES["40m_bs16"] = dict(SCALES["40m"], batch=16)
 
 _T_START = time.monotonic()
 _BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1200"))
@@ -451,8 +460,19 @@ def build_plan(vocab, steps):
                                   vocab, steps), 150),
         ("2m_simple", "simple",
          lambda: bench_train_case("2m_simple", "2m", "simple", vocab, steps), 90),
+        # flash-vs-simple at 40m compares at the SAME bs16 shape (simple's
+        # [B,H,S,S] scores OOM at bs32, and a cross-batch comparison would
+        # confound kernel and batch effects).
         ("40m_simple", "simple",
-         lambda: bench_train_case("40m_simple", "40m", "simple", vocab, steps), 150),
+         lambda: bench_train_case("40m_simple", "40m_bs16", "simple", vocab,
+                                  steps), 150),
+        ("40m_flash_bs16", "simple",
+         lambda: bench_train_case("40m_flash_bs16", "40m_bs16", "flash", vocab,
+                                  steps), 120),
+        # Last: the most expensive case must not starve the unique
+        # families above under a tight budget (it needs its own 300s).
+        ("650m_flash", "650m",
+         lambda: bench_train_case("650m_flash", "650m", "flash", vocab, steps), 300),
     ]
 
 
@@ -610,7 +630,7 @@ def main() -> None:
     _VOCAB = vocab = int(os.environ.get("BENCH_VOCAB", "32768"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     cases_env = os.environ.get(
-        "BENCH_CASES", "2m,40m,100m,400m,simple,decode,longctx,trainer")
+        "BENCH_CASES", "2m,40m,100m,400m,650m,simple,decode,longctx,trainer")
     wanted = set(cases_env.split(","))
     inproc = os.environ.get("BENCH_INPROC") == "1"
 

@@ -34,6 +34,7 @@ import sys
 from typing import Dict, Iterator, List
 
 _WORD = re.compile(r"[A-Za-z]+")
+_STRIP_CHARS = ".,;:!?\"'()[] "
 _SENT_SPLIT = re.compile(r"(?<=[.!?])\s+")
 
 
@@ -67,7 +68,11 @@ def build_cloze(
     for text in _iter_texts(src_path):
         for sent in _SENT_SPLIT.split(text):
             words = sent.split()
-            freq.update(w.lower() for w in words if _content_word(w))
+            # Strip the same punctuation the gold-selection pass strips —
+            # otherwise clause-final words ('jumps.') never get counted and
+            # the frequency bands stop being frequency-matched.
+            stripped = (w.strip(_STRIP_CHARS) for w in words)
+            freq.update(w.lower() for w in stripped if _content_word(w))
             if len(words) >= min_ctx + 1:
                 sents.append(words)
     if not sents:
@@ -95,13 +100,13 @@ def build_cloze(
         # gold = last content word with at least min_ctx words before it
         gold_idx = None
         for i in range(len(words) - 1, min_ctx - 1, -1):
-            w = _WORD.fullmatch(words[i].strip(".,;:!?\"'()[]").strip())
+            w = _WORD.fullmatch(words[i].strip(_STRIP_CHARS))
             if w and _content_word(w.group(0)) and w.group(0).lower() in band_of:
                 gold_idx = i
                 break
         if gold_idx is None:
             continue
-        gold_raw = words[gold_idx].strip(".,;:!?\"'()[]").strip()
+        gold_raw = words[gold_idx].strip(_STRIP_CHARS)
         gold = gold_raw.lower()
         ctx = " ".join(words[:gold_idx])
         band = bands[band_of[gold]]
