@@ -40,8 +40,9 @@ on-device (state feeds the next step) and syncs once via a host fetch;
 decode/prefill additionally use a two-point (T(n_hi)-T(n_lo)) difference
 to cancel the fixed overhead.
 
-Env knobs: BENCH_CASES (comma list: 2m,40m,100m,400m,simple,decode,
-longctx,trainer; default all), BENCH_STEPS, BENCH_VOCAB, BENCH_BUDGET_S.
+Env knobs: BENCH_CASES (comma list: 2m,40m,100m,400m,650m,simple,decode,
+longctx,trainer; default all; plus CI-only "tiny"), BENCH_STEPS,
+BENCH_VOCAB, BENCH_BUDGET_S.
 """
 
 from __future__ import annotations
@@ -62,6 +63,9 @@ V5E_PEAK_FLOPS = 197e12  # TPU v5e bf16 peak per chip
 # BASELINE.md scale points; per-chip batch/seq chosen to fill HBM (fused CE
 # frees the 4.3GB logits tensor, so 100m runs bs32 and 400m bs16 + remat).
 SCALES = {
+    "tiny": dict(shape=dict(hidden_size=32, intermediate_size=64, num_layers=2,
+                            num_heads=4, num_kv_heads=2, head_dim=8),
+                 batch=4, seq=128, remat=None),
     "2m": dict(shape=dict(hidden_size=128, intermediate_size=256, num_layers=4,
                           num_heads=8, num_kv_heads=8, head_dim=16),
                batch=64, seq=1024, remat=None),
@@ -438,6 +442,12 @@ def build_plan(vocab, steps):
     every case family. (trainer before 40m: it IS a 40m e2e run.)
     Each entry: (case_id, family, thunk, reserve_s)."""
     return [
+        # "tiny" is a CI-only family (not in the default BENCH_CASES): it
+        # exists so tests can drive the whole parent/child/probe machinery
+        # on CPU in seconds.
+        ("tiny_simple", "tiny",
+         lambda: bench_train_case("tiny_simple", "tiny", "simple", vocab, steps),
+         60),
         ("2m_flash", "2m",
          lambda: bench_train_case("2m_flash", "2m", "flash", vocab, steps), 90),
         ("decode_2m", "decode", lambda: bench_decode_case("2m", vocab), 120),
