@@ -11,7 +11,11 @@ OUT=${2:?out dir}
 VAL=${3:-/tmp/realrun/data/val.jsonl}
 MANIFEST=${4:-/tmp/realrun/corpus.manifest.json}
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
-PY=(env PYTHONPATH="$REPO" python)
+# Default to CPU: the session env forces JAX_PLATFORMS=axon via a
+# sitecustomize that PYTHONPATH="$REPO" displaces, which would otherwise
+# leave jax pointing at an unregisterable backend. Export
+# ARTIFACT_JAX_PLATFORM=tpu to eval on the chip.
+PY=(env PYTHONPATH="$REPO" JAX_PLATFORMS="${ARTIFACT_JAX_PLATFORM:-cpu}" python)
 
 mkdir -p "$OUT"
 cp "$RUN/config.yaml" "$RUN/log.txt" "$OUT/"
