@@ -156,3 +156,22 @@ def test_attend_bucket_helper():
     assert _attend_bucket(257, 4096) == 512
     assert _attend_bucket(5000, 8192) == 8192
     assert _attend_bucket(5000, 6000) == 6000  # clamped to cache
+
+
+def test_moe_decode_matches_full_forward():
+    """Cached single-token decode through MoE blocks must equal full-forward
+    greedy — expert capacity at S=1 must not silently drop the token."""
+    moe_args = LlamaArgs(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=8, max_position_embeddings=64,
+        num_local_experts=4, num_experts_per_tok=2)
+    params = llama.init_params(jax.random.PRNGKey(0), moe_args)
+    prompt = [1, 5, 9, 3, 7]
+    toks, _ = generate_lite(params, moe_args, prompt, max_tokens=6)
+
+    cur = list(prompt)
+    for _ in range(6):
+        logits, _, _ = llama.forward(params, jnp.asarray([cur]), moe_args,
+                                     return_aux=True)
+        cur.append(int(jnp.argmax(logits[0, -1])))
+    assert toks == cur[len(prompt):]
