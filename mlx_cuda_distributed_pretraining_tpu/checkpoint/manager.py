@@ -51,6 +51,12 @@ def _to_numpy_tree(tree: Any) -> Any:
 
 
 
+class StaleBackgroundWriteError(RuntimeError):
+    """An EARLIER async checkpoint write failed; the blocking write that
+    surfaced this error DID land on disk. Callers on exit paths (final /
+    preemption saves) can catch exactly this and proceed."""
+
+
 def _atomic_json(path: str, obj: Any) -> None:
     """Temp-file + rename: JSON sidecars get the same crash safety as the
     safetensors files (an interrupted rewrite must not truncate a good
@@ -129,16 +135,32 @@ class CheckpointManager:
                    arrays, scalars, training_state, metadata_extra)
 
         if blocking:
-            self.wait()  # keep FIFO order with any pending async writes
+            # Drain pending async writes (FIFO order), but do NOT let a
+            # failed background write abort this one: a blocking save is
+            # usually the final/preemption checkpoint, and raising before
+            # writing would lose the latest state precisely when it matters
+            # most. Write first, then surface the earlier failure.
+            if self._writer is not None:
+                self._queue.join()
+            try:
+                self._raise_pending()
+            except RuntimeError as earlier:
+                self._write(payload)
+                raise StaleBackgroundWriteError(
+                    f"checkpoint for step {step} was written, but an earlier "
+                    f"background write had failed: {earlier}") from earlier
             self._write(payload)
         else:
             if self._writer is None:
                 import queue
                 import threading
 
-                # Depth 1: overlapping the write of step N with training is
-                # the whole benefit; deeper queues only pin more full host
-                # copies of params+opt state (GBs each at 100M+).
+                # maxsize=1 bounds the pipeline at TWO live host snapshots
+                # (GBs each at 100M+): the writer get()s a payload
+                # immediately, so one can sit in the queue while another is
+                # being written. A producer that saves faster than the disk
+                # drains blocks on put() — that back-pressure, not the
+                # queue depth alone, is the memory bound.
                 self._queue: Any = queue.Queue(maxsize=1)
                 self._writer = threading.Thread(
                     target=self._writer_loop, name="ckpt-writer", daemon=True)

@@ -52,7 +52,9 @@ def save_safetensors(
     blobs = []
     offset = 0
     for name in sorted(tensors):
-        arr = np.ascontiguousarray(tensors[name])
+        # NOT ascontiguousarray: it silently promotes 0-d scalars to shape
+        # (1,); ``tobytes()`` below C-orders non-contiguous views anyway.
+        arr = np.asarray(tensors[name])
         st_dtype = _DTYPE_TO_ST.get(arr.dtype)
         if st_dtype is None:
             raise ValueError(f"unsupported dtype {arr.dtype} for tensor {name!r}")

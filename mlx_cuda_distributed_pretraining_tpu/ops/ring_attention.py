@@ -41,6 +41,20 @@ import jax.numpy as jnp
 from .masks import NEG_INF, MaskMod
 
 
+def ring_live_hops(sp: int, seq_local: int, window: Optional[int]) -> int:
+    """Number of live KV rotation chunks for a ring of size ``sp``.
+
+    This is the kernel's own static unroll bound: a full causal ring
+    visits all ``sp`` chunks, while a sliding window of ``window`` tokens
+    only has visible elements at rotation distances ``i*seq_local <
+    window + seq_local - 1`` — so a 1024-window over a 32k sequence on
+    sp=8 does 2 hops, not 8. Exposed so callers (dryrun, tests) can
+    certify the early stop from outside the kernel."""
+    if window is None:
+        return sp
+    return min(sp, (window + seq_local - 2) // seq_local + 1)
+
+
 def _ring_perm(sp: int):
     return [(j, (j + 1) % sp) for j in range(sp)]
 
@@ -227,7 +241,7 @@ def _ring_attention_flash_sw(q, k, v, axis_name: str, scale: float,
     sp = jax.lax.axis_size(axis_name)
     kw = dict(block_q=block_q, block_kv=block_kv, scale=scale)
     # distances with any visible element: i*Sl < window + Sl - 1
-    n_live = min(sp, (window + Sl - 2) // Sl + 1)
+    n_live = ring_live_hops(sp, Sl, window)
     perm = _ring_perm(sp)
 
     def _chunk_kw(i: int) -> dict:

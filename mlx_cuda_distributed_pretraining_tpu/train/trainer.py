@@ -570,7 +570,16 @@ class Trainer:
                         f"Preemption signal received: saving checkpoint at step {step} and exiting"
                     )
                     if not saved_this_step:
-                        self.save_checkpoint(step)
+                        from ..checkpoint.manager import StaleBackgroundWriteError
+
+                        try:
+                            self.save_checkpoint(step)
+                        except StaleBackgroundWriteError as e:
+                            # Exactly this error means the preemption state
+                            # IS on disk and only an EARLIER async write had
+                            # failed — log it and exit cleanly. Any other
+                            # failure (e.g. the gather itself) propagates.
+                            self.logger.log(f"Preemption checkpoint: {e}")
                     break
 
                 if stopped_early:

@@ -22,3 +22,88 @@ def test_run_dir_roundtrip(tmp_path):
     mgr2 = TokenizerManager.from_run_dir(str(tmp_path))
     assert mgr2.vocab_size == mgr.vocab_size
     assert mgr2.detokenize(mgr2.tokenize("xyz")) == "xyz"
+
+
+# --- adversarial edges (VERDICT r3 next #7) --------------------------------
+
+def test_byte_tokenizer_multibyte_unicode_roundtrip():
+    """UTF-8 multi-byte sequences (2..4 bytes) survive encode/decode
+    exactly — every byte is < 256, so nothing is dropped."""
+    tok = ByteTokenizer()
+    text = "héllo жизнь 数学 🎉🧪"
+    ids = tok.encode(text)
+    assert max(ids) < 256 and len(ids) == len(text.encode("utf-8"))
+    assert tok.decode(ids) == text
+
+
+def test_byte_tokenizer_truncated_multibyte_replaces_not_raises():
+    """Truncating a doc mid-UTF-8-sequence must decode with replacement
+    characters, never raise (tokenize_doc truncates at a byte count that
+    can split a codepoint)."""
+    mgr = TokenizerManager(DataConfig(preprocessing={"max_context_size": 5}))
+    ids = mgr.tokenize_doc("ab🎉cd")  # 🎉 is 4 bytes; cut lands inside it
+    assert ids[0] == mgr.bos_id and ids[-1] == mgr.eos_id
+    assert len(ids) == 7  # 5 payload bytes + BOS/EOS
+    out = mgr.detokenize(ids)  # must not raise
+    assert out.startswith("ab")
+
+
+def test_byte_tokenizer_small_vocab_drops_high_bytes():
+    """normal_vocab_size < 256: bytes outside the table are dropped on
+    encode, and decode of arbitrary ids never raises."""
+    tok = ByteTokenizer(normal_vocab_size=128)
+    ids = tok.encode("abc é")  # é is 2 bytes >= 128
+    assert ids == [ord(c) for c in "abc "]
+    assert tok.decode([0, 127, 128, 1000, -3]) == "\x00\x7f"  # out-of-range skipped
+    assert tok.vocab_size == 131
+
+
+def test_special_token_ids_stable_across_run_dir_roundtrip(tmp_path):
+    """Custom specials in a non-default order keep their EXACT ids after
+    save_to_run_dir -> from_run_dir (ids are assigned by dict order; a
+    reorder would silently remap BOS/EOS in resumed runs)."""
+    cfg = DataConfig(tokenizer={
+        "normal_vocab_size": 200,
+        "special_tokens": {"eos": "<e>", "bos": "<b>", "pad": "<p>"},
+    })
+    mgr = TokenizerManager(cfg, run_dir=str(tmp_path))
+    assert (mgr.eos_id, mgr.bos_id, mgr.pad_id) == (200, 201, 202)
+    mgr2 = TokenizerManager.from_run_dir(str(tmp_path))
+    assert (mgr2.eos_id, mgr2.bos_id, mgr2.pad_id) == (200, 201, 202)
+    assert mgr2.vocab_size == 203
+
+
+def test_hf_tokenizer_specials_collision_and_unicode(tmp_path):
+    """HF tokenizer.json path: literal special-token text in user input
+    maps to the special id (added tokens match raw text), and decode()
+    strips it — adversarial input cannot smuggle an EOS through a
+    detokenize round-trip. Unicode survives byte-level BPE."""
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=400, special_tokens=["<pad>", "<bos>", "<eos>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet())
+    tok.train_from_iterator(["hello world häßlich 🎉"] * 8, trainer)
+    tok_dir = tmp_path / "tok"
+    tok_dir.mkdir()
+    tok.save(str(tok_dir / "tokenizer.json"))
+
+    mgr = TokenizerManager(DataConfig(tokenizer_path=str(tok_dir)))
+    assert mgr.external_path is not None
+    # unicode round-trip through byte-level BPE
+    assert mgr.detokenize(mgr.tokenize("häßlich 🎉")) == "häßlich 🎉"
+    # literal "<eos>" in input text becomes the special id ...
+    ids = mgr.tokenize("abc<eos>def")
+    assert mgr.eos_id in ids
+    # ... and detokenize strips it rather than re-emitting the marker
+    assert "<eos>" not in mgr.detokenize(ids)
+
+
+def test_tokenize_doc_empty_and_exact_boundary():
+    mgr = TokenizerManager(DataConfig(preprocessing={"max_context_size": 4}))
+    assert mgr.tokenize_doc("") == [mgr.bos_id, mgr.eos_id]
+    ids = mgr.tokenize_doc("abcd")  # exactly max_context_size bytes
+    assert len(ids) == 6 and ids[1:-1] == [ord(c) for c in "abcd"]
