@@ -181,7 +181,8 @@ def flops_per_token(n_params, num_layers, seq, d_attn):
     return 6.0 * n_params + 6.0 * num_layers * seq * d_attn
 
 
-def bench_train_case(name, scale_key, attn, vocab, steps, fused_ce=True):
+def bench_train_case(name, scale_key, attn, vocab, steps, fused_ce=True,
+                     optimizer="adamw"):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -206,7 +207,7 @@ def bench_train_case(name, scale_key, attn, vocab, steps, fused_ce=True):
     tr_cfg = TrainingConfig(
         hyperparameters={"learning_rate": 1e-3, "weight_decay": 0.01, "gradient_clip": 1.0},
         scheduler={"type": "cosine", "min_lr_ratio": 0.1},
-        optimization={"optimizer": "adamw"},
+        optimization={"optimizer": optimizer},
     )
     opt = build_optimizer(tr_cfg, 1000)
 
@@ -244,6 +245,7 @@ def bench_train_case(name, scale_key, attn, vocab, steps, fused_ce=True):
                          args.num_heads * args.head_dim)
     return {
         "case": name, "params_m": round(n_params / 1e6, 1), "attn": attn,
+        "optimizer": optimizer,
         "batch": batch, "seq": seq, "vocab": vocab, "remat": remat,
         "fused_ce": ce_chunk > 0, "tok_s": round(tok_s, 0),
         "step_ms": round(1000 * dt / steps, 1),
@@ -492,6 +494,13 @@ def build_plan(vocab, steps):
          lambda: bench_train_case("650m_flash", "650m", "flash", vocab, steps), 300),
         ("1b_flash", "1b",
          lambda: bench_train_case("1b_flash", "1b", "flash", vocab, steps), 420),
+        # AdamW at ~0.96B params wants ~11.5 GB of fp32 master+m+v plus
+        # ~3.8 GB of fp32 grads in flight — right at the 16 GB HBM edge.
+        # Lion keeps only master+momentum (~7.7 GB), so this row is the
+        # guaranteed-fit 1B demonstration if the AdamW row OOMs.
+        ("1b_lion", "1b",
+         lambda: bench_train_case("1b_lion", "1b", "flash", vocab, steps,
+                                  optimizer="lion"), 420),
         ("100m_bs64_remat", "100m",
          lambda: bench_train_case("100m_bs64_remat", "100m_bs64", "flash",
                                   vocab, steps), 150),

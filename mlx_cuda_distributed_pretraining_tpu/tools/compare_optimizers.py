@@ -15,7 +15,48 @@ import json
 import os
 from typing import Any, Dict, List, Optional
 
-DEFAULT_OPTIMIZERS = ["adamw", "sgd", "lion", "muon", "shampoo", "hybrid"]
+# "hybrid" at default settings builds the exact same update as "muon" (muon
+# already routes non-matrix params to AdamW), so the default comparison uses
+# a DISTINCT pairing for the hybrid column (VERDICT r3 #5).
+DEFAULT_OPTIMIZERS = ["adamw", "sgd", "lion", "muon", "shampoo",
+                      "hybrid:shampoo+lion"]
+
+
+def parse_opt_spec(spec: str):
+    """'adamw' -> ('adamw', {}); 'hybrid:shampoo+lion' -> ('hybrid',
+    {'matrix_optimizer': 'shampoo', 'non_matrix_optimizer': 'lion'})."""
+    if spec.startswith("hybrid:"):
+        matrix, _, rest = spec[len("hybrid:"):].partition("+")
+        return "hybrid", {"matrix_optimizer": matrix,
+                          "non_matrix_optimizer": rest or "adamw"}
+    return spec, {}
+
+
+def _tuned_lr(cfg_dict: Dict[str, Any], opt_name: str, runs_root: str,
+              label: str, finder_steps: int, out_dir: Optional[str]) -> float:
+    """Per-optimizer LR sweep with the optimizer's own update rule: builds
+    a throwaway Trainer for params/loss/data, sweeps, returns the
+    suggestion (finder CSV/PNG land in <out_dir>/lr_finder_<label>/)."""
+    from ..config import Config
+    from ..train.lr_finder import run_lr_finder_for_optimizer
+    from ..train.trainer import Trainer, _device_batch
+
+    probe_dict = copy.deepcopy(cfg_dict)
+    probe_dict["name"] = f"{probe_dict['name']}-lrfind"
+    probe = Trainer(Config.from_dict(probe_dict), runs_root=runs_root, quiet=True)
+    try:
+        suggested, _, _ = run_lr_finder_for_optimizer(
+            probe.state["params"], probe.loss_fn,
+            lambda i: _device_batch(probe.data.generate_batch(i)),
+            probe.config.training, opt_name,
+            num_steps=finder_steps,
+            out_dir=os.path.join(out_dir, f"lr_finder_{label}") if out_dir else None,
+        )
+    finally:
+        if hasattr(probe.data, "stop"):
+            probe.data.stop()
+        probe.logger.close()
+    return float(suggested)
 
 
 def compare(
@@ -23,30 +64,53 @@ def compare(
     optimizers: List[str],
     runs_root: str,
     iters: Optional[int] = None,
+    tune_lr: bool = False,
+    finder_steps: int = 80,
+    out_dir: Optional[str] = None,
 ) -> Dict[str, Dict[str, Any]]:
-    """Train one run per optimizer from the same base config; returns
-    {optimizer: {final_loss, final_val_loss, losses, steps}}."""
+    """Train one run per optimizer spec from the same base config; returns
+    {label: {final_loss, final_val_loss, losses, steps, wall_s,
+    mean_tok_s, learning_rate}}. With ``tune_lr`` each optimizer first
+    gets its own LR-finder sweep (run with its real update rule) and
+    trains at the suggestion — comparing optimizers at one shared LR
+    mostly measures LR mismatch (VERDICT r3 #5)."""
+    import time
+
     from ..config import Config
     from ..obs.plotting import parse_log
     from ..train.trainer import Trainer
 
     results: Dict[str, Dict[str, Any]] = {}
-    for opt in optimizers:
+    for spec in optimizers:
+        opt, extra = parse_opt_spec(spec)
+        label = spec.replace(":", "_").replace("+", "_")
         cfg_dict = copy.deepcopy(base_config)
-        cfg_dict["name"] = f"{cfg_dict.get('name', 'optcmp')}-{opt}"
+        cfg_dict["name"] = f"{cfg_dict.get('name', 'optcmp')}-{label}"
         cfg_dict["overwrite"] = True
-        cfg_dict.setdefault("training", {}).setdefault("optimization", {})["optimizer"] = opt
+        opt_cfg = cfg_dict.setdefault("training", {}).setdefault("optimization", {})
+        opt_cfg["optimizer"] = opt
+        opt_cfg.update(extra)
         if iters:
             cfg_dict["training"].setdefault("hyperparameters", {})["iters"] = iters
+        if tune_lr:
+            lr = _tuned_lr(cfg_dict, opt, runs_root, label, finder_steps, out_dir)
+            cfg_dict["training"].setdefault("hyperparameters", {})["learning_rate"] = lr
         cfg = Config.from_dict(cfg_dict)
         trainer = Trainer(cfg, runs_root=runs_root, quiet=True)
+        t0 = time.perf_counter()
         out = trainer.train()
+        wall = time.perf_counter() - t0
         steps, metrics = parse_log(os.path.join(trainer.run_dir, "log.txt"))
-        results[opt] = {
+        tok_s = [v for v in (metrics.get("tok/s") or []) if v is not None]
+        results[label] = {
             "final_loss": out["final_loss"],
             "final_val_loss": out["final_val_loss"],
             "steps": steps,
             "losses": metrics.get("loss", []),
+            "learning_rate": float(cfg.training.learning_rate),
+            "wall_s": round(wall, 1),
+            "mean_tok_s": round(sum(tok_s[1:]) / max(len(tok_s) - 1, 1), 1)
+                          if len(tok_s) > 1 else None,
         }
     return results
 
@@ -63,7 +127,8 @@ def write_outputs(results: Dict[str, Dict[str, Any]], out_dir: str) -> str:
         for s in all_steps:
             w.writerow([s] + [by_opt[n].get(s) for n in names])
     summary = {
-        n: {"final_loss": r["final_loss"], "final_val_loss": r["final_val_loss"]}
+        n: {k: r.get(k) for k in ("final_loss", "final_val_loss",
+                                  "learning_rate", "wall_s", "mean_tok_s")}
         for n, r in results.items()
     }
     with open(os.path.join(out_dir, "optimizer_comparison.json"), "w") as f:
@@ -97,19 +162,27 @@ def main(argv=None):
     parser.add_argument("--iters", type=int, default=None, help="override steps per run")
     parser.add_argument("--runs-root", default="runs")
     parser.add_argument("--out-dir", default="optimizer_comparison")
+    parser.add_argument("--tune-lr", action="store_true",
+                        help="per-optimizer LR finder sweep (with the real "
+                             "update rule) before each run")
+    parser.add_argument("--finder-steps", type=int, default=80)
     a = parser.parse_args(argv)
 
     import yaml
 
     with open(a.config) as f:
         base = yaml.safe_load(f)
-    results = compare(base, a.optimizers, a.runs_root, a.iters)
+    results = compare(base, a.optimizers, a.runs_root, a.iters,
+                      tune_lr=a.tune_lr, finder_steps=a.finder_steps,
+                      out_dir=a.out_dir)
     csv_path = write_outputs(results, a.out_dir)
     print(f"Wrote {csv_path}")
     for n, r in results.items():
         val = r["final_val_loss"]
-        print(f"  {n:>10}: final_loss={r['final_loss']:.4f}"
-              + (f" val_loss={val:.4f}" if val is not None else ""))
+        print(f"  {n:>24}: final_loss={r['final_loss']:.4f}"
+              + (f" val_loss={val:.4f}" if val is not None else "")
+              + f" lr={r['learning_rate']:.2e} wall={r['wall_s']}s"
+              + (f" tok/s={r['mean_tok_s']}" if r['mean_tok_s'] else ""))
     return results
 
 

@@ -75,6 +75,77 @@ def run_lr_finder(
     return suggested, lrs, losses
 
 
+def run_lr_finder_for_optimizer(
+    params: Any,
+    loss_fn: Callable,
+    batch_iter: Callable[[int], Dict],
+    training_cfg: Any,
+    optimizer_name: str,
+    min_lr: float = 1e-7,
+    max_lr: float = 1.0,
+    num_steps: int = 100,
+    smoothing: float = 0.05,
+    diverge_factor: float = 4.0,
+    out_dir: Optional[str] = None,
+) -> Tuple[float, List[float], List[float]]:
+    """LR sweep using the REAL optimizer's update rule.
+
+    ``run_lr_finder`` reproduces the reference's momentum-SGD sweep
+    (core/training.py:1520), but an SGD-derived suggestion is wrong for
+    optimizers with different update geometry (Muon's orthogonalized
+    steps, Shampoo's preconditioning, Lion's sign updates). Here the
+    optimizer itself is built with an exponentially-increasing LR
+    schedule, so each step IS one real update at the swept LR — the
+    suggestion is native to the optimizer being tuned (VERDICT r3 #5).
+    """
+    from ..optim import build_optimizer
+
+    gamma = (max_lr / min_lr) ** (1.0 / max(num_steps - 1, 1))
+    log_gamma = math.log(gamma)
+
+    def sweep_schedule(count):
+        # scale_by_schedule increments its counter BEFORE evaluating the
+        # schedule (optim/base.py), so loop step i arrives as count=i+1;
+        # shift back so step i applies exactly min_lr * gamma**i — the LR
+        # the sweep records for it.
+        i = jnp.maximum(count.astype(jnp.float32) - 1.0, 0.0)
+        return jnp.float32(min_lr) * jnp.exp(i * jnp.float32(log_gamma))
+
+    opt = build_optimizer(training_cfg, num_steps, name=optimizer_name,
+                          schedule=sweep_schedule)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state, batch):
+        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+        updates, state = opt.update(grads, state, params)
+        return apply_updates(params, updates), state, loss
+
+    lrs: List[float] = []
+    losses: List[float] = []
+    smooth = None
+    best = math.inf
+    for i in range(num_steps):
+        params, state, loss = step(params, state, batch_iter(i))
+        loss = float(loss)
+        smooth = loss if smooth is None else smoothing * loss + (1 - smoothing) * smooth
+        lrs.append(min_lr * gamma**i)
+        losses.append(smooth)
+        best = min(best, smooth)
+        if not math.isfinite(smooth) or smooth > diverge_factor * best:
+            break
+
+    suggested = suggest_lr(lrs, losses)
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, "lr_finder.csv"), "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["lr", "smoothed_loss"])
+            w.writerows(zip(lrs, losses))
+        _maybe_plot(lrs, losses, suggested, os.path.join(out_dir, "lr_finder.png"))
+    return suggested, lrs, losses
+
+
 def suggest_lr(lrs: List[float], losses: List[float]) -> float:
     """LR at the steepest descent of loss w.r.t. log(lr); falls back to
     best/10."""

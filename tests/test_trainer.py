@@ -223,3 +223,51 @@ def test_profiler_trace_window(tmp_path):
     assert found, "profiler produced no trace files"
     log = open(os.path.join(tr.run_dir, "log.txt")).read()
     assert "profiler: trace started at step 2" in log
+
+
+def test_lr_finder_for_optimizer_uses_real_update_rule(tmp_path):
+    """Per-optimizer sweep (VERDICT r3 #5): the finder runs the actual
+    optimizer (built with an exponential LR schedule), so different
+    optimizers can get different suggestions from identical params/data."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlx_cuda_distributed_pretraining_tpu.config import TrainingConfig
+    from mlx_cuda_distributed_pretraining_tpu.train.lr_finder import (
+        run_lr_finder_for_optimizer,
+    )
+
+    rng = np.random.default_rng(0)
+    w_true = rng.standard_normal((8, 1)).astype(np.float32)
+    params = {"w": jnp.zeros((8, 1), jnp.float32)}
+
+    def loss_fn(p, batch):
+        pred = batch["x"] @ p["w"]
+        return jnp.mean((pred - batch["y"]) ** 2), jnp.float32(1.0)
+
+    def batch_iter(i):
+        x = rng.standard_normal((16, 8)).astype(np.float32)
+        return {"x": jnp.asarray(x), "y": jnp.asarray(x @ w_true)}
+
+    tr_cfg = TrainingConfig(
+        hyperparameters={"learning_rate": 1e-3, "weight_decay": 0.0,
+                         "gradient_clip": 1.0},
+        scheduler={"type": "cosine", "min_lr_ratio": 0.1},
+        optimization={"optimizer": "adamw"},
+    )
+    out = {}
+    for opt in ("adamw", "lion", "muon"):
+        suggested, lrs, losses = run_lr_finder_for_optimizer(
+            params, loss_fn, batch_iter, tr_cfg, opt,
+            min_lr=1e-5, max_lr=10.0, num_steps=25,
+            out_dir=str(tmp_path / opt))
+        assert np.isfinite(suggested) and suggested > 0
+        assert len(lrs) == len(losses) > 4
+        assert os.path.isfile(os.path.join(str(tmp_path / opt), "lr_finder.csv"))
+        out[opt] = suggested
+    # The sweep must actually move loss (the real optimizer stepped) ...
+    assert losses[2] != losses[0]
+    # ... and the suggestions must be optimizer-specific: if the sweep
+    # ignored optimizer_name all three would come out identical.
+    assert len(set(out.values())) >= 2, out
