@@ -213,11 +213,18 @@ def bench_train_case(name, scale_key, attn, vocab, steps, fused_ce=True,
 
     from mlx_cuda_distributed_pretraining_tpu.ops.fused_ce import auto_chunk
 
-    ce_chunk = auto_chunk(batch, seq, vocab) if fused_ce else 0
+    # BENCH_CE_CHUNK overrides the auto policy for on-chip chunk sweeps.
+    env_chunk = os.environ.get("BENCH_CE_CHUNK")
+    ce_chunk = (int(env_chunk) if env_chunk
+                else (auto_chunk(batch, seq, vocab) if fused_ce else 0))
+
+    # BENCH_SCAN_LAYERS=1: lax.scan over the layer stack (one compiled
+    # layer body — cuts remote-compile wall time at 400M-1B scales).
+    scan = os.environ.get("BENCH_SCAN_LAYERS") == "1"
 
     def loss_fn(p, b):
         return llama.loss_fn(p, b, args, compute_dtype=jnp.bfloat16,
-                             remat=remat, ce_chunk=ce_chunk)
+                             remat=remat, ce_chunk=ce_chunk, scan_layers=scan)
 
     step, _ = make_train_step(loss_fn, opt)
     state = init_train_state(params, opt)
@@ -245,9 +252,10 @@ def bench_train_case(name, scale_key, attn, vocab, steps, fused_ce=True,
                          args.num_heads * args.head_dim)
     return {
         "case": name, "params_m": round(n_params / 1e6, 1), "attn": attn,
-        "optimizer": optimizer,
+        "optimizer": optimizer, "scan_layers": scan,
         "batch": batch, "seq": seq, "vocab": vocab, "remat": remat,
-        "fused_ce": ce_chunk > 0, "tok_s": round(tok_s, 0),
+        "fused_ce": ce_chunk > 0, "ce_chunk": ce_chunk,
+        "tok_s": round(tok_s, 0),
         "step_ms": round(1000 * dt / steps, 1),
         "mfu": round(ft * tok_s / V5E_PEAK_FLOPS, 4),
         "final_loss": round(final_loss, 3),

@@ -128,11 +128,17 @@ class Trainer:
             ce_chunk = 0
             self.logger.log("fused CE auto-disabled on sp mesh (sequence-sharded)")
 
+        scan_layers = bool(getattr(cfg.system, "scan_layers", False))
+        if scan_layers and self.remat_ratio < 1.0:
+            self.logger.log(
+                "scan_layers ignored: remat_ratio < 1 needs per-layer "
+                "checkpoint selection, which a scanned stack cannot express")
+
         def loss_fn(params, batch):
             return arch.loss_fn(
                 params, batch, args, compute_dtype=self.compute_dtype,
                 remat=self.remat, remat_ratio=self.remat_ratio,
-                ce_chunk=ce_chunk,
+                ce_chunk=ce_chunk, scan_layers=scan_layers,
             )
 
         # Validation excludes MoE router aux terms: val loss / ppl stay pure
@@ -141,6 +147,7 @@ class Trainer:
             return arch.loss_fn(
                 params, batch, args, compute_dtype=self.compute_dtype,
                 include_aux=False, ce_chunk=ce_chunk,
+                scan_layers=scan_layers,
             )
 
         self.loss_fn = loss_fn

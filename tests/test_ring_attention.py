@@ -167,3 +167,21 @@ def test_ring_sliding_window_gqa():
     out = jax.jit(ring)(q, k, v)
     ref = reference_attention(q, k, v, mask_mod=M.sliding_window(20))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_ring_live_hops_formula():
+    """The public early-stop bound matches the kernel's static unroll:
+    full causal rings visit all sp chunks; a window smaller than the local
+    shard stops after ~2 hops regardless of total sequence length."""
+    from mlx_cuda_distributed_pretraining_tpu.ops.ring_attention import ring_live_hops
+
+    assert ring_live_hops(4, 64, None) == 4        # full causal: no early stop
+    assert ring_live_hops(4, 64, 96) == 3          # dryrun phase D
+    assert ring_live_hops(4, 8192, 1024) == 2      # dryrun phase E (32k/sp4)
+    assert ring_live_hops(8, 4096, 1024) == 2      # the 32k/sp8 pitch
+    assert ring_live_hops(2, 16, 1000) == 2        # clamped to sp
+    # Edge: a row's furthest visible key is window-1 back, so distance-2
+    # chunks only come alive once window >= seq_local + 2.
+    assert ring_live_hops(4, 64, 64) == 2
+    assert ring_live_hops(4, 64, 65) == 2
+    assert ring_live_hops(4, 64, 66) == 3
