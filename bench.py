@@ -218,9 +218,12 @@ def bench_train_case(name, scale_key, attn, vocab, steps, fused_ce=True,
     ce_chunk = (int(env_chunk) if env_chunk
                 else (auto_chunk(batch, seq, vocab) if fused_ce else 0))
 
-    # BENCH_SCAN_LAYERS=1: lax.scan over the layer stack (one compiled
-    # layer body — cuts remote-compile wall time at 400M-1B scales).
-    scan = os.environ.get("BENCH_SCAN_LAYERS") == "1"
+    # lax.scan over the layer stack (one compiled layer body — cuts
+    # remote-compile wall time at 400M-1B scales). Per-scale default in
+    # SCALES["<key>"]["scan"]; BENCH_SCAN_LAYERS=0/1 forces either way.
+    env_scan = os.environ.get("BENCH_SCAN_LAYERS")
+    scan = (env_scan == "1") if env_scan is not None \
+        else bool(sc.get("scan", False))
 
     def loss_fn(p, b):
         return llama.loss_fn(p, b, args, compute_dtype=jnp.bfloat16,

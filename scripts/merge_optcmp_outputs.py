@@ -1,0 +1,79 @@
+"""Merge per-optimizer compare_optimizers output dirs into one artifact.
+
+The comparison can run one optimizer per invocation (resumable under
+flaky schedulers); this stitches the per-run ``optimizer_comparison.json``
+/ ``.csv`` files back into the combined artifact layout that a single
+multi-optimizer invocation would have produced, and re-renders the PNG.
+
+Usage: python scripts/merge_optcmp_outputs.py OUT_DIR IN_DIR [IN_DIR...]
+Each IN_DIR is an --out-dir from a single-optimizer run (its lr_finder_*
+subdirs are copied through).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+import shutil
+import sys
+
+
+def main(out_dir: str, in_dirs: list) -> None:
+    os.makedirs(out_dir, exist_ok=True)
+    summary = {}
+    curves = {}
+    for d in in_dirs:
+        with open(os.path.join(d, "optimizer_comparison.json")) as f:
+            summary.update(json.load(f))
+        with open(os.path.join(d, "optimizer_comparison.csv")) as f:
+            rows = list(csv.reader(f))
+        names = rows[0][1:]
+        for j, n in enumerate(names):
+            curves[n] = [(int(r[0]), float(r[j + 1])) for r in rows[1:]
+                         if r[j + 1] not in ("", "None")]
+        for sub in os.listdir(d):
+            if sub.startswith("lr_finder_"):
+                dst = os.path.join(out_dir, sub)
+                shutil.rmtree(dst, ignore_errors=True)
+                shutil.copytree(os.path.join(d, sub), dst)
+
+    with open(os.path.join(out_dir, "optimizer_comparison.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+
+    names = list(curves)
+    all_steps = sorted({s for c in curves.values() for s, _ in c})
+    by = {n: dict(curves[n]) for n in names}
+    with open(os.path.join(out_dir, "optimizer_comparison.csv"), "w",
+              newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["step"] + names)
+        for s in all_steps:
+            w.writerow([s] + [by[n].get(s) for n in names])
+
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        return
+    fig, ax = plt.subplots(figsize=(8, 5))
+    for n in names:
+        steps = [s for s, _ in curves[n]]
+        losses = [l for _, l in curves[n]]
+        lr = summary.get(n, {}).get("learning_rate")
+        label = f"{n} (lr={lr:.1e})" if lr else n
+        ax.plot(steps, losses, label=label, linewidth=1.2)
+    ax.set_xlabel("step")
+    ax.set_ylabel("train loss")
+    ax.set_title("Optimizer comparison — per-optimizer tuned LRs")
+    ax.legend(fontsize=8)
+    ax.grid(alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(os.path.join(out_dir, "optimizer_comparison.png"), dpi=120)
+    plt.close(fig)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], sys.argv[2:])
