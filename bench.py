@@ -100,6 +100,10 @@ SCALES["100m_bs64"] = dict(SCALES["100m"], batch=64, remat="dots")
 # Simple (full-score) attention at 40m needs a smaller batch: [B,H,S,S]
 # fp32 scores at bs32 are ~4.3 GB in the forward alone.
 SCALES["40m_bs16"] = dict(SCALES["40m"], batch=16)
+# Long-context TRAINING point: flash at seq 8192 (same 40m model, same
+# tokens/step as 40m@2048) — simple attention at this seq would need a
+# 17 GB score tensor per batch element group; flash streams it.
+SCALES["40m_s8k"] = dict(SCALES["40m"], batch=8, seq=8192, remat="dots")
 
 # Decode timing chains DECODE_CHAIN greedy steps (two-point difference vs a
 # 32-step chain); the attend-bucket guard in bench_decode_case must cover
@@ -491,6 +495,9 @@ def build_plan(vocab, steps):
         ("400m_flash", "400m",
          lambda: bench_train_case("400m_flash", "400m", "flash", vocab, steps), 240),
         ("decode_100m", "decode", lambda: bench_decode_case("100m", vocab), 150),
+        ("40m_flash_s8k", "longctx",
+         lambda: bench_train_case("40m_flash_s8k", "40m_s8k", "flash", vocab,
+                                  steps), 180),
         ("decode_100m_16k_int8", "longctx",
          # attend=16384: the bucket production decode actually runs at
          # these positions (generate.py _attend_bucket is power-of-two, so
@@ -526,6 +533,12 @@ def build_plan(vocab, steps):
         ("40m_flash_bs16", "simple",
          lambda: bench_train_case("40m_flash_bs16", "40m_bs16", "flash", vocab,
                                   steps), 120),
+        # Muon at 100m: the lr-fair comparison (bench_artifacts/
+        # optcmp_1m_realtext_tuned) shows Muon ahead on quality; this row
+        # prices its NS5 step cost on-chip next to 100m_flash (adamw).
+        ("100m_muon", "100m",
+         lambda: bench_train_case("100m_muon", "100m", "flash", vocab, steps,
+                                  optimizer="muon"), 150),
     ]
 
 
