@@ -21,6 +21,12 @@ def main(argv=None) -> str:
     parser.add_argument("--beams", type=int, default=0, help=">0 switches to beam search")
     parser.add_argument("--kv-quant", action="store_true",
                         help="int8-quantized KV cache (less HBM per token)")
+    parser.add_argument("--speculative", action="store_true",
+                        help="greedy decode with prompt-lookup speculation "
+                             "(bit-identical output, >1 token per device "
+                             "step on repetitive stretches)")
+    parser.add_argument("--draft-len", type=int, default=8,
+                        help="speculative: drafted tokens per verify step")
     args = parser.parse_args(argv)
 
     from ..train.trainer import load_trained
@@ -29,7 +35,22 @@ def main(argv=None) -> str:
     if args.beams > 0 and args.kv_quant:
         parser.error("--kv-quant is not supported with --beams (beam search "
                      "uses the fp32 cache)")
+    if args.speculative and args.beams > 0:
+        parser.error("--speculative is greedy decoding; drop --beams")
     params, margs, tok, _ = load_trained(args.run, runs_root=args.runs_root)
+    if args.speculative:
+        from .generate import generate_speculative
+
+        ids = [tok.bos_id] + tok.tokenize(args.prompt)
+        out, stats = generate_speculative(
+            params, margs, ids, max_tokens=args.max_tokens,
+            draft_len=args.draft_len, stop_tokens=[tok.eos_id],
+            kv_quant=args.kv_quant,
+        )
+        text = tok.detokenize(out)
+        print(f"[{stats['generation_tps']:.1f} tok/s, "
+              f"{stats['tokens_per_call']} tok/verify] {args.prompt}{text}")
+        return text
     if args.beams > 0:
         ids = [tok.bos_id] + tok.tokenize(args.prompt)
         seq, score = beam_search(params, margs, ids, num_beams=args.beams,

@@ -187,6 +187,149 @@ def generate_lite(
     return out, stats
 
 
+def _verify_step(args: llama.LlamaArgs, chunk: int, attend_len: int):
+    """Speculative verify: one forward over [current token + drafts],
+    returning the model's greedy next-token at every position. Compiled
+    once per (args, chunk, attend bucket) — cached like _decode_step."""
+    key = ("verify", args, chunk, attend_len)
+    if key in _STEP_CACHE:
+        return _STEP_CACHE[key]
+
+    @jax.jit
+    def step(params, cache, toks, pos):
+        logits, cache = llama.forward(params, toks, args, cache=cache,
+                                      start_pos=pos, attend_len=attend_len)
+        lp = jax.nn.log_softmax(logits[0], axis=-1)
+        preds = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)
+        # Gather on device: every emitted token equals preds at its
+        # position (accepted drafts by definition, the bonus trivially),
+        # so [chunk] scalars cross the link instead of [chunk, vocab].
+        lp_emit = jnp.take_along_axis(lp, preds[:, None], axis=-1)[:, 0]
+        return cache, preds, lp_emit
+
+    _STEP_CACHE[key] = step
+    return step
+
+
+def _prompt_lookup_draft(seq: List[int], k: int, max_ngram: int,
+                         window: int = 2048) -> List[int]:
+    """Draft k tokens by prompt lookup: find the most recent earlier
+    occurrence of the longest suffix n-gram (n = max_ngram..1) within the
+    last ``window`` tokens and propose its continuation. No draft model —
+    the sequence itself is the draft model (strong on the repetitive
+    structure of code/data/quotes). Always returns exactly k tokens: with
+    no match it guesses (verification cost is shape-static either way)."""
+    lo = max(0, len(seq) - window)
+    for n in range(min(max_ngram, len(seq) - 1), 0, -1):
+        pat = seq[-n:]
+        for j in range(len(seq) - n - 1, lo - 1, -1):
+            if seq[j:j + n] == pat:
+                cont = seq[j + n:j + n + k]
+                if cont:
+                    return (cont + [seq[-1]] * (k - len(cont)))[:k]
+    return [seq[-1]] * k
+
+
+def generate_speculative(
+    params,
+    args: llama.LlamaArgs,
+    prompt_tokens: Sequence[int],
+    max_tokens: int = 128,
+    draft_len: int = 8,
+    max_ngram: int = 3,
+    stop_tokens: Optional[Sequence[int]] = None,
+    prefill_step_size: int = 512,
+    kv_quant: bool = False,
+) -> Tuple[List[int], Dict[str, float]]:
+    """Greedy decoding with prompt-lookup speculation (self-drafting).
+
+    Capability the reference does not have (its decode is strictly
+    one-token-at-a-time: core/generation_lite.py:158-175). Each iteration
+    verifies ``draft_len`` drafted tokens plus the current token in ONE
+    forward — on a match-heavy stretch one device step emits up to
+    ``draft_len + 1`` tokens; on a total miss it still emits 1, exactly
+    like plain decode. Output is bit-identical to greedy ``generate_lite``
+    (the draft only ever *proposes*; every emitted token is the model's
+    own argmax — see test_generate.py equivalence test).
+
+    Cache-safety of partial acceptance: a verify forward writes all
+    ``draft_len + 1`` KV entries, but ``pos`` is rewound to the accepted
+    position, and the next verify's write window starts there — every
+    junk entry is overwritten before any later query can attend it (the
+    same invariant bucketed prefill relies on).
+    """
+    k = max(1, int(draft_len))
+    stop = set(stop_tokens or ())
+    t0 = time.perf_counter()
+    if max_tokens < 1:
+        return [], {"generation_tokens": 0.0, "generation_tps": 0.0,
+                    "mean_logprob": 0.0,
+                    "prompt_tokens": float(len(prompt_tokens)),
+                    "verify_calls": 0.0, "tokens_per_call": 0.0}
+    tokens = np.asarray(prompt_tokens, np.int32)[None, :]
+    P = tokens.shape[1]
+    # + k headroom: the last verify window may write past the final token.
+    cache_len = min(_round_up(P + max_tokens + k, 128),
+                    max(args.max_position_embeddings, P + max_tokens + k))
+    cache, last_logits = prefill(params, args, tokens, cache_len,
+                                 prefill_step_size, kv_quant=kv_quant)
+
+    seq: List[int] = [int(t) for t in prompt_tokens]
+    first = int(np.argmax(np.asarray(last_logits[0])))
+    lp_first = float(jax.nn.log_softmax(last_logits, axis=-1)[0, first])
+    out: List[int] = [first]
+    logprobs: List[float] = [lp_first]
+    seq.append(first)
+
+    pos = P
+    calls = 0
+    while len(out) < max_tokens and out[-1] not in stop:
+        drafts = _prompt_lookup_draft(seq, k, max_ngram)
+        toks = jnp.asarray([[seq[-1]] + drafts], jnp.int32)  # [1, k+1]
+        bucket = _attend_bucket(pos + k + 1, cache_len)
+        step = _verify_step(args, k + 1, bucket)
+        cache, preds, lp = step(params, cache, toks, jnp.asarray(pos, jnp.int32))
+        preds_h = np.asarray(preds)
+        lp_h = np.asarray(lp)
+        calls += 1
+
+        m = 0
+        while m < k and drafts[m] == int(preds_h[m]):
+            m += 1
+        emitted = drafts[:m] + [int(preds_h[m])]  # m accepted + 1 bonus
+        for i, t in enumerate(emitted):
+            if len(out) >= max_tokens:
+                break
+            out.append(t)
+            logprobs.append(float(lp_h[i]))
+            seq.append(t)
+            if t in stop:
+                break
+        # Rewind to the slot of the LAST emitted token: its KV was never
+        # written (like `first` after prefill, it was an output, not an
+        # input), so the next verify feeds it as toks[0] and writes it at
+        # exactly this slot. out[i] sits at slot P+i, hence P+len(out)-1.
+        # Junk beyond it is overwritten by that same write window before
+        # any query can attend it.
+        pos = P + len(out) - 1
+        for layer in cache:
+            layer["pos"] = jnp.asarray(pos, jnp.int32)
+
+    while out and out[-1] in stop:
+        out.pop()
+        logprobs.pop()
+    dt = max(time.perf_counter() - t0, 1e-9)
+    stats = {
+        "generation_tokens": float(len(out)),
+        "generation_tps": len(out) / dt,
+        "mean_logprob": float(np.mean(logprobs)) if logprobs else 0.0,
+        "prompt_tokens": float(P),
+        "verify_calls": float(calls),
+        "tokens_per_call": round(len(out) / max(calls, 1), 2),
+    }
+    return out, stats
+
+
 def generate_text(
     params,
     args: llama.LlamaArgs,
