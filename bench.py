@@ -104,6 +104,10 @@ SCALES["40m_bs16"] = dict(SCALES["40m"], batch=16)
 # tokens/step as 40m@2048) — simple attention at this seq would need a
 # 17 GB score tensor per batch element group; flash streams it.
 SCALES["40m_s8k"] = dict(SCALES["40m"], batch=8, seq=8192, remat="dots")
+# Adafactor's factored second moments shrink the 1B optimizer state from
+# ~11.5 GB (AdamW fp32 master+m+v) to ~3.9 GB (master + row/col factors),
+# buying 2x batch at the same HBM (optim/adafactor.py).
+SCALES["1b_bs8"] = dict(SCALES["1b"], batch=8)
 
 # Decode timing chains DECODE_CHAIN greedy steps (two-point difference vs a
 # 32-step chain); the attend-bucket guard in bench_decode_case must cover
@@ -519,6 +523,9 @@ def build_plan(vocab, steps):
         ("1b_lion", "1b",
          lambda: bench_train_case("1b_lion", "1b", "flash", vocab, steps,
                                   optimizer="lion"), 420),
+        ("1b_adafactor", "1b",
+         lambda: bench_train_case("1b_adafactor", "1b_bs8", "flash", vocab,
+                                  steps, optimizer="adafactor"), 420),
         ("100m_bs64_remat", "100m",
          lambda: bench_train_case("100m_bs64_remat", "100m_bs64", "flash",
                                   vocab, steps), 150),

@@ -325,7 +325,9 @@ def generate_speculative(
         "mean_logprob": float(np.mean(logprobs)) if logprobs else 0.0,
         "prompt_tokens": float(P),
         "verify_calls": float(calls),
-        "tokens_per_call": round(len(out) / max(calls, 1), 2),
+        # Excludes the prefill-produced first token: it cost zero verify
+        # calls, so counting it would overstate the speculation payoff.
+        "tokens_per_call": round(max(len(out) - 1, 0) / max(calls, 1), 2),
     }
     return out, stats
 

@@ -8,6 +8,7 @@ from .base import (
     partition,
     with_ema,
 )
+from .adafactor import adafactor
 from .enhanced import adam, adamw, lion, sgd
 from .factory import build_optimizer
 from .muon import muon, newton_schulz5
@@ -19,5 +20,5 @@ __all__ = [
     "global_norm", "partition", "with_ema", "adam", "adamw", "lion", "sgd",
     "build_optimizer", "muon", "newton_schulz5", "build_schedule",
     "cosine_decay", "join_schedules", "linear_schedule", "warmup_cosine",
-    "inverse_pth_root", "shampoo",
+    "inverse_pth_root", "shampoo", "adafactor",
 ]

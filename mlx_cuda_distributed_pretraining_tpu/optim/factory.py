@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+from .adafactor import adafactor
 from .base import Schedule, Transform, partition
 from .enhanced import adam, adamw, lion, sgd
 from .muon import matrix_label_fn, muon
@@ -77,6 +78,17 @@ def build_optimizer(
             momentum=float(_opt(training_cfg, "momentum", 0.9)),
             graft_type=str(_opt(training_cfg, "graft_type", "adam")),
             weight_decay=wd, grad_clip=clip,
+        )
+    if name == "adafactor":
+        momentum = _opt(training_cfg, "momentum")
+        return adafactor(
+            schedule, weight_decay=wd,
+            decay_rate=float(_opt(training_cfg, "decay_rate", 0.8)),
+            clipping_threshold=_opt(training_cfg, "clipping_threshold", 1.0),
+            momentum=float(momentum) if momentum else None,
+            multiply_by_parameter_scale=bool(
+                _opt(training_cfg, "multiply_by_parameter_scale", True)),
+            grad_clip=clip,
         )
     if name == "hybrid":
         # Two-optimizer partition: matrix params → matrix_optimizer, rest →
