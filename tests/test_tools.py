@@ -592,3 +592,52 @@ def test_make_cloze_eval(tmp_path):
             f.write(json.dumps(r) + "\n")
     parsed = list(_mc_records(str(out)))
     assert len(parsed) == 50
+
+
+def test_merge_optcmp_outputs(tmp_path):
+    """scripts/merge_optcmp_outputs.py stitches per-optimizer --out-dir
+    runs back into the combined artifact layout (summary JSON merged,
+    curves re-aligned on the step axis, lr_finder dirs copied)."""
+    import csv
+    import importlib.util
+    import json
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "merge_optcmp", os.path.join(repo, "scripts", "merge_optcmp_outputs.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    def write_dir(name, steps, losses, lr):
+        d = tmp_path / name
+        d.mkdir()
+        with open(d / "optimizer_comparison.json", "w") as f:
+            json.dump({name: {"final_loss": losses[-1], "final_val_loss": None,
+                              "learning_rate": lr, "wall_s": 1.0,
+                              "mean_tok_s": 10.0}}, f)
+        with open(d / "optimizer_comparison.csv", "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["step", name])
+            w.writerows(zip(steps, losses))
+        (d / f"lr_finder_{name}").mkdir()
+        (d / f"lr_finder_{name}" / "lr_finder.csv").write_text("lr,loss\n")
+        return str(d)
+
+    a = write_dir("alpha", [10, 20, 30], [3.0, 2.5, 2.0], 1e-3)
+    b = write_dir("beta", [10, 30], [3.1, 2.1], 2e-3)  # sparser steps
+    out = str(tmp_path / "merged")
+    mod.main(out, [a, b])
+
+    with open(os.path.join(out, "optimizer_comparison.json")) as f:
+        summary = json.load(f)
+    assert set(summary) == {"alpha", "beta"}
+    assert summary["beta"]["learning_rate"] == 2e-3
+    with open(os.path.join(out, "optimizer_comparison.csv")) as f:
+        rows = list(csv.reader(f))
+    assert rows[0] == ["step", "alpha", "beta"]
+    by_step = {int(r[0]): r[1:] for r in rows[1:]}
+    assert by_step[20] == ["2.5", ""] or by_step[20] == ["2.5", "None"] or \
+        by_step[20][1] in ("", "None")  # beta has no step 20
+    assert os.path.isdir(os.path.join(out, "lr_finder_alpha"))
+    assert os.path.isdir(os.path.join(out, "lr_finder_beta"))
