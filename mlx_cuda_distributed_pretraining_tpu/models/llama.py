@@ -574,10 +574,22 @@ def loss_fn(
         else:
             w_vd = params["tok_embeddings"]["weight"].astype(compute_dtype)
             bias = None
-        nll_sum = fused_ce.fused_cross_entropy(
-            hidden, w_vd, targets, mask, bias_v=bias,
-            logit_scale=args.logit_scale, chunk=ce_chunk,
-        )
+        from ..parallel.context import current_mesh
+
+        mesh = current_mesh()
+        if (mesh is not None and mesh.shape.get("sp", 1) > 1
+                and mesh.shape.get("tp", 1) == 1):
+            # Sequence-sharded: shard_map keeps the chunked CE local to
+            # each sp shard (ops/fused_ce.py::fused_cross_entropy_sp).
+            nll_sum = fused_ce.fused_cross_entropy_sp(
+                hidden, w_vd, targets, mask, mesh, bias_v=bias,
+                logit_scale=args.logit_scale, chunk=ce_chunk,
+            )
+        else:
+            nll_sum = fused_ce.fused_cross_entropy(
+                hidden, w_vd, targets, mask, bias_v=bias,
+                logit_scale=args.logit_scale, chunk=ce_chunk,
+            )
         loss = nll_sum / count
     else:
         logits, _, aux = forward(

@@ -99,3 +99,61 @@ def auto_chunk(batch: int, seq: int, vocab: int) -> int:
     if batch * seq * vocab * 4 < 256 * 1024 * 1024:
         return 0
     return 2048
+
+
+def fused_cross_entropy_sp(
+    hidden: jnp.ndarray,
+    w_vd: jnp.ndarray,
+    targets: jnp.ndarray,
+    mask: jnp.ndarray,
+    mesh,
+    bias_v: Optional[jnp.ndarray] = None,
+    logit_scale: Optional[float] = None,
+    chunk: int = 2048,
+) -> jnp.ndarray:
+    """Sequence-sharded fused CE for sp (context-parallel) meshes.
+
+    The flat-row reshape in :func:`fused_cross_entropy` has no valid GSPMD
+    sharding when the sequence dim is sharded, which previously forced sp
+    runs back to full [B, S, V] logits — the exact memory hog fused CE
+    exists to avoid, and sp runs are where S is LONGEST. This variant
+    drops to ``shard_map``: every device runs the chunked fused CE on its
+    own local [B_local, S_local] block (chunking over local rows), and one
+    ``psum`` reduces the masked NLL sums. Requires the vocab projection
+    replicated — i.e. ``tp == 1`` (with tp, the projection is
+    vocab-sharded and GSPMD's own vocab-parallel handling of the unfused
+    path applies instead).
+
+    Exactness: identical math to the single-device path — the row chunks
+    are just distributed; the psum is the same fp32 sum re-associated per
+    device (tests assert loss AND grad parity on a dp x sp mesh).
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def size(a):
+        return mesh.shape.get(a, 1)
+
+    assert size("tp") == 1, (
+        "fused_cross_entropy_sp needs a replicated vocab projection "
+        "(tp == 1); with tp the unfused path is already vocab-parallel")
+    data_axes = tuple(a for a in ("dp", "fsdp", "ep") if size(a) > 1)
+    b_axes = data_axes if data_axes else None
+    seq_axis = "sp" if size("sp") > 1 else None
+
+    in_specs = [P(b_axes, seq_axis, None), P(None, None),
+                P(b_axes, seq_axis), P(b_axes, seq_axis)]
+    args = [hidden, w_vd, targets, mask]
+    if bias_v is not None:
+        in_specs.append(P(None))
+        args.append(bias_v)
+
+    def local(h, w, t, m, *rest):
+        b = rest[0] if rest else None
+        s = fused_cross_entropy(h, w, t, m, bias_v=b,
+                                logit_scale=logit_scale, chunk=chunk)
+        return jax.lax.psum(s, tuple(mesh.axis_names))
+
+    fn = shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
+                   out_specs=P(), check_rep=False)
+    return fn(*args)

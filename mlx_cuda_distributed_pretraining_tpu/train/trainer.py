@@ -121,12 +121,18 @@ class Trainer:
         ce_chunk = int(getattr(cfg.system, "fused_ce_chunk", -1))
         if (ce_chunk == -1 and self.mesh is not None
                 and "sp" in self.mesh.axis_names and self.mesh.shape["sp"] > 1):
-            # Fused CE chunks over flattened B*S rows; with the sequence dim
-            # sharded (sp) that reshape has no valid GSPMD sharding and would
-            # all-gather the hidden states. Auto mode therefore stays off on
-            # sp meshes (explicit fused_ce_chunk > 0 is respected if set).
-            ce_chunk = 0
-            self.logger.log("fused CE auto-disabled on sp mesh (sequence-sharded)")
+            if self.mesh.shape.get("tp", 1) > 1:
+                # With BOTH sp and tp, the projection is vocab-sharded and
+                # the sequence is sharded: neither fused path applies; the
+                # unfused CE under GSPMD is already vocab-parallel.
+                ce_chunk = 0
+                self.logger.log(
+                    "fused CE auto-disabled on sp x tp mesh (vocab-sharded "
+                    "projection); explicit fused_ce_chunk > 0 is respected")
+            else:
+                # loss_fn routes to the shard_map sequence-sharded fused CE
+                # (ops/fused_ce.py::fused_cross_entropy_sp).
+                self.logger.log("fused CE: sequence-sharded path on sp mesh")
 
         scan_layers = bool(getattr(cfg.system, "scan_layers", False))
         if scan_layers and self.remat_ratio < 1.0:

@@ -158,3 +158,47 @@ def test_sharding_no_shape_collision():
     assert wq_mu.spec == wq_param.spec
     assert wo_mu.spec == wo_param.spec
     assert wq_param.spec != wo_param.spec  # transposed rules really differ
+
+
+def test_sp_fused_ce_matches_dense():
+    """Sequence-sharded fused CE (ops/fused_ce.py::fused_cross_entropy_sp,
+    auto-routed by llama.loss_fn on sp meshes with tp == 1): loss AND
+    grads match the single-device unfused reference on a dp x sp mesh —
+    the shard_map path just distributes the row chunks."""
+    import dataclasses
+
+    import numpy as np
+
+    from mlx_cuda_distributed_pretraining_tpu.config import SystemConfig
+    from mlx_cuda_distributed_pretraining_tpu.models import llama
+    from mlx_cuda_distributed_pretraining_tpu.parallel import build_mesh
+    from mlx_cuda_distributed_pretraining_tpu.parallel.context import set_mesh
+
+    mesh = build_mesh(SystemConfig(seed=0, device="cpu",
+                                   mesh={"dp": 2, "sp": 4}))
+    args = llama.LlamaArgs(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=2, num_kv_heads=2, head_dim=16,
+        max_position_embeddings=256, attention_type="ring")
+    params = llama.init_params(jax.random.PRNGKey(0), args)
+    rng = np.random.default_rng(0)
+    x = rng.integers(1, 120, size=(4, 257)).astype(np.int32)
+    b = {"inputs": jnp.asarray(x[:, :-1]), "targets": jnp.asarray(x[:, 1:]),
+         "mask": jnp.ones((4, 256), jnp.float32)}
+
+    set_mesh(None)
+    dargs = dataclasses.replace(args, attention_type="simple")
+    dense, dg = jax.value_and_grad(
+        lambda p: llama.loss_fn(p, b, dargs, ce_chunk=0)[0])(params)
+
+    set_mesh(mesh)
+    try:
+        loss, g = jax.jit(jax.value_and_grad(
+            lambda p: llama.loss_fn(p, b, args, ce_chunk=64)[0]))(params)
+        assert abs(float(loss) - float(dense)) < 1e-4
+        mx = max(float(jnp.max(jnp.abs(a - b2))) for a, b2 in
+                 zip(jax.tree_util.tree_leaves(dg),
+                     jax.tree_util.tree_leaves(g)))
+        assert mx < 1e-6, mx
+    finally:
+        set_mesh(None)
