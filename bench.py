@@ -75,15 +75,20 @@ SCALES = {
     "100m": dict(shape=dict(hidden_size=768, intermediate_size=2048, num_layers=12,
                             num_heads=12, num_kv_heads=12, head_dim=64),
                  batch=32, seq=2048, remat=None),
+    # scan=True on the big cases: 20-24 unrolled layers + remat + fused CE
+    # make the largest XLA programs in the matrix, and long remote compiles
+    # blowing the case reserve are the observed reason 400m/650m have no
+    # driver-recorded number after three rounds; the scan body compiles
+    # once per LAYER SHAPE instead (identical math — tests/test_model.py).
     "400m": dict(shape=dict(hidden_size=1024, intermediate_size=4096, num_layers=24,
                             num_heads=16, num_kv_heads=16, head_dim=64),
-                 batch=16, seq=2048, remat="dots"),
+                 batch=16, seq=2048, remat="dots", scan=True),
     # Largest single-chip point with full AdamW state (fp32 master+m+v is
     # ~8 GB of the 16 GB HBM): extends the measured ladder toward the 1B
     # north star; full remat keeps activations out of the way.
     "650m": dict(shape=dict(hidden_size=1536, intermediate_size=4096, num_layers=20,
                             num_heads=24, num_kv_heads=24, head_dim=64),
-                 batch=8, seq=2048, remat="full"),
+                 batch=8, seq=2048, remat="full", scan=True),
     # The 1B north star (BASELINE.md; reference model-config-1b.yaml:
     # h2048, inter 5632, 16 layers, 16 heads @ head_dim 128, ctx 2048).
     # ~0.96B params at vocab 32768 → AdamW fp32 master+m+v is ~11.5 GB of
@@ -91,7 +96,7 @@ SCALES = {
     # and bf16 param cast inside the rest.
     "1b": dict(shape=dict(hidden_size=2048, intermediate_size=5632, num_layers=16,
                           num_heads=16, num_kv_heads=16, head_dim=128),
-               batch=4, seq=2048, remat="full"),
+               batch=4, seq=2048, remat="full", scan=True),
 }
 # MFU-chasing variant: remat trades FLOPs for memory so the batch can
 # double again — higher arithmetic intensity per HBM byte. Derived from
