@@ -541,6 +541,7 @@ def loss_fn(
     include_aux: bool = True,
     ce_chunk: int = -1,
     scan_layers: bool = False,
+    z_loss_weight: float = 0.0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Masked mean cross-entropy in fp32 (reference: core/training.py
     compute_loss :1195-1260). Returns (loss, token_count). MoE models add
@@ -577,20 +578,25 @@ def loss_fn(
         from ..parallel.context import current_mesh
 
         mesh = current_mesh()
+        want_z = z_loss_weight > 0.0
         if (mesh is not None and mesh.shape.get("sp", 1) > 1
                 and mesh.shape.get("tp", 1) == 1):
             # Sequence-sharded: shard_map keeps the chunked CE local to
             # each sp shard (ops/fused_ce.py::fused_cross_entropy_sp).
-            nll_sum = fused_ce.fused_cross_entropy_sp(
+            out = fused_ce.fused_cross_entropy_sp(
                 hidden, w_vd, targets, mask, mesh, bias_v=bias,
-                logit_scale=args.logit_scale, chunk=ce_chunk,
+                logit_scale=args.logit_scale, chunk=ce_chunk, with_z=want_z,
             )
         else:
-            nll_sum = fused_ce.fused_cross_entropy(
+            out = fused_ce.fused_cross_entropy(
                 hidden, w_vd, targets, mask, bias_v=bias,
-                logit_scale=args.logit_scale, chunk=ce_chunk,
+                logit_scale=args.logit_scale, chunk=ce_chunk, with_z=want_z,
             )
-        loss = nll_sum / count
+        if want_z:
+            nll_sum, z_sum = out
+            loss = nll_sum / count + z_loss_weight * z_sum / count
+        else:
+            loss = out / count
     else:
         logits, _, aux = forward(
             params, batch["inputs"], args, compute_dtype=compute_dtype,
@@ -601,6 +607,8 @@ def loss_fn(
         gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
         nll = (logz - gold) * mask
         loss = nll.sum() / count
+        if z_loss_weight > 0.0:
+            loss = loss + z_loss_weight * jnp.sum(jnp.square(logz) * mask) / count
     if args.is_moe and include_aux:
         loss = loss + aux  # pre-scaled inside moe_block
     return loss, mask.sum()

@@ -41,7 +41,8 @@ def fused_cross_entropy(
     bias_v: Optional[jnp.ndarray] = None,
     logit_scale: Optional[float] = None,
     chunk: int = 2048,
-) -> jnp.ndarray:
+    with_z: bool = False,
+):
     """Masked NLL sum without materializing full logits.
 
     hidden  [B, S, D]  final hidden states (compute dtype, e.g. bf16)
@@ -50,7 +51,10 @@ def fused_cross_entropy(
     mask    [B, S]     0/1
     bias_v  [V]        optional output-projection bias
     Returns the fp32 scalar sum of masked token NLLs (caller divides by
-    the token count).
+    the token count); with ``with_z`` returns ``(nll_sum, z_sum)`` where
+    z_sum is the masked sum of logsumexp(logits)^2 — the z-loss
+    regularizer's numerator (PaLM-style logit-drift control), computed
+    from the same per-chunk logsumexp at zero extra memory.
     """
     B, S, D = hidden.shape
     N = B * S
@@ -81,11 +85,17 @@ def fused_cross_entropy(
             logits = logits * logit_scale
         logz = jax.nn.logsumexp(logits, axis=-1)
         gold = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
-        return acc + jnp.sum((logz - gold) * mc), None
+        nll_acc, z_acc = acc
+        return (nll_acc + jnp.sum((logz - gold) * mc),
+                z_acc + jnp.sum(jnp.square(logz) * mc)), None
 
-    nll_sum, _ = jax.lax.scan(
-        jax.checkpoint(body), jnp.zeros((), jnp.float32), (xs, ts, ms)
+    (nll_sum, z_sum), _ = jax.lax.scan(
+        jax.checkpoint(body),
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (xs, ts, ms),
     )
+    if with_z:
+        return nll_sum, z_sum
     return nll_sum
 
 
@@ -110,7 +120,8 @@ def fused_cross_entropy_sp(
     bias_v: Optional[jnp.ndarray] = None,
     logit_scale: Optional[float] = None,
     chunk: int = 2048,
-) -> jnp.ndarray:
+    with_z: bool = False,
+):
     """Sequence-sharded fused CE for sp (context-parallel) meshes.
 
     The flat-row reshape in :func:`fused_cross_entropy` has no valid GSPMD
@@ -150,10 +161,14 @@ def fused_cross_entropy_sp(
 
     def local(h, w, t, m, *rest):
         b = rest[0] if rest else None
-        s = fused_cross_entropy(h, w, t, m, bias_v=b,
-                                logit_scale=logit_scale, chunk=chunk)
-        return jax.lax.psum(s, tuple(mesh.axis_names))
+        nll, z = fused_cross_entropy(h, w, t, m, bias_v=b,
+                                     logit_scale=logit_scale, chunk=chunk,
+                                     with_z=True)
+        return jax.lax.psum((nll, z), tuple(mesh.axis_names))
 
     fn = shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
-                   out_specs=P(), check_rep=False)
-    return fn(*args)
+                   out_specs=(P(), P()), check_rep=False)
+    nll_sum, z_sum = fn(*args)
+    if with_z:
+        return nll_sum, z_sum
+    return nll_sum

@@ -135,6 +135,7 @@ class Trainer:
                 self.logger.log("fused CE: sequence-sharded path on sp mesh")
 
         scan_layers = bool(getattr(cfg.system, "scan_layers", False))
+        z_loss_weight = float(cfg.training.hyperparameters.get("z_loss", 0.0))
         if scan_layers and self.remat_ratio < 1.0:
             self.logger.log(
                 "scan_layers ignored: remat_ratio < 1 needs per-layer "
@@ -145,6 +146,7 @@ class Trainer:
                 params, batch, args, compute_dtype=self.compute_dtype,
                 remat=self.remat, remat_ratio=self.remat_ratio,
                 ce_chunk=ce_chunk, scan_layers=scan_layers,
+                z_loss_weight=z_loss_weight,
             )
 
         # Validation excludes MoE router aux terms: val loss / ppl stay pure
