@@ -240,11 +240,11 @@ class SystemConfig:
     # Interval checkpoints hand the disk write to a background thread so
     # the train loop keeps stepping (final/preemption saves stay blocking).
     async_checkpointing: bool = True
-    # Run the uniform layer stack as one lax.scan body over in-jit-stacked
-    # params (models/llama.py::forward): XLA compiles ONE layer instead of
-    # num_layers copies — a large (remote-)compile-time saver at 400M-1B.
-    # Training path only; ignored when remat_ratio < 1 or under pipeline
-    # parallelism (pp stacks layers itself).
+    # Run the uniform layer stack as lax.scan bodies over in-jit-stacked
+    # params (models/llama.py::forward): XLA compiles ONE layer (two with
+    # a partial remat_ratio) instead of num_layers copies — a large
+    # (remote-)compile-time saver at 400M-1B. Training path only; under
+    # pipeline parallelism pp stacks layers itself.
     scan_layers: bool = False
 
     def __post_init__(self):

@@ -427,8 +427,8 @@ def forward(
     cutting program size and (remote-)compile wall time ~num_layers x at
     the 400M-1B scales; the stack itself is one extra pass over the
     already-casted params, negligible next to a training step. Training
-    path only (ignored under KV cache); falls back to the loop when
-    ``remat_ratio < 1`` (a scan cannot checkpoint a prefix of layers).
+    path only (ignored under KV cache). ``remat_ratio < 1`` runs as TWO
+    scans — the checkpointed prefix and the plain suffix.
     """
     B, S = tokens.shape
     x = params["tok_embeddings"]["weight"].astype(compute_dtype)[tokens]
@@ -448,17 +448,26 @@ def forward(
     new_cache = [] if cache is not None else None
     n_remat = int(round(args.num_layers * remat_ratio))
     aux_total = jnp.zeros((), jnp.float32)
-    if scan_layers and cache is None and remat_ratio >= 1.0:
-        stacked = jax.tree_util.tree_map(
-            lambda *ls: jnp.stack(ls), *[cast(l) for l in params["layers"]])
-        blk = block  # remat dispatch already applied above
+    if scan_layers and cache is None:
+        # Segmented scan: the checkpointed prefix (remat_ratio) and the
+        # plain suffix each scan over their own stacked params — at most
+        # two compiled layer bodies, any ratio.
+        layers = [cast(l) for l in params["layers"]]
+        segments = ([(layers[:n_remat], block),
+                     (layers[n_remat:], transformer_block)]
+                    if remat else [(layers, transformer_block)])
+        for seg, blk in segments:
+            if not seg:
+                continue
+            stacked = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *seg)
 
-        def body(h, layer):
-            h, _, aux = blk(layer, h, args, positions, None, None, attend_len)
-            return h, aux
+            def body(h, layer, blk=blk):
+                h, _, aux = blk(layer, h, args, positions, None, None,
+                                attend_len)
+                return h, aux
 
-        x, auxs = jax.lax.scan(body, x, stacked)
-        aux_total = aux_total + auxs.sum()
+            x, auxs = jax.lax.scan(body, x, stacked)
+            aux_total = aux_total + auxs.sum()
     else:
         for i, layer in enumerate(params["layers"]):
             blk = block if (remat and i < n_remat) else transformer_block

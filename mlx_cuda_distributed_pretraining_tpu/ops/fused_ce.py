@@ -85,18 +85,16 @@ def fused_cross_entropy(
             logits = logits * logit_scale
         logz = jax.nn.logsumexp(logits, axis=-1)
         gold = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
-        nll_acc, z_acc = acc
-        return (nll_acc + jnp.sum((logz - gold) * mc),
-                z_acc + jnp.sum(jnp.square(logz) * mc)), None
+        nll_c = jnp.sum((logz - gold) * mc)
+        if with_z:  # trace-time constant: pure-CE callers keep one carry
+            nll_acc, z_acc = acc
+            return (nll_acc + nll_c, z_acc + jnp.sum(jnp.square(logz) * mc)), None
+        return acc + nll_c, None
 
-    (nll_sum, z_sum), _ = jax.lax.scan(
-        jax.checkpoint(body),
-        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
-        (xs, ts, ms),
-    )
-    if with_z:
-        return nll_sum, z_sum
-    return nll_sum
+    init = ((jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+            if with_z else jnp.zeros((), jnp.float32))
+    acc, _ = jax.lax.scan(jax.checkpoint(body), init, (xs, ts, ms))
+    return acc  # (nll_sum, z_sum) when with_z, else the nll_sum scalar
 
 
 def auto_chunk(batch: int, seq: int, vocab: int) -> int:

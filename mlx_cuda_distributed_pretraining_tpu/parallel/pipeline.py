@@ -202,6 +202,7 @@ def make_pipeline_loss(
     remat: Optional[str] = None,
     include_aux: bool = True,
     ce_chunk: int = -1,
+    z_loss_weight: float = 0.0,
 ) -> Callable:
     """Build ``loss(stacked_params, batch) -> (loss, token_count)`` running
     the GPipe schedule over the mesh's pp axis.
@@ -253,11 +254,15 @@ def make_pipeline_loss(
         def head_nll(out, tgt, msk):
             h = rms_norm(out, norm_w, args.rms_norm_eps)
             if ce_rows > 0:
-                nll = fused_ce.fused_cross_entropy(
+                out_ce = fused_ce.fused_cross_entropy(
                     h, out_w.astype(compute_dtype).T, tgt, msk,
                     logit_scale=args.logit_scale, chunk=ce_rows,
+                    with_z=z_loss_weight > 0.0,
                 )
-                return nll, msk.sum()
+                if z_loss_weight > 0.0:
+                    nll, z = out_ce
+                    return nll + z_loss_weight * z, msk.sum()
+                return out_ce, msk.sum()
             # fp32-accumulated projection — matches the non-pp loss exactly.
             logits = jax.lax.dot_general(
                 h, out_w.astype(compute_dtype), (((2,), (0,)), ((), ())),
@@ -267,7 +272,10 @@ def make_pipeline_loss(
                 logits = logits * args.logit_scale
             logz = jax.nn.logsumexp(logits, axis=-1)
             gold = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
-            return ((logz - gold) * msk).sum(), msk.sum()
+            nll_sum = ((logz - gold) * msk).sum()
+            if z_loss_weight > 0.0:
+                nll_sum = nll_sum + z_loss_weight * jnp.sum(jnp.square(logz) * msk)
+            return nll_sum, msk.sum()
 
         def tick(carry, t):
             state, nll_sum, tok_sum, aux_sum = carry
@@ -358,6 +366,7 @@ def make_pipeline_train_step(
     params_like: Optional[Params] = None,
     log_grad_norm: bool = False,
     ce_chunk: int = -1,
+    z_loss_weight: float = 0.0,
 ) -> Tuple[Callable, Any]:
     """Jitted ``step(state, batch) -> (state, metrics)`` with stacked params
     sharded over pp (plus the usual auto axes). ``params_like`` is the
@@ -368,7 +377,7 @@ def make_pipeline_train_step(
     assert params_like is not None
     loss_fn = make_pipeline_loss(
         args, mesh, num_microbatches, compute_dtype=compute_dtype, remat=remat,
-        ce_chunk=ce_chunk,
+        ce_chunk=ce_chunk, z_loss_weight=z_loss_weight,
     )
 
     def train_step(state, batch):

@@ -135,7 +135,7 @@ class Trainer:
                 self.logger.log("fused CE: sequence-sharded path on sp mesh")
 
         scan_layers = bool(getattr(cfg.system, "scan_layers", False))
-        z_loss_weight = float(cfg.training.hyperparameters.get("z_loss", 0.0))
+        z_loss_weight = float(cfg.training.hyperparameters.get("z_loss") or 0.0)
         if scan_layers and self.remat_ratio < 1.0:
             self.logger.log(
                 "scan_layers ignored: remat_ratio < 1 needs per-layer "
@@ -228,7 +228,7 @@ class Trainer:
                 zero_level=cfg.system.zero_optimization_level,
                 params_like=self.params,
                 log_grad_norm=cfg.logging.log_gradient_norm,
-                ce_chunk=ce_chunk,
+                ce_chunk=ce_chunk, z_loss_weight=z_loss_weight,
             )
             self.eval_step = jax.jit(make_pipeline_loss(
                 args, self.mesh, self.microbatches,

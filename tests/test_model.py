@@ -243,14 +243,15 @@ def test_scan_layers_matches_loop():
         num_heads=2, num_kv_heads=2, head_dim=16, max_position_embeddings=64,
         num_local_experts=4, num_experts_per_tok=2, moe_group_size=16)
 
-    for args, remat in ((dense, None), (dense, "full"), (dense, "dots"),
-                        (moe, None)):
+    for args, remat, ratio in ((dense, None, 1.0), (dense, "full", 1.0),
+                               (dense, "dots", 1.0), (moe, None, 1.0),
+                               (dense, "full", 0.5)):
         params = llama.init_params(jax.random.PRNGKey(1), args)
         batch = batch_for(args.vocab_size)
 
         def loss(p, scan):
             return llama.loss_fn(p, batch, args, remat=remat,
-                                 scan_layers=scan)[0]
+                                 remat_ratio=ratio, scan_layers=scan)[0]
 
         l_loop, g_loop = jax.value_and_grad(lambda p: loss(p, False))(params)
         l_scan, g_scan = jax.value_and_grad(lambda p: loss(p, True))(params)

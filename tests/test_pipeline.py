@@ -248,3 +248,21 @@ def test_pipeline_fused_ce_matches_unfused():
         loss_fn = pl.make_pipeline_loss(ARGS, mesh, num_microbatches=4, ce_chunk=chunk)
         got, _ = jax.jit(loss_fn)(stacked, batch)
         np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+
+
+def test_pipeline_z_loss_matches_single_device():
+    """z_loss plumbs through the pipeline head: pp loss with z equals the
+    non-pp loss_fn with the same weight (a pp>1 config must not silently
+    drop the regularizer)."""
+    mesh = _mesh()
+    params = llama.init_params(jax.random.PRNGKey(0), ARGS)
+    batch = _batch()
+    w = 1e-2
+    ref, _ = llama.loss_fn(params, batch, ARGS, z_loss_weight=w)
+    loss_fn = pl.make_pipeline_loss(ARGS, mesh, num_microbatches=4,
+                                    z_loss_weight=w)
+    got, _ = jax.jit(loss_fn)(pl.stack_layers(params), batch)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+    # and the z term is actually active (differs from the pure-CE loss)
+    plain, _ = llama.loss_fn(params, batch, ARGS)
+    assert float(got) > float(plain)
