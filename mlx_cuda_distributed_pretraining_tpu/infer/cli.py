@@ -27,6 +27,9 @@ def main(argv=None) -> str:
                              "step on repetitive stretches)")
     parser.add_argument("--draft-len", type=int, default=8,
                         help="speculative: drafted tokens per verify step")
+    parser.add_argument("--weight-quant", action="store_true",
+                        help="int8 weight-only quantization (weights cross "
+                             "HBM at 1 byte/elem; composes with --kv-quant)")
     args = parser.parse_args(argv)
 
     from ..train.trainer import load_trained
@@ -38,6 +41,10 @@ def main(argv=None) -> str:
     if args.speculative and args.beams > 0:
         parser.error("--speculative is greedy decoding; drop --beams")
     params, margs, tok, _ = load_trained(args.run, runs_root=args.runs_root)
+    if args.weight_quant:
+        from ..models.llama import quantize_params_int8
+
+        params = quantize_params_int8(params)
     if args.speculative:
         from .generate import generate_speculative
 

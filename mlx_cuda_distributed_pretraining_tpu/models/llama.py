@@ -186,10 +186,57 @@ def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
 
 
 def _linear(x: jnp.ndarray, p: Params) -> jnp.ndarray:
-    y = x @ p["weight"]
+    if "weight_q" in p:
+        # int8 weight-only quantization (quantize_params_int8): the
+        # per-output-channel scale factors OUT of the contraction, so
+        # dequant happens after the matmul on the [.., out] result — the
+        # weight crosses HBM at 1 byte/elem.
+        y = (x @ p["weight_q"].astype(x.dtype)) * p["weight_s"].astype(x.dtype)
+    else:
+        y = x @ p["weight"]
     if "bias" in p:
         y = y + p["bias"]
     return y
+
+
+def quantize_params_int8(params: Params) -> Params:
+    """Weight-only int8 quantization for inference (per-output-channel
+    symmetric scales on every layer linear: wq/wk/wv/wo and the dense
+    MLP). Embeddings, the output head, norms and biases stay full
+    precision (they set logit quality); MoE expert banks are left
+    unquantized (they run through einsum, not _linear). Composes with the
+    int8 KV cache: weights AND cache both cross HBM at 1 byte/elem.
+
+    The reference has no weight quantization (its only quant surface is
+    the optional KV cache quant, core/generation_lite.py:75-89)."""
+
+    def quant(w):
+        s = jnp.max(jnp.abs(w), axis=0) / 127.0
+        s = jnp.where(s == 0, 1.0, s).astype(jnp.float32)
+        q = jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)
+        return q, s
+
+    def walk_linear(p):
+        if "weight" not in p or p["weight"].ndim != 2:
+            return dict(p)
+        q, s = quant(p["weight"].astype(jnp.float32))
+        out = {k: v for k, v in p.items() if k != "weight"}
+        out["weight_q"], out["weight_s"] = q, s
+        return out
+
+    out = {k: v for k, v in params.items() if k != "layers"}
+    new_layers = []
+    for layer in params["layers"]:
+        nl = dict(layer)
+        nl["attention"] = {k: walk_linear(v) if isinstance(v, dict) else v
+                           for k, v in layer["attention"].items()}
+        ff = layer["feed_forward"]
+        if "w_gate" in ff:  # dense MLP (expert banks pass through)
+            nl["feed_forward"] = {k: walk_linear(v) if isinstance(v, dict) else v
+                                  for k, v in ff.items()}
+        new_layers.append(nl)
+    out["layers"] = new_layers
+    return out
 
 
 def rope_cos_sin(
@@ -444,7 +491,9 @@ def forward(
             static_argnums=(2, 5, 6),
         )
 
-    cast = partial(jax.tree_util.tree_map, lambda a: a.astype(compute_dtype))
+    # int8 (quantized) leaves must stay int8 through the compute-dtype cast
+    cast = partial(jax.tree_util.tree_map,
+                   lambda a: a if a.dtype == jnp.int8 else a.astype(compute_dtype))
     new_cache = [] if cache is not None else None
     n_remat = int(round(args.num_layers * remat_ratio))
     aux_total = jnp.zeros((), jnp.float32)

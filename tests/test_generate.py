@@ -233,3 +233,46 @@ def test_speculative_stop_tokens_and_stats():
                                         draft_len=8)
     assert stats2["tokens_per_call"] > 1.5, stats2
     assert stats2["verify_calls"] < 40 / 1.5
+
+
+def test_int8_weight_quant_decode():
+    """Weight-only int8 quantization: per-channel error bound holds, the
+    quantized model decodes through the full KV-cache path (composing
+    with int8 KV), and its per-position logprobs stay close to the fp
+    model's."""
+    from mlx_cuda_distributed_pretraining_tpu.models.llama import (
+        quantize_params_int8,
+    )
+
+    qparams = quantize_params_int8(PARAMS)
+    # per-channel symmetric error bound: |w - q*s| <= s/2 elementwise
+    layer = PARAMS["layers"][0]["attention"]["wq"]["weight"]
+    qlayer = qparams["layers"][0]["attention"]["wq"]
+    deq = qlayer["weight_q"].astype(jnp.float32) * qlayer["weight_s"]
+    err = np.abs(np.asarray(layer) - np.asarray(deq))
+    bound = np.asarray(qlayer["weight_s"])[None, :] / 2 + 1e-7
+    assert (err <= bound).all()
+    assert qlayer["weight_q"].dtype == jnp.int8
+
+    prompt = [1, 5, 9, 3, 7, 2]
+    ref, ref_stats = generate_lite(PARAMS, ARGS, prompt, max_tokens=16)
+    out, stats = generate_lite(qparams, ARGS, prompt, max_tokens=16,
+                               kv_quant=True)
+    assert len(out) == 16  # decodes end-to-end
+    # logit quality: mean logprob within a coarse band of the fp model
+    assert abs(stats["mean_logprob"] - ref_stats["mean_logprob"]) < 0.3
+
+
+def test_int8_weight_quant_full_forward_close():
+    from mlx_cuda_distributed_pretraining_tpu.models.llama import (
+        quantize_params_int8,
+    )
+
+    qparams = quantize_params_int8(PARAMS)
+    toks = jnp.asarray([[1, 5, 9, 3, 7, 2, 11, 4]], jnp.int32)
+    ref, _ = llama.forward(PARAMS, toks, ARGS)
+    got, _ = llama.forward(qparams, toks, ARGS)
+    # int8 per-channel on a tiny random model: logits track closely
+    denom = float(jnp.abs(ref).mean()) + 1e-6
+    rel = float(jnp.abs(ref - got).mean()) / denom
+    assert rel < 0.05, rel
