@@ -1,0 +1,135 @@
+"""Inference benchmark CLI: decode throughput over a trained run.
+
+Prices the inference stack's modes against each other on REAL prompts
+from a held-out file — plain greedy decode, prompt-lookup speculative
+decode (must be token-identical to plain), int8 weight-only quantization,
+and their composition — reporting tok/s, speculation acceptance, and
+output agreement. The reference has no inference benchmark tooling (its
+decode numbers were never published; SURVEY.md §6).
+
+Usage:
+    python -m ..tools.benchmark_inference --run NAME --runs-root R \\
+        --prompts val.jsonl [--n-prompts 8] [--max-tokens 128] \\
+        [--modes plain,spec,wq,spec+wq] [--prompt-chars 400]
+
+Prints one JSON object; per-mode progress to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Dict, List
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def load_prompts(path: str, n: int, chars: int) -> List[str]:
+    out: List[str] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                text = json.loads(line).get("text", "")
+            except json.JSONDecodeError:
+                text = line
+            if len(text) >= chars // 2:
+                out.append(text[:chars])
+            if len(out) >= n:
+                break
+    if not out:
+        raise SystemExit(f"no usable prompts in {path}")
+    return out
+
+
+def main(argv=None) -> Dict[str, Any]:
+    ap = argparse.ArgumentParser(description="Decode-throughput benchmark")
+    ap.add_argument("--run", required=True)
+    ap.add_argument("--runs-root", default="runs")
+    ap.add_argument("--prompts", required=True, help="JSONL/text prompt file")
+    ap.add_argument("--n-prompts", type=int, default=8)
+    ap.add_argument("--max-tokens", type=int, default=128)
+    ap.add_argument("--prompt-chars", type=int, default=400)
+    ap.add_argument("--draft-len", type=int, default=8)
+    ap.add_argument("--modes", default="plain,spec,wq,spec+wq")
+    ap.add_argument("--kv-quant", action="store_true")
+    a = ap.parse_args(argv)
+
+    from ..infer.generate import generate_lite, generate_speculative
+    from ..models.llama import quantize_params_int8
+    from ..train.trainer import load_trained
+
+    params, margs, tok, _ = load_trained(a.run, runs_root=a.runs_root)
+    qparams = None
+    texts = load_prompts(a.prompts, a.n_prompts, a.prompt_chars)
+    prompts = [[tok.bos_id] + tok.tokenize(t) for t in texts]
+
+    def run_mode(mode: str) -> Dict[str, Any]:
+        nonlocal qparams
+        spec = "spec" in mode
+        wq = "wq" in mode
+        if wq and qparams is None:
+            qparams = quantize_params_int8(params)
+        p = qparams if wq else params
+        outs: List[List[int]] = []
+        toks = 0
+        calls = 0.0
+        lps: List[float] = []
+        t0 = time.perf_counter()
+        for ids in prompts:
+            if spec:
+                out, stats = generate_speculative(
+                    p, margs, ids, max_tokens=a.max_tokens,
+                    draft_len=a.draft_len, stop_tokens=[tok.eos_id],
+                    kv_quant=a.kv_quant)
+                calls += stats["verify_calls"]
+            else:
+                out, stats = generate_lite(
+                    p, margs, ids, max_tokens=a.max_tokens,
+                    stop_tokens=[tok.eos_id], kv_quant=a.kv_quant)
+            outs.append(out)
+            toks += len(out)
+            lps.append(stats["mean_logprob"])
+        dt = time.perf_counter() - t0
+        r = {
+            "mode": mode, "tok_s": round(toks / dt, 1), "tokens": toks,
+            "wall_s": round(dt, 2),
+            "mean_logprob": round(sum(lps) / len(lps), 4),
+        }
+        if spec:
+            r["tokens_per_verify"] = round(toks / max(calls, 1), 2)
+        log(f"[infbench] {json.dumps(r)}")
+        return r, outs
+
+    results: List[Dict[str, Any]] = []
+    outputs: Dict[str, List[List[int]]] = {}
+    for mode in a.modes.split(","):
+        r, outs = run_mode(mode.strip())
+        results.append(r)
+        outputs[mode.strip()] = outs
+
+    agreement = {}
+    if "plain" in outputs:
+        for mode, outs in outputs.items():
+            if mode == "plain":
+                continue
+            same = sum(o == r for o, r in zip(outs, outputs["plain"]))
+            agreement[f"{mode}_vs_plain_identical"] = f"{same}/{len(outs)}"
+
+    report = {
+        "run": a.run, "n_prompts": len(prompts),
+        "max_tokens": a.max_tokens, "draft_len": a.draft_len,
+        "kv_quant": a.kv_quant, "results": results, "agreement": agreement,
+    }
+    print(json.dumps(report, indent=2))
+    return report
+
+
+if __name__ == "__main__":
+    main()
