@@ -271,3 +271,27 @@ def test_lr_finder_for_optimizer_uses_real_update_rule(tmp_path):
     # ... and the suggestions must be optimizer-specific: if the sweep
     # ignored optimizer_name all three would come out identical.
     assert len(set(out.values())) >= 2, out
+
+
+def test_benchmark_inference_tool(tmp_path):
+    """tools/benchmark_inference: runs all modes on a trained run, reports
+    per-mode tok/s, and certifies speculative outputs identical to plain."""
+    import json
+
+    from mlx_cuda_distributed_pretraining_tpu.tools import benchmark_inference
+
+    cfg = _tiny_config(tmp_path, name="infbench", iters=20)
+    tr = Trainer(cfg, runs_root=str(tmp_path / "runs"), quiet=True)
+    tr.train()
+
+    report = benchmark_inference.main([
+        "--run", "infbench", "--runs-root", str(tmp_path / "runs"),
+        "--prompts", str(tmp_path / "val.jsonl"),
+        "--n-prompts", "2", "--max-tokens", "12", "--prompt-chars", "80",
+    ])
+    modes = {r["mode"]: r for r in report["results"]}
+    assert set(modes) == {"plain", "spec", "wq", "spec+wq"}
+    assert all(r["tok_s"] > 0 for r in report["results"])
+    assert report["agreement"]["spec_vs_plain_identical"] == "2/2"
+    # report is printable JSON
+    json.dumps(report)
