@@ -13,7 +13,8 @@ def main(argv=None) -> str:
     parser.add_argument("--runs-root", default="runs")
     parser.add_argument("--prompt", default="")
     parser.add_argument("--max-tokens", type=int, default=128)
-    parser.add_argument("--temperature", type=float, default=0.7)
+    parser.add_argument("--temperature", type=float, default=None,
+                        help="sampling temperature (default 0.7; 0 = greedy)")
     parser.add_argument("--top-p", type=float, default=0.0)
     parser.add_argument("--min-p", type=float, default=0.0)
     parser.add_argument("--repetition-penalty", type=float, default=None)
@@ -22,9 +23,10 @@ def main(argv=None) -> str:
     parser.add_argument("--kv-quant", action="store_true",
                         help="int8-quantized KV cache (less HBM per token)")
     parser.add_argument("--speculative", action="store_true",
-                        help="greedy decode with prompt-lookup speculation "
-                             "(bit-identical output, >1 token per device "
-                             "step on repetitive stretches)")
+                        help="prompt-lookup speculation: greedy (bit-"
+                             "identical to plain decode) unless "
+                             "--temperature is given, then exact "
+                             "rejection-sampled temperature sampling")
     parser.add_argument("--draft-len", type=int, default=8,
                         help="speculative: drafted tokens per verify step")
     parser.add_argument("--weight-quant", action="store_true",
@@ -39,7 +41,12 @@ def main(argv=None) -> str:
         parser.error("--kv-quant is not supported with --beams (beam search "
                      "uses the fp32 cache)")
     if args.speculative and args.beams > 0:
-        parser.error("--speculative is greedy decoding; drop --beams")
+        parser.error("--speculative cannot combine with --beams")
+    if args.speculative and (args.top_p or args.min_p
+                             or args.repetition_penalty):
+        parser.error("--speculative supports greedy or pure-temperature "
+                     "sampling only; drop --top-p/--min-p/"
+                     "--repetition-penalty")
     params, margs, tok, _ = load_trained(args.run, runs_root=args.runs_root)
     if args.weight_quant:
         from ..models.llama import quantize_params_int8
@@ -53,6 +60,9 @@ def main(argv=None) -> str:
             params, margs, ids, max_tokens=args.max_tokens,
             draft_len=args.draft_len, stop_tokens=[tok.eos_id],
             kv_quant=args.kv_quant,
+            # greedy unless the user EXPLICITLY asked for sampling
+            temperature=args.temperature or 0.0,
+            seed=args.seed,
         )
         text = tok.detokenize(out)
         print(f"[{stats['generation_tps']:.1f} tok/s, "
@@ -67,7 +77,8 @@ def main(argv=None) -> str:
         return text
     text = generate_text(
         params, margs, tok, args.prompt,
-        max_new_tokens=args.max_tokens, temperature=args.temperature,
+        max_new_tokens=args.max_tokens,
+        temperature=0.7 if args.temperature is None else args.temperature,
         top_p=args.top_p, min_p=args.min_p,
         repetition_penalty=args.repetition_penalty, seed=args.seed,
         kv_quant=args.kv_quant,

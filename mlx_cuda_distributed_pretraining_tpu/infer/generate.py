@@ -211,6 +211,55 @@ def _verify_step(args: llama.LlamaArgs, chunk: int, attend_len: int):
     return step
 
 
+def _spec_accept_one(key, probs_row, draft):
+    """One position of point-mass-proposal speculative sampling.
+
+    Accept the (deterministic) draft with probability p(draft); otherwise
+    pre-sample the fallback from the residual p with the draft's mass
+    removed. Emitting ``draft if accept else alt`` is distributed exactly
+    as p — the standard rejection-sampling identity with q = delta(draft)
+    (distribution-level test in test_generate.py)."""
+    ku, kr = jax.random.split(key)
+    accept = jax.random.uniform(ku) < probs_row[draft]
+    residual = probs_row * (1.0 - jax.nn.one_hot(draft, probs_row.shape[-1],
+                                                 dtype=probs_row.dtype))
+    alt = jax.random.categorical(kr, jnp.log(residual + 1e-30))
+    return accept, alt.astype(jnp.int32)
+
+
+def _verify_step_sampled(args: llama.LlamaArgs, chunk: int, attend_len: int,
+                         temperature: float):
+    """Speculative verify for SAMPLING: per position, accept the draft
+    with probability p(draft) and pre-sample the residual fallback —
+    point-mass-proposal rejection sampling, which preserves the exact
+    temperature-T sampling distribution (the draft is deterministic, so
+    q = delta(draft): accept w.p. min(1, p/q)(d) = p(d); on reject,
+    sample from (p - min(p, q))/Z = p with the draft's mass removed)."""
+    key_ = ("verify_sampled", args, chunk, attend_len, temperature)
+    if key_ in _STEP_CACHE:
+        return _STEP_CACHE[key_]
+
+    @jax.jit
+    def step(params, cache, toks, pos, rng):
+        logits, cache = llama.forward(params, toks, args, cache=cache,
+                                      start_pos=pos, attend_len=attend_len)
+        probs = jax.nn.softmax(logits[0] / temperature, axis=-1)  # [chunk, V]
+        lp = jnp.log(probs + 1e-30)
+        k = chunk - 1
+        drafts = toks[0, 1:]  # [k]
+        keys = jax.random.split(rng, k + 1)
+        accept, alts = jax.vmap(_spec_accept_one)(keys[:k], probs[:k], drafts)
+        bonus = jax.random.categorical(keys[k], lp[k])
+        gather = lambda rows, idx: jnp.take_along_axis(
+            rows, idx[:, None], axis=-1)[:, 0]
+        return (cache, accept, gather(lp[:k], drafts),
+                alts.astype(jnp.int32), gather(lp[:k], alts),
+                bonus.astype(jnp.int32), lp[k, bonus])
+
+    _STEP_CACHE[key_] = step
+    return step
+
+
 def _prompt_lookup_draft(seq: List[int], k: int, max_ngram: int,
                          window: int = 2048) -> List[int]:
     """Draft k tokens by prompt lookup: find the most recent earlier
@@ -240,8 +289,10 @@ def generate_speculative(
     stop_tokens: Optional[Sequence[int]] = None,
     prefill_step_size: int = 512,
     kv_quant: bool = False,
+    temperature: float = 0.0,
+    seed: int = 0,
 ) -> Tuple[List[int], Dict[str, float]]:
-    """Greedy decoding with prompt-lookup speculation (self-drafting).
+    """Decoding with prompt-lookup speculation (self-drafting).
 
     Capability the reference does not have (its decode is strictly
     one-token-at-a-time: core/generation_lite.py:158-175). Each iteration
@@ -251,6 +302,13 @@ def generate_speculative(
     like plain decode. Output is bit-identical to greedy ``generate_lite``
     (the draft only ever *proposes*; every emitted token is the model's
     own argmax — see test_generate.py equivalence test).
+
+    ``temperature > 0`` switches to EXACT speculative sampling: the
+    deterministic draft is a point-mass proposal, so accepting draft d
+    with probability p(d) and otherwise resampling from p with d's mass
+    removed preserves the temperature-T sampling distribution precisely
+    (distribution-level test in test_generate.py). The bonus position
+    samples from p directly.
 
     Cache-safety of partial acceptance: a verify forward writes all
     ``draft_len + 1`` KV entries, but ``pos`` is rewound to the accepted
@@ -275,8 +333,16 @@ def generate_speculative(
                                  prefill_step_size, kv_quant=kv_quant)
 
     seq: List[int] = [int(t) for t in prompt_tokens]
-    first = int(np.argmax(np.asarray(last_logits[0])))
-    lp_first = float(jax.nn.log_softmax(last_logits, axis=-1)[0, first])
+    sampled = temperature > 0.0
+    rng = jax.random.PRNGKey(seed)
+    if sampled:
+        rng, sub = jax.random.split(rng)
+        first = int(jax.random.categorical(sub, last_logits[0] / temperature))
+        lp_first = float(jax.nn.log_softmax(
+            last_logits / temperature, axis=-1)[0, first])
+    else:
+        first = int(np.argmax(np.asarray(last_logits[0])))
+        lp_first = float(jax.nn.log_softmax(last_logits, axis=-1)[0, first])
     out: List[int] = [first]
     logprobs: List[float] = [lp_first]
     seq.append(first)
@@ -287,16 +353,38 @@ def generate_speculative(
         drafts = _prompt_lookup_draft(seq, k, max_ngram)
         toks = jnp.asarray([[seq[-1]] + drafts], jnp.int32)  # [1, k+1]
         bucket = _attend_bucket(pos + k + 1, cache_len)
-        step = _verify_step(args, k + 1, bucket)
-        cache, preds, lp = step(params, cache, toks, jnp.asarray(pos, jnp.int32))
-        preds_h = np.asarray(preds)
-        lp_h = np.asarray(lp)
-        calls += 1
+        if sampled:
+            rng, sub = jax.random.split(rng)
+            step = _verify_step_sampled(args, k + 1, bucket, temperature)
+            out_dev = step(params, cache, toks,
+                           jnp.asarray(pos, jnp.int32), sub)
+            cache = out_dev[0]
+            # ONE blocking transfer for all the small outputs (the greedy
+            # path pays two; per-field np.asarray would pay five).
+            (accept_h, lp_draft, alts_h, lp_alt,
+             bonus_h, lp_bonus) = jax.device_get(out_dev[1:])
+            m = 0
+            while m < k and accept_h[m]:
+                m += 1
+            if m < k:
+                emitted = drafts[:m] + [int(alts_h[m])]
+                lp_h = np.concatenate([lp_draft[:m], [float(lp_alt[m])]])
+            else:
+                emitted = drafts[:k] + [int(bonus_h)]
+                lp_h = np.concatenate([lp_draft, [float(lp_bonus)]])
+            calls += 1
+        else:
+            step = _verify_step(args, k + 1, bucket)
+            cache, preds, lp = step(params, cache, toks,
+                                    jnp.asarray(pos, jnp.int32))
+            preds_h = np.asarray(preds)
+            lp_h = np.asarray(lp)
+            calls += 1
 
-        m = 0
-        while m < k and drafts[m] == int(preds_h[m]):
-            m += 1
-        emitted = drafts[:m] + [int(preds_h[m])]  # m accepted + 1 bonus
+            m = 0
+            while m < k and drafts[m] == int(preds_h[m]):
+                m += 1
+            emitted = drafts[:m] + [int(preds_h[m])]  # m accepted + 1 bonus
         for i, t in enumerate(emitted):
             if len(out) >= max_tokens:
                 break
