@@ -266,6 +266,14 @@ def bench_train_case(name, scale_key, attn, vocab, steps, fused_ce=True,
     tok_s = toks / dt
     ft = flops_per_token(n_params, args.num_layers, seq,
                          args.num_heads * args.head_dim)
+    hbm_peak_gb = None
+    try:  # self-documenting fit analysis (1b cases ride the HBM edge)
+        stats = jax.local_devices()[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use") or stats.get("bytes_in_use")
+        if peak:
+            hbm_peak_gb = round(peak / 2**30, 2)
+    except Exception:  # noqa: BLE001 - tunnel-dependent introspection
+        pass
     return {
         "case": name, "params_m": round(n_params / 1e6, 1), "attn": attn,
         "optimizer": optimizer, "scan_layers": scan,
@@ -275,6 +283,7 @@ def bench_train_case(name, scale_key, attn, vocab, steps, fused_ce=True,
         "step_ms": round(1000 * dt / steps, 1),
         "mfu": round(ft * tok_s / V5E_PEAK_FLOPS, 4),
         "final_loss": round(final_loss, 3),
+        "hbm_peak_gb": hbm_peak_gb,
     }
 
 
