@@ -57,7 +57,9 @@ def main(argv=None) -> Dict[str, Any]:
     ap.add_argument("--max-tokens", type=int, default=128)
     ap.add_argument("--prompt-chars", type=int, default=400)
     ap.add_argument("--draft-len", type=int, default=8)
-    ap.add_argument("--modes", default="plain,spec,wq,spec+wq")
+    ap.add_argument("--modes", default="plain,spec,wq,spec+wq",
+                    help="comma list; 'spec-t<T>' runs exact speculative "
+                         "SAMPLING at temperature T (e.g. spec-t0.8)")
     ap.add_argument("--kv-quant", action="store_true")
     a = ap.parse_args(argv)
 
@@ -73,6 +75,9 @@ def main(argv=None) -> Dict[str, Any]:
     def run_mode(mode: str) -> Dict[str, Any]:
         nonlocal qparams
         spec = "spec" in mode
+        temp = 0.0
+        if "spec-t" in mode:
+            temp = float(mode.split("spec-t")[1].split("+")[0])
         wq = "wq" in mode
         if wq and qparams is None:
             qparams = quantize_params_int8(params)
@@ -87,7 +92,7 @@ def main(argv=None) -> Dict[str, Any]:
                 out, stats = generate_speculative(
                     p, margs, ids, max_tokens=a.max_tokens,
                     draft_len=a.draft_len, stop_tokens=[tok.eos_id],
-                    kv_quant=a.kv_quant)
+                    kv_quant=a.kv_quant, temperature=temp)
                 calls += stats["verify_calls"]
             else:
                 out, stats = generate_lite(
