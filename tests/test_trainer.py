@@ -295,3 +295,20 @@ def test_benchmark_inference_tool(tmp_path):
     assert report["agreement"]["spec_vs_plain_identical"] == "2/2"
     # report is printable JSON
     json.dumps(report)
+
+
+def test_adafactor_checkpoint_resume(tmp_path):
+    """Adafactor's factored state (row/col vectors + (1,) placeholders)
+    round-trips through save/resume."""
+    cfg = _tiny_config(tmp_path, name="af", iters=10,
+                       **{"training.optimization.optimizer": "adafactor"})
+    Trainer(cfg, runs_root=str(tmp_path / "runs"), quiet=True).train()
+    d = cfg.to_dict()
+    d["overwrite"] = False
+    d["resume"] = {"checkpoint": "final"}
+    d["training"]["hyperparameters"]["iters"] = 15
+    tr = Trainer(Config.from_dict(d), runs_root=str(tmp_path / "runs"),
+                 quiet=True)
+    assert tr.start_step == 10
+    result = tr.train()
+    assert result["steps"] == 15 and np.isfinite(result["final_loss"])
