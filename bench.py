@@ -137,6 +137,31 @@ def elapsed() -> float:
     return time.monotonic() - _T_START
 
 
+def build_doc(matrix, device, vocab, reason, elapsed_s=None):
+    """The stdout-contract document. Shared with
+    scripts/merge_bench_outputs.py so self-captured artifacts merged from
+    ``--one`` runs keep exactly this schema."""
+    flash_2m = next((r for r in matrix if r.get("case") == "2m_flash" and r.get("tok_s")), None)
+    best_mfu = max((r.get("mfu", 0.0) or 0.0 for r in matrix), default=0.0)
+    headline = flash_2m or next((r for r in matrix if r.get("tok_s")), {"case": "none", "tok_s": 0})
+    # vs_baseline (M3-Max 2M anchor) only makes sense for the 2M case.
+    vs = round(headline["tok_s"] / BASELINE_TOKS_PER_SEC, 3) if headline is flash_2m else None
+    doc = {
+        "metric": f"pretrain_tokens_per_sec_per_chip_llama_{headline['case']}"
+                  f"_vocab{vocab}",
+        "value": headline.get("tok_s", 0),
+        "unit": "tok/s",
+        "vs_baseline": vs,
+        "device": device,
+        "best_mfu": best_mfu,
+        "emit_reason": reason,
+        "matrix": matrix,
+    }
+    if elapsed_s is not None:
+        doc["bench_elapsed_s"] = round(elapsed_s, 1)
+    return doc
+
+
 def emit(reason: str = "final") -> None:
     """Print the one-line stdout contract exactly once, from wherever we
     are — normal exit, atexit, or a termination signal."""
@@ -144,23 +169,8 @@ def emit(reason: str = "final") -> None:
     if _EMITTED:
         return
     _EMITTED = True
-    flash_2m = next((r for r in _MATRIX if r.get("case") == "2m_flash" and r.get("tok_s")), None)
-    best_mfu = max((r.get("mfu", 0.0) or 0.0 for r in _MATRIX), default=0.0)
-    headline = flash_2m or next((r for r in _MATRIX if r.get("tok_s")), {"case": "none", "tok_s": 0})
-    # vs_baseline (M3-Max 2M anchor) only makes sense for the 2M case.
-    vs = round(headline["tok_s"] / BASELINE_TOKS_PER_SEC, 3) if headline is flash_2m else None
-    print(json.dumps({
-        "metric": f"pretrain_tokens_per_sec_per_chip_llama_{headline['case']}"
-                  f"_vocab{_VOCAB}",
-        "value": headline.get("tok_s", 0),
-        "unit": "tok/s",
-        "vs_baseline": vs,
-        "device": _DEVICE,
-        "best_mfu": best_mfu,
-        "emit_reason": reason,
-        "bench_elapsed_s": round(elapsed(), 1),
-        "matrix": _MATRIX,
-    }), flush=True)
+    print(json.dumps(build_doc(_MATRIX, _DEVICE, _VOCAB, reason,
+                               elapsed_s=elapsed())), flush=True)
 
 
 _ACTIVE_CHILD = None  # Popen of the in-flight --one case, if any
