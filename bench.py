@@ -113,6 +113,10 @@ SCALES["40m_s8k"] = dict(SCALES["40m"], batch=8, seq=8192, remat="dots")
 # ~11.5 GB (AdamW fp32 master+m+v) to ~3.9 GB (master + row/col factors),
 # buying 2x batch at the same HBM (optim/adafactor.py).
 SCALES["1b_bs8"] = dict(SCALES["1b"], batch=8)
+# Batch ladder at 400m: AdamW state (~5.2 GB fp32 master+m+v at 430M) and
+# dots-remat activations leave room to try bs32 — double arithmetic
+# intensity per optimizer step if it fits (hbm_peak_gb documents the edge).
+SCALES["400m_bs32"] = dict(SCALES["400m"], batch=32)
 
 # Decode timing chains DECODE_CHAIN greedy steps (two-point difference vs a
 # 32-step chain); the attend-bucket guard in bench_decode_case must cover
@@ -220,6 +224,11 @@ def bench_train_case(name, scale_key, attn, vocab, steps, fused_ce=True,
 
     sc = SCALES[scale_key]
     batch, seq, remat = sc["batch"], sc["seq"], sc["remat"]
+    # BENCH_REMAT overrides the per-scale policy for on-chip sweeps
+    # ("none" clears it; "full"/"dots" select a policy).
+    env_remat = os.environ.get("BENCH_REMAT")
+    if env_remat is not None:
+        remat = None if env_remat in ("none", "") else env_remat
     args = llama.LlamaArgs(
         vocab_size=vocab, max_position_embeddings=seq,
         attention_type=attn, **sc["shape"],
@@ -553,6 +562,9 @@ def build_plan(vocab, steps):
         ("100m_bs64_remat", "100m",
          lambda: bench_train_case("100m_bs64_remat", "100m_bs64", "flash",
                                   vocab, steps), 150),
+        ("400m_bs32", "400m",
+         lambda: bench_train_case("400m_bs32", "400m_bs32", "flash", vocab,
+                                  steps), 300),
         ("2m_simple", "simple",
          lambda: bench_train_case("2m_simple", "2m", "simple", vocab, steps), 90),
         # flash-vs-simple at 40m compares at the SAME bs16 shape (simple's

@@ -1,0 +1,143 @@
+"""Full-step knob sweep for a bench case: run `bench.py --one CASE` under
+combinations of the bench env knobs and report each as a matrix row.
+
+The kernel-level sweep (scripts/bench_attention.py) picked the flash
+defaults; this sweeps knobs in the context of the FULL train step at a
+real scale — where the MFU actually lives (VERDICT r3 item 2):
+
+  FLASH_BLOCK_Q / FLASH_BLOCK_KV   flash kernel tiling
+  BENCH_CE_CHUNK                   fused-CE rows per chunk
+  BENCH_SCAN_LAYERS                lax.scan stack vs unrolled layers
+  BENCH_REMAT                      remat policy (none/dots/full)
+
+Each combo runs in its own subprocess (a hung remote compile can only be
+SIGKILLed) and prints a ``BENCHCASE`` line whose case id carries the combo
+(e.g. ``400m_flash@SCAN=0``), so scripts/merge_bench_outputs.py folds
+sweep points into the same artifact as the main matrix. Ordered
+best-guess-first: a window that fits only two combos still answers the
+biggest questions. Exit code 0 = every combo produced a row.
+
+    python scripts/bench_sweep.py --case 400m_flash [--steps 10]
+        [--timeout 600] [--combo FLASH_BLOCK_Q=512,FLASH_BLOCK_KV=1024]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CASE_MARK = "BENCHCASE "
+
+# Short labels keep the merged case ids readable.
+_SHORT = {
+    "FLASH_BLOCK_Q": "BQ",
+    "FLASH_BLOCK_KV": "BKV",
+    "BENCH_CE_CHUNK": "CE",
+    "BENCH_SCAN_LAYERS": "SCAN",
+    "BENCH_REMAT": "REMAT",
+}
+
+DEFAULT_COMBOS = {
+    "400m_flash": [
+        {"BENCH_SCAN_LAYERS": "0"},
+        {"FLASH_BLOCK_Q": "512", "FLASH_BLOCK_KV": "1024"},
+        {"FLASH_BLOCK_Q": "512", "FLASH_BLOCK_KV": "512"},
+        {"BENCH_CE_CHUNK": "4096"},
+        {"BENCH_CE_CHUNK": "1024"},
+        {"FLASH_BLOCK_Q": "1024", "FLASH_BLOCK_KV": "1024"},
+        {"FLASH_BLOCK_Q": "256", "FLASH_BLOCK_KV": "1024"},
+    ],
+    "100m_flash": [
+        {"BENCH_SCAN_LAYERS": "1"},
+        {"FLASH_BLOCK_Q": "512", "FLASH_BLOCK_KV": "1024"},
+        {"BENCH_CE_CHUNK": "4096"},
+        {"BENCH_REMAT": "dots"},
+    ],
+}
+
+
+def parse_combo(text):
+    combo = {}
+    for part in text.split(","):
+        k, _, v = part.partition("=")
+        combo[k.strip()] = v.strip()
+    return combo
+
+
+def combo_label(combo):
+    return ",".join(f"{_SHORT.get(k, k)}={v}" for k, v in sorted(combo.items()))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--case", required=True)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--timeout", type=int, default=600)
+    ap.add_argument("--combo", action="append", default=[],
+                    help="K=V[,K=V...] (repeatable; default: built-in list)")
+    ap.add_argument("--skip-done", default=None,
+                    help="out-file from a previous attempt: combos whose "
+                         "case id already has a row there are not re-run, "
+                         "so a retried sweep resumes instead of restarting")
+    a = ap.parse_args()
+
+    combos = ([parse_combo(c) for c in a.combo]
+              or DEFAULT_COMBOS.get(a.case))
+    if not combos:
+        sys.exit(f"no default combos for case {a.case!r}; pass --combo")
+
+    already = set()
+    if a.skip_done and os.path.exists(a.skip_done):
+        with open(a.skip_done) as f:
+            for ln in f:
+                if ln.startswith(CASE_MARK):
+                    try:
+                        already.add(json.loads(ln[len(CASE_MARK):])["case"])
+                    except (json.JSONDecodeError, KeyError):
+                        pass
+
+    failures = 0
+    for combo in combos:
+        label = combo_label(combo)
+        if f"{a.case}@{label}" in already:
+            print(f"[sweep] {label}: already captured, skipping",
+                  file=sys.stderr)
+            continue
+        env = dict(os.environ, BENCH_STEPS=str(a.steps), **combo)
+        t0 = time.perf_counter()
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(REPO, "bench.py"), "--one", a.case],
+                env=env, capture_output=True, text=True, timeout=a.timeout)
+        except subprocess.TimeoutExpired:
+            print(f"[sweep] {label}: TIMEOUT after {a.timeout}s", file=sys.stderr)
+            failures += 1
+            continue
+        line = next((ln for ln in proc.stdout.splitlines()
+                     if ln.startswith(CASE_MARK)), None)
+        if line is None:
+            print(f"[sweep] {label}: no result (rc={proc.returncode}) "
+                  f"{proc.stderr[-200:]}", file=sys.stderr)
+            failures += 1
+            continue
+        try:
+            row = json.loads(line[len(CASE_MARK):])
+        except json.JSONDecodeError:
+            print(f"[sweep] {label}: truncated result line", file=sys.stderr)
+            failures += 1
+            continue
+        row["case"] = f"{a.case}@{label}"
+        row["sweep_combo"] = combo
+        print(CASE_MARK + json.dumps(row), flush=True)
+        print(f"[sweep] {label}: tok_s={row.get('tok_s')} mfu={row.get('mfu')}"
+              f" ({time.perf_counter() - t0:.0f}s)", file=sys.stderr)
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

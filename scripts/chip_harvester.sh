@@ -46,6 +46,8 @@ JOBS=(
   "train40m 1600"
   "one_1b_adafactor 1000"
   "breakdown_400m 1000"
+  "sweep_400m 4400"
+  "one_400m_bs32 900"
   "one_1b_lion 1000"
   "one_40m_flash_s8k 500"
   "one_100m_muon 450"
@@ -63,20 +65,32 @@ probe() { timeout -k 10 80 python bench.py --probe >/dev/null 2>&1; }
 
 nfail() { if [ -f "$BASE/fail/$1" ]; then wc -l < "$BASE/fail/$1"; else echo 0; fi; }
 
-run_one() { # id timeout cmd...
+run_one() { # [-strict] id timeout cmd...
+  # Default success: a BENCHCASE result line that is NOT a SIGTERM-
+  # truncated measurement (the Trainer consumes timeout's SIGTERM and
+  # still prints a line with "preempted": true — partial data, retry in a
+  # better window). With -strict (multi-row jobs like sweeps): rc==0 only,
+  # so a partial run retries — its captured rows survive via append-mode.
+  local strict=0
+  [ "$1" = "-strict" ] && { strict=1; shift; }
   local id=$1 t=$2; shift 2
-  echo "$(stamp) START $id (timeout ${t}s)" >> "$LOG"
+  echo "$(stamp) START $id (timeout ${t}s strict=$strict)" >> "$LOG"
   # Append across retries: a partial first attempt (e.g. 5 of 6 breakdown
   # lines before a tunnel death) is captured data, not garbage.
   timeout -k 15 "$t" "$@" >> "$BASE/out/$id.out" 2>> "$BASE/out/$id.err"
   local rc=$?
-  # Success = a result line that is NOT a SIGTERM-truncated measurement:
-  # the Trainer consumes timeout's SIGTERM and still prints a BENCHCASE
-  # line with "preempted": true — partial data, retry in a better window.
-  local last
-  last=$(grep '^BENCHCASE ' "$BASE/out/$id.out" 2>/dev/null | tail -1)
-  if { [ -n "$last" ] && ! printf '%s' "$last" | grep -q '"preempted": true'; } \
-      || { [ -z "$last" ] && [ $rc -eq 0 ]; }; then
+  local ok=0
+  if [ "$strict" = 1 ]; then
+    [ $rc -eq 0 ] && ok=1
+  else
+    local last
+    last=$(grep '^BENCHCASE ' "$BASE/out/$id.out" 2>/dev/null | tail -1)
+    if { [ -n "$last" ] && ! printf '%s' "$last" | grep -q '"preempted": true'; } \
+        || { [ -z "$last" ] && [ $rc -eq 0 ]; }; then
+      ok=1
+    fi
+  fi
+  if [ "$ok" = 1 ]; then
     touch "$BASE/done/$id"; echo "$(stamp) DONE $id rc=$rc" >> "$LOG"; return 0
   fi
   # Only count a failure against the job if the tunnel is still up: a
@@ -137,6 +151,9 @@ while :; do
     case $id in
       train40m) train40m "$t" ;;
       breakdown_*) run_one "$id" "$t" python scripts/bench_breakdown.py --scale "${id#breakdown_}" ;;
+      sweep_*) run_one -strict "$id" "$t" python scripts/bench_sweep.py \
+                 --case "${id#sweep_}_flash" --timeout 600 \
+                 --skip-done "$BASE/out/$id.out" ;;
       one_*) run_one "$id" "$t" python bench.py --one "${id#one_}" ;;
       *) echo "$(stamp) UNKNOWN job $id" >> "$LOG"; echo x >> "$BASE/fail/$id" ;;
     esac
