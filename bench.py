@@ -272,14 +272,41 @@ def bench_train_case(name, scale_key, attn, vocab, steps, fused_ce=True,
         "mask": jnp.ones((batch, seq), jnp.float32),
     }
 
-    state, metrics = step(state, b)  # compile + warm
-    float(metrics["loss"])
+    # BENCH_MEGASTEP=K compiles K train steps into ONE dispatch via
+    # lax.scan: through the axon tunnel every dispatch pays ~70-200ms RTT
+    # (the 2m case measures ~11ms of compute inside a ~195ms step), so the
+    # per-step loop measures tunnel overhead, not chip capability. The
+    # megastep number is the chip's true sustained rate — what a locally
+    # attached host (or a longer scan) would see.
+    mega = int(os.environ.get("BENCH_MEGASTEP", "0"))
+    if mega > 1:
+        def _mega(st):
+            def body(s, _):
+                s2, m = step(s, b)
+                return s2, m["loss"]
+            st2, losses = jax.lax.scan(body, st, None, length=mega)
+            return st2, losses[-1]
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step(state, b)
-    final_loss = float(metrics["loss"])  # host fetch syncs the whole chain
-    dt = time.perf_counter() - t0
+        mega_fn = jax.jit(_mega, donate_argnums=0)
+        n_disp = max(1, steps // mega)
+
+        state, last_loss = mega_fn(state)  # compile + warm
+        float(last_loss)
+        t0 = time.perf_counter()
+        for _ in range(n_disp):
+            state, last_loss = mega_fn(state)
+        final_loss = float(last_loss)  # host fetch syncs the chain
+        dt = time.perf_counter() - t0
+        steps = n_disp * mega
+    else:
+        state, metrics = step(state, b)  # compile + warm
+        float(metrics["loss"])
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, b)
+        final_loss = float(metrics["loss"])  # host fetch syncs the whole chain
+        dt = time.perf_counter() - t0
 
     toks = steps * batch * seq
     tok_s = toks / dt
@@ -303,6 +330,7 @@ def bench_train_case(name, scale_key, attn, vocab, steps, fused_ce=True,
         "mfu": round(ft * tok_s / V5E_PEAK_FLOPS, 4),
         "final_loss": round(final_loss, 3),
         "hbm_peak_gb": hbm_peak_gb,
+        **({"megastep": mega} if mega > 1 else {}),
     }
 
 

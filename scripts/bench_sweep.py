@@ -26,6 +26,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -40,23 +41,32 @@ _SHORT = {
     "BENCH_CE_CHUNK": "CE",
     "BENCH_SCAN_LAYERS": "SCAN",
     "BENCH_REMAT": "REMAT",
+    "BENCH_MEGASTEP": "MEGA",
 }
 
+# Megastep-first: BENCH_MEGASTEP compiles K steps into one dispatch, so
+# the first combo separates tunnel dispatch overhead from chip compute —
+# THE open MFU question — and later combos measure their knob on top of
+# megastep so tunnel noise can't mask a small kernel-level win.
 DEFAULT_COMBOS = {
+    "2m_flash": [
+        {"BENCH_MEGASTEP": "20"},
+    ],
     "400m_flash": [
-        {"BENCH_SCAN_LAYERS": "0"},
-        {"FLASH_BLOCK_Q": "512", "FLASH_BLOCK_KV": "1024"},
-        {"FLASH_BLOCK_Q": "512", "FLASH_BLOCK_KV": "512"},
-        {"BENCH_CE_CHUNK": "4096"},
-        {"BENCH_CE_CHUNK": "1024"},
-        {"FLASH_BLOCK_Q": "1024", "FLASH_BLOCK_KV": "1024"},
-        {"FLASH_BLOCK_Q": "256", "FLASH_BLOCK_KV": "1024"},
+        {"BENCH_MEGASTEP": "10"},
+        {"BENCH_MEGASTEP": "10", "BENCH_SCAN_LAYERS": "0"},
+        {"BENCH_MEGASTEP": "10", "FLASH_BLOCK_Q": "512", "FLASH_BLOCK_KV": "1024"},
+        {"BENCH_MEGASTEP": "10", "FLASH_BLOCK_Q": "512", "FLASH_BLOCK_KV": "512"},
+        {"BENCH_MEGASTEP": "10", "BENCH_CE_CHUNK": "4096"},
+        {"BENCH_MEGASTEP": "10", "BENCH_CE_CHUNK": "1024"},
+        {"BENCH_MEGASTEP": "10", "FLASH_BLOCK_Q": "1024", "FLASH_BLOCK_KV": "1024"},
     ],
     "100m_flash": [
-        {"BENCH_SCAN_LAYERS": "1"},
-        {"FLASH_BLOCK_Q": "512", "FLASH_BLOCK_KV": "1024"},
-        {"BENCH_CE_CHUNK": "4096"},
-        {"BENCH_REMAT": "dots"},
+        {"BENCH_MEGASTEP": "10"},
+        {"BENCH_MEGASTEP": "10", "BENCH_SCAN_LAYERS": "1"},
+        {"BENCH_MEGASTEP": "10", "FLASH_BLOCK_Q": "512", "FLASH_BLOCK_KV": "1024"},
+        {"BENCH_MEGASTEP": "10", "BENCH_CE_CHUNK": "4096"},
+        {"BENCH_MEGASTEP": "10", "BENCH_REMAT": "dots"},
     ],
 }
 
@@ -73,7 +83,22 @@ def combo_label(combo):
     return ",".join(f"{_SHORT.get(k, k)}={v}" for k, v in sorted(combo.items()))
 
 
+_child = None
+
+
+def _on_term(signum, frame):  # noqa: ARG001
+    """The harvester's outer `timeout` SIGTERMs only this process; without
+    this handler the in-flight bench.py child would be orphaned still
+    holding the TPU tunnel (hung remote compiles block in C and need
+    SIGKILL), starving every later job in the session."""
+    if _child is not None and _child.poll() is None:
+        _child.kill()
+    sys.exit(143)
+
+
 def main():
+    global _child
+    signal.signal(signal.SIGTERM, _on_term)
     ap = argparse.ArgumentParser()
     ap.add_argument("--case", required=True)
     ap.add_argument("--steps", type=int, default=10)
@@ -108,21 +133,28 @@ def main():
             print(f"[sweep] {label}: already captured, skipping",
                   file=sys.stderr)
             continue
-        env = dict(os.environ, BENCH_STEPS=str(a.steps), **combo)
+        # combo values win over --steps so BENCH_STEPS can itself be swept.
+        env = {**os.environ, "BENCH_STEPS": str(a.steps), **combo}
         t0 = time.perf_counter()
+        _child = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--one", a.case],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
         try:
-            proc = subprocess.run(
-                [sys.executable, os.path.join(REPO, "bench.py"), "--one", a.case],
-                env=env, capture_output=True, text=True, timeout=a.timeout)
+            out, err = _child.communicate(timeout=a.timeout)
+            rc = _child.returncode
         except subprocess.TimeoutExpired:
+            _child.kill()
+            _child.communicate()
             print(f"[sweep] {label}: TIMEOUT after {a.timeout}s", file=sys.stderr)
             failures += 1
             continue
-        line = next((ln for ln in proc.stdout.splitlines()
+        finally:
+            _child = None
+        line = next((ln for ln in out.splitlines()
                      if ln.startswith(CASE_MARK)), None)
         if line is None:
-            print(f"[sweep] {label}: no result (rc={proc.returncode}) "
-                  f"{proc.stderr[-200:]}", file=sys.stderr)
+            print(f"[sweep] {label}: no result (rc={rc}) "
+                  f"{err[-200:]}", file=sys.stderr)
             failures += 1
             continue
         try:

@@ -38,7 +38,9 @@ cd "$REPO"
 JOBS=(
   "one_40m_flash 420"
   "one_400m_flash 700"
+  "sweep_2m 800"
   "breakdown_100m 700"
+  "sweep_100m 2800"
   "one_trainer 700"
   "one_decode_100m 450"
   "one_decode_100m_16k_int8 560"
@@ -75,6 +77,8 @@ run_one() { # [-strict] id timeout cmd...
   [ "$1" = "-strict" ] && { strict=1; shift; }
   local id=$1 t=$2; shift 2
   echo "$(stamp) START $id (timeout ${t}s strict=$strict)" >> "$LOG"
+  local rows_before
+  rows_before=$(grep -c '^BENCHCASE ' "$BASE/out/$id.out" 2>/dev/null || echo 0)
   # Append across retries: a partial first attempt (e.g. 5 of 6 breakdown
   # lines before a tunnel death) is captured data, not garbage.
   timeout -k 15 "$t" "$@" >> "$BASE/out/$id.out" 2>> "$BASE/out/$id.err"
@@ -82,6 +86,17 @@ run_one() { # [-strict] id timeout cmd...
   local ok=0
   if [ "$strict" = 1 ]; then
     [ $rc -eq 0 ] && ok=1
+    # An incomplete attempt that still captured NEW rows is progress
+    # (--skip-done resumes where it left off) — don't count it toward
+    # quarantine, mirroring train40m's new-checkpoint rule.
+    if [ "$ok" = 0 ]; then
+      local rows_after
+      rows_after=$(grep -c '^BENCHCASE ' "$BASE/out/$id.out" 2>/dev/null || echo 0)
+      if [ "$rows_after" -gt "$rows_before" ]; then
+        echo "$(stamp) PROGRESS $id rc=$rc ($rows_before -> $rows_after rows)" >> "$LOG"
+        return 1
+      fi
+    fi
   else
     local last
     last=$(grep '^BENCHCASE ' "$BASE/out/$id.out" 2>/dev/null | tail -1)
