@@ -246,6 +246,15 @@ class SystemConfig:
     # (remote-)compile-time saver at 400M-1B. Training path only; under
     # pipeline parallelism pp stacks layers itself.
     scan_layers: bool = False
+    # Train K steps per device dispatch (lax.scan over the jitted step,
+    # batches stacked [K, B, L]). Each dispatch pays a fixed host->device
+    # latency — ~70-200ms through a remote/tunneled chip, where K=8 is a
+    # multi-x wall-clock win; ~0 for a locally attached chip. Checkpoints,
+    # validation, and profiler windows stay exact: the trainer shrinks a
+    # group so it never straddles an interval boundary. Per-step losses
+    # still come back (scan stacks the metrics); preemption latency grows
+    # to at most K steps. Not supported under pipeline parallelism.
+    steps_per_dispatch: int = 1
 
     def __post_init__(self):
         if self.compute_dtype is None:

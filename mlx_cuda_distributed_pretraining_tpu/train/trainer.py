@@ -195,6 +195,18 @@ class Trainer:
             and "pp" in self.mesh.axis_names
             and self.mesh.shape["pp"] > 1
         )
+        # K train steps per device dispatch (see SystemConfig). Pipeline
+        # builds its own step; K>1 is a dense/sharded-step feature.
+        self.steps_per_dispatch = max(1, int(
+            getattr(cfg.system, "steps_per_dispatch", 1) or 1))
+        self.train_multi_step = None
+        if self.pipeline and self.steps_per_dispatch > 1:
+            raise ValueError(
+                "system.steps_per_dispatch > 1 is not supported with "
+                "pipeline parallelism (system.mesh.pp > 1): the GPipe step "
+                "already amortizes dispatches over microbatches — set "
+                "steps_per_dispatch: 1"
+            )
         if self.pipeline:
             from ..parallel.pipeline import (
                 make_pipeline_loss,
@@ -246,6 +258,17 @@ class Trainer:
                 log_grad_norm=cfg.logging.log_gradient_norm,
                 params_like=self.params,
             )
+            if self.steps_per_dispatch > 1:
+                from .train_step import make_multi_step
+
+                self.train_multi_step, _ = make_multi_step(
+                    self.loss_fn, self.optimizer,
+                    accum_steps=self.accum_steps,
+                    mesh=self.mesh,
+                    zero_level=cfg.system.zero_optimization_level,
+                    log_grad_norm=cfg.logging.log_gradient_norm,
+                    params_like=self.params,
+                )
             self.eval_step = make_eval_step(self.eval_loss_fn, self.mesh, self.state_shardings)
 
             self.state = init_train_state(self.params, self.optimizer)
@@ -452,12 +475,40 @@ class Trainer:
             log_grad_norm=self.config.logging.log_gradient_norm,
             params_like=self.params,
         )
+        if self.steps_per_dispatch > 1:
+            from .train_step import make_multi_step
+
+            self.train_multi_step, _ = make_multi_step(
+                self.loss_fn, self.optimizer,
+                accum_steps=self.accum_steps,
+                mesh=self.mesh,
+                zero_level=self.config.system.zero_optimization_level,
+                log_grad_norm=self.config.logging.log_gradient_norm,
+                params_like=self.params,
+            )
         self.state = init_train_state(self.state["params"], self.optimizer)
         if self.mesh is not None and self.state_shardings is not None:
             self.state = jax.device_put(self.state, self.state_shardings)
         return suggested
 
     # -- the loop -----------------------------------------------------------
+    def _dispatch_group_len(self, step: int, val_int, ckpt_int,
+                            prof_start: int, prof_stop: int) -> int:
+        """Steps to run in this dispatch group: at most steps_per_dispatch,
+        never past total_steps, never straddling a validation/checkpoint
+        step (events fire at group end) or a profiler window boundary
+        (traces must toggle between dispatches)."""
+        end = min(step + self.steps_per_dispatch - 1, self.total_steps)
+        for intv in (val_int, ckpt_int):
+            if intv:
+                nxt = ((step + intv - 1) // intv) * intv
+                end = min(end, nxt)
+        if prof_stop > prof_start:
+            for b in (prof_start, prof_stop):
+                if b > step:
+                    end = min(end, b - 1)
+        return max(1, end - step + 1)
+
     def train(self) -> Dict[str, Any]:
         cfg = self.config
         log_int = max(1, cfg.logging.logging_interval)
@@ -509,6 +560,14 @@ class Trainer:
         except (ValueError, OSError):  # non-main thread: no signal hooks
             prev_handlers = {}
 
+        # steps_per_dispatch>1: each dispatch runs a GROUP of steps via
+        # lax.scan (make_multi_step) and the per-step loop below consumes
+        # the stacked results one step at a time — logging, validation,
+        # checkpoints, and preemption handling stay byte-identical because
+        # _dispatch_group_len never lets a group straddle an interval
+        # boundary or the profiler window.
+        pending: list = []
+
         try:
             for step in range(self.start_step + 1, self.total_steps + 1):
                 if prof_stop > prof_start:
@@ -527,17 +586,46 @@ class Trainer:
                         _prof.start_trace(os.path.join(self.run_dir, "profile"))
                         prof_active = True
                         self.logger.log(f"profiler: trace started at step {step}")
-                try:
-                    batch = self.data.generate_batch(step - 1)
-                except StopIteration:  # finite stream ran dry (streaming sources)
-                    self.logger.log(f"Data stream exhausted before step {step}; stopping")
-                    break
-                # Host-side token count (non-pad targets) so tok/s stays correct
-                # even when device metrics are only read every log_int steps.
-                step_tokens = int(batch["mask"].sum()) * jax.process_count()
-                window_tokens += step_tokens
-                self.total_tokens += step_tokens
-                self.state, metrics = self.train_step(self.state, _device_batch(batch))
+                if self.steps_per_dispatch > 1:
+                    if not pending:
+                        glen = self._dispatch_group_len(
+                            step, val_int, ckpt_int, prof_start, prof_stop)
+                        batches = []
+                        for i in range(glen):
+                            try:
+                                batches.append(self.data.generate_batch(step - 1 + i))
+                            except StopIteration:
+                                break  # dispatch the fetched prefix; the
+                                # next group attempt gets 0 and stops
+                        if not batches:
+                            self.logger.log(
+                                f"Data stream exhausted before step {step}; stopping")
+                            break
+                        stacked = {k: np.stack([b[k] for b in batches])
+                                   for k in batches[0]}
+                        self.state, mm = self.train_multi_step(
+                            self.state, _device_batch(stacked))
+                        pending = [
+                            (jax.tree_util.tree_map(lambda a, i=i: a[i], mm),
+                             int(b["mask"].sum()) * jax.process_count())
+                            for i, b in enumerate(batches)
+                        ]
+                    metrics, step_tokens = pending.pop(0)
+                    window_tokens += step_tokens
+                    self.total_tokens += step_tokens
+                else:
+                    try:
+                        batch = self.data.generate_batch(step - 1)
+                    except StopIteration:  # finite stream ran dry (streaming sources)
+                        self.logger.log(f"Data stream exhausted before step {step}; stopping")
+                        break
+                    # Host-side token count (non-pad targets) so tok/s stays
+                    # correct even when device metrics are only read every
+                    # log_int steps.
+                    step_tokens = int(batch["mask"].sum()) * jax.process_count()
+                    window_tokens += step_tokens
+                    self.total_tokens += step_tokens
+                    self.state, metrics = self.train_step(self.state, _device_batch(batch))
 
                 if step % log_int == 0 or step == self.total_steps:
                     loss = float(metrics["loss"])  # device sync point
@@ -580,7 +668,13 @@ class Trainer:
                     self.save_checkpoint(
                         step, blocking=not cfg.system.async_checkpointing)
 
-                if self._preempted:
+                # With steps_per_dispatch>1, drain the already-dispatched
+                # group before saving: the device state is at the group
+                # end, so breaking mid-group would tag the checkpoint with
+                # a step the state has already passed and undercount
+                # total_tokens. Draining is host-side only (no new
+                # dispatches) — preemption latency grows by < K steps.
+                if self._preempted and not pending:
                     self.logger.log(
                         f"Preemption signal received: saving checkpoint at step {step} and exiting"
                     )
