@@ -9,8 +9,11 @@ shape bench.py uses, the cost of
   - the CE head alone (fused and unfused),
 so fwd / bwd / optimizer / attention / CE shares can be read directly.
 
-Same chained-dispatch methodology as bench.py (the axon tunnel makes
-block_until_ready a no-op). Prints JSON lines; run on the TPU:
+Each section times ``steps`` iterations in ONE ``lax.scan`` dispatch, so
+the numbers are pure chip compute — compare against bench.py rows taken
+with ``BENCH_MEGASTEP`` set (the default per-step bench rows additionally
+pay one tunnel RTT per step). ``BREAKDOWN_CHAIN=dispatch`` restores
+per-call chaining. Prints JSON lines; run on the TPU:
 
     python scripts/bench_breakdown.py [--scale 100m] [--steps 10]
 """
@@ -22,6 +25,7 @@ import json
 import os
 import sys
 import time
+from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -32,14 +36,37 @@ import numpy as np
 from bench import SCALES, V5E_PEAK_FLOPS, flops_per_token
 
 
-def chain_time(fn, state, steps):
-    """fn: state -> state (jitted). Chains ``steps`` calls, one host sync."""
-    out = fn(state)
-    jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[:1])  # compile+warm
+def chain_time(fn, state, steps, donate=False):
+    """fn: state -> state (jitted). Times ``steps`` iterations in ONE
+    dispatch (lax.scan), so per-dispatch tunnel RTT (~70-200ms each) is
+    paid once instead of per iteration — per-call chaining inflated every
+    section's absolute ms and hid the true component shares.
+
+    ``donate`` must be True ONLY when ``state`` is a fresh tree owned by
+    this section (the full-step sections: params + Adam moments would
+    otherwise be held twice and OOM at scales the bench megastep fits)
+    and False for sections whose input (module-level params/q0/h0) is
+    reused by later sections — donating those would delete their buffers.
+    BREAKDOWN_CHAIN=dispatch restores the old per-call chaining."""
+    if os.environ.get("BREAKDOWN_CHAIN") == "dispatch":
+        out = fn(state)
+        jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+        t0 = time.perf_counter()
+        cur = out
+        for _ in range(steps):
+            cur = fn(cur)
+        jax.device_get(jax.tree_util.tree_leaves(cur)[0].ravel()[:1])
+        return (time.perf_counter() - t0) / steps
+
+    @partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def scanned(s):
+        return jax.lax.scan(lambda c, _: (fn(c), None), s, None,
+                            length=steps)[0]
+
+    out = scanned(state)  # compile + warm
+    jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
     t0 = time.perf_counter()
-    cur = out
-    for _ in range(steps):
-        cur = fn(cur)
+    cur = scanned(out)
     jax.device_get(jax.tree_util.tree_leaves(cur)[0].ravel()[:1])
     return (time.perf_counter() - t0) / steps
 
@@ -102,12 +129,14 @@ def main():
     step, _ = make_train_step(loss_fused, opt)
     report("full_step_fused_ce",
            chain_time(lambda s: step(s, batch)[0],
-                      init_train_state(fresh_params(), opt), a.steps))
+                      init_train_state(fresh_params(), opt), a.steps,
+                      donate=True))
 
     step_u, _ = make_train_step(loss_unfused, opt)
     report("full_step_unfused_ce",
            chain_time(lambda s: step_u(s, batch)[0],
-                      init_train_state(fresh_params(), opt), a.steps))
+                      init_train_state(fresh_params(), opt), a.steps,
+                      donate=True))
 
     # non-donating sections below reuse the module-level params (never
     # donated: both full-step sections built their own trees)
