@@ -209,7 +209,7 @@ def flops_per_token(n_params, num_layers, seq, d_attn):
 
 
 def bench_train_case(name, scale_key, attn, vocab, steps, fused_ce=True,
-                     optimizer="adamw"):
+                     optimizer="adamw", megastep=0):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -278,7 +278,7 @@ def bench_train_case(name, scale_key, attn, vocab, steps, fused_ce=True,
     # per-step loop measures tunnel overhead, not chip capability. The
     # megastep number is the chip's true sustained rate — what a locally
     # attached host (or a longer scan) would see.
-    mega = int(os.environ.get("BENCH_MEGASTEP", "0"))
+    mega = int(os.environ.get("BENCH_MEGASTEP", str(megastep)))
     if mega > 1:
         def _mega(st):
             def body(s, _):
@@ -551,6 +551,11 @@ def build_plan(vocab, steps):
          60),
         ("2m_flash", "2m",
          lambda: bench_train_case("2m_flash", "2m", "flash", vocab, steps), 90),
+        # *_mega rows: K steps per dispatch (lax.scan) — the chip's true
+        # sustained rate next to the per-step row's rate-with-tunnel-RTT.
+        ("2m_mega", "2m",
+         lambda: bench_train_case("2m_mega", "2m", "flash", vocab,
+                                  max(steps, 20), megastep=20), 100),
         ("decode_2m", "decode", lambda: bench_decode_case("2m", vocab), 120),
         ("100m_flash", "100m",
          lambda: bench_train_case("100m_flash", "100m", "flash", vocab, steps), 150),
@@ -587,6 +592,16 @@ def build_plan(vocab, steps):
         ("1b_adafactor", "1b",
          lambda: bench_train_case("1b_adafactor", "1b_bs8", "flash", vocab,
                                   steps, optimizer="adafactor"), 420),
+        # Megastep comparison rows AFTER the unique families: duplicate
+        # family coverage must not budget-starve longctx/650m/1b
+        # (cheap-and-diverse-first invariant; 2m_mega stays early as the
+        # true-rate anchor next to the headline row).
+        ("100m_mega", "100m",
+         lambda: bench_train_case("100m_mega", "100m", "flash", vocab,
+                                  max(steps, 10), megastep=10), 170),
+        ("400m_mega", "400m",
+         lambda: bench_train_case("400m_mega", "400m", "flash", vocab,
+                                  max(steps, 10), megastep=10), 260),
         ("100m_bs64_remat", "100m",
          lambda: bench_train_case("100m_bs64_remat", "100m_bs64", "flash",
                                   vocab, steps), 150),

@@ -48,12 +48,10 @@ _SHORT = {
 # the first combo separates tunnel dispatch overhead from chip compute —
 # THE open MFU question — and later combos measure their knob on top of
 # megastep so tunnel noise can't mask a small kernel-level win.
+# The bare megastep points (2m_mega/100m_mega/400m_mega) are first-class
+# bench cases; the sweeps here measure the TUNING knobs on top of them.
 DEFAULT_COMBOS = {
-    "2m_flash": [
-        {"BENCH_MEGASTEP": "20"},
-    ],
     "400m_flash": [
-        {"BENCH_MEGASTEP": "10"},
         {"BENCH_MEGASTEP": "10", "BENCH_SCAN_LAYERS": "0"},
         {"BENCH_MEGASTEP": "10", "FLASH_BLOCK_Q": "512", "FLASH_BLOCK_KV": "1024"},
         {"BENCH_MEGASTEP": "10", "FLASH_BLOCK_Q": "512", "FLASH_BLOCK_KV": "512"},
@@ -62,7 +60,6 @@ DEFAULT_COMBOS = {
         {"BENCH_MEGASTEP": "10", "FLASH_BLOCK_Q": "1024", "FLASH_BLOCK_KV": "1024"},
     ],
     "100m_flash": [
-        {"BENCH_MEGASTEP": "10"},
         {"BENCH_MEGASTEP": "10", "BENCH_SCAN_LAYERS": "1"},
         {"BENCH_MEGASTEP": "10", "FLASH_BLOCK_Q": "512", "FLASH_BLOCK_KV": "1024"},
         {"BENCH_MEGASTEP": "10", "BENCH_CE_CHUNK": "4096"},

@@ -37,10 +37,12 @@ cd "$REPO"
 # then decode/longctx/1b rows, then comparison variants.
 JOBS=(
   "one_40m_flash 420"
+  "one_2m_mega 400"
   "one_400m_flash 700"
-  "sweep_2m 800"
+  "one_100m_mega 500"
+  "one_400m_mega 700"
   "breakdown_100m 700"
-  "sweep_100m 2800"
+  "sweep_100m 2200"
   "one_trainer 700"
   "one_decode_100m 450"
   "one_decode_100m_16k_int8 560"
