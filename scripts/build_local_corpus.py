@@ -118,18 +118,58 @@ def iter_docstrings(min_chars: int):
                 yield text
 
 
+def iter_source_files(min_chars: int, exts=(".py",)):
+    """Whole source files as documents (real human-written text: code +
+    comments + docstrings). Skips vendored/minified/test-fixture noise by
+    requiring a minimum size and a sane line length profile."""
+    seen = set()
+    for root in ("/opt/venv/lib", "/usr/lib/python3.12"):
+        for ext in exts:
+            for path in glob.iglob(os.path.join(root, "**", f"*{ext}"),
+                                   recursive=True):
+                real = os.path.realpath(path)
+                if real in seen or not os.path.isfile(real):
+                    continue
+                seen.add(real)
+                try:
+                    if os.path.getsize(real) < min_chars:
+                        continue
+                    with io.open(real, "r", errors="ignore") as f:
+                        raw = f.read(1 << 20)
+                except OSError:
+                    continue
+                text = raw.replace("\r\n", "\n").replace("\x00", "").strip()
+                if len(text) < min_chars:
+                    continue
+                lines = text.splitlines()
+                # minified/generated files have few, enormous lines
+                if not lines or sum(len(l) for l in lines) / len(lines) > 200:
+                    continue
+                yield text
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--out", required=True)
     p.add_argument("--min-doc-chars", type=int, default=400)
     p.add_argument("--max-mb", type=float, default=200.0)
+    p.add_argument("--code-mb", type=float, default=0.0,
+                   help="additionally include up to this many MB of whole "
+                        "source files (.py) as documents — real text with "
+                        "different token statistics than the doc prose")
     p.add_argument("--seed", type=int, default=0)
     a = p.parse_args(argv)
 
     docs = []
     total = 0
     cap = int(a.max_mb * 1e6)
-    for it in (iter_doc_files(a.min_doc_chars), iter_docstrings(a.min_doc_chars)):
+    # With --code-mb, whole .py files already carry their docstrings —
+    # running the docstring extractor too would ship every long docstring
+    # twice, so the prose side is then doc-files only.
+    prose_iters = ((iter_doc_files(a.min_doc_chars),) if a.code_mb > 0 else
+                   (iter_doc_files(a.min_doc_chars),
+                    iter_docstrings(a.min_doc_chars)))
+    for it in prose_iters:
         for text in it:
             docs.append(text)
             total += len(text)
@@ -137,6 +177,16 @@ def main(argv=None) -> int:
                 break
         if total >= cap:
             break
+
+    code_chars = 0
+    if a.code_mb > 0:
+        code_cap = int(a.code_mb * 1e6)
+        for text in iter_source_files(a.min_doc_chars):
+            docs.append(text)
+            code_chars += len(text)
+            if code_chars >= code_cap:
+                break
+        total += code_chars
 
     random.Random(a.seed).shuffle(docs)
     os.makedirs(os.path.dirname(os.path.abspath(a.out)) or ".", exist_ok=True)
@@ -147,8 +197,12 @@ def main(argv=None) -> int:
         "documents": len(docs),
         "chars": total,
         "mb": round(total / 1e6, 1),
+        "code_mb": round(code_chars / 1e6, 1),
         "sources": "local documentation (*.rst/*.md/*.txt, /usr/share/doc "
-                   "gzipped changelogs) + installed-package docstrings",
+                   "gzipped changelogs)"
+                   + (" + whole .py source files (docstrings ride along "
+                      "in-file)" if code_chars
+                      else " + installed-package docstrings"),
         "note": "offline real-prose corpus; zero-egress environment",
     }
     with open(os.path.splitext(a.out)[0] + ".manifest.json", "w") as f:
