@@ -134,7 +134,8 @@ train40m() { # timeout
   local before
   before=$(ls "$RUN"/checkpoints/ 2>/dev/null | md5sum)
   echo "$(stamp) START train40m segment cfg=$cfg (timeout ${t}s)" >> "$LOG"
-  timeout -k 15 "$t" python train.py --config "$cfg" > "$seg" 2>&1
+  timeout -k 15 "$t" python train.py --config "$cfg" \
+    --runs-root /tmp/realrun/runs > "$seg" 2>&1
   local rc=$?
   if train40m_done; then
     touch "$BASE/done/train40m"; echo "$(stamp) DONE train40m rc=$rc" >> "$LOG"
