@@ -448,7 +448,7 @@ def bench_decode_case(scale_key, vocab, prompt=512, max_len=2048,
     }
 
 
-def bench_trainer_case(vocab, workdir="/tmp/bench_trainer"):
+def bench_trainer_case(vocab, workdir="/tmp/bench_trainer", spd=1):
     """End-to-end Trainer on-chip (40M, flash, bf16, token-shard data):
     proves the input pipeline keeps the device fed (tok/s must be within
     ~10% of the bare-step 40m number)."""
@@ -507,7 +507,8 @@ def bench_trainer_case(vocab, workdir="/tmp/bench_trainer"):
         "logging": {"steps": {"logging_interval": 10,
                               "checkpoint_interval": 0,
                               "validation_interval": 0}},
-        "system": {"seed": 0, "compute_dtype": "bfloat16"},
+        "system": {"seed": 0, "compute_dtype": "bfloat16",
+                   "steps_per_dispatch": spd},
     }
     import yaml
 
@@ -527,8 +528,10 @@ def bench_trainer_case(vocab, workdir="/tmp/bench_trainer"):
             if "tok/s=" in line:
                 tok_s = float(line.split("tok/s=")[1].split()[0].rstrip("|"))
     return {
-        "case": "trainer_40m_flash_e2e", "batch": batch, "seq": seq,
+        "case": "trainer_40m_flash_e2e" + (f"_spd{spd}" if spd > 1 else ""),
+        "batch": batch, "seq": seq,
         "vocab": vocab, "tok_s": tok_s, "wall_s": round(dt, 1),
+        **({"steps_per_dispatch": spd} if spd > 1 else {}),
         # The Trainer's own SIGTERM handler consumed a kill signal (it
         # saves and exits cleanly); run_case reads this flag — in
         # subprocess mode it is the only way the signal reaches the
@@ -602,6 +605,12 @@ def build_plan(vocab, steps):
         ("400m_mega", "400m",
          lambda: bench_train_case("400m_mega", "400m", "flash", vocab,
                                   max(steps, 10), megastep=10), 260),
+        # Same e2e Trainer with 8 steps per dispatch: through the tunnel
+        # this is the production analog of the *_mega rows (the trainer
+        # tok/s should approach the bare-step megastep rate).
+        ("trainer_spd8", "trainer",
+         lambda: bench_trainer_case(vocab, workdir="/tmp/bench_trainer8",
+                                    spd=8), 260),
         ("100m_bs64_remat", "100m",
          lambda: bench_train_case("100m_bs64_remat", "100m_bs64", "flash",
                                   vocab, steps), 150),

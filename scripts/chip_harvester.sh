@@ -47,6 +47,7 @@ JOBS=(
   "one_decode_100m 450"
   "one_decode_100m_16k_int8 560"
   "one_650m_flash 800"
+  "one_trainer_spd8 700"
   "train40m 1600"
   "one_1b_adafactor 1000"
   "breakdown_400m 1000"
