@@ -178,5 +178,5 @@ while :; do
     esac
   done
   if [ "$all_done" -eq 1 ]; then echo "$(stamp) ALL DONE" >> "$LOG"; break; fi
-  sleep 20
+  sleep "${CHIPRUN_SLEEP:-20}"
 done
