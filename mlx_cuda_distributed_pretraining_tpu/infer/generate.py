@@ -432,18 +432,22 @@ def generate_text(
     repetition_penalty: Optional[float] = None,
     seed: int = 0,
     kv_quant: bool = False,
-) -> str:
-    """Convenience: str → str with EOS stop."""
+    return_stats: bool = False,
+):
+    """Convenience: str → str with EOS stop. With ``return_stats`` returns
+    ``(text, stats)`` — the single place prompt encoding / sampler / stop
+    wiring lives, shared by the CLI and the HTTP server."""
     from .samplers import make_logits_processors
 
     ids = [tokenizer.bos_id] + tokenizer.tokenize(prompt)
     sampler = make_sampler(temp=temperature, top_p=top_p, min_p=min_p)
-    toks, _ = generate_lite(
+    toks, stats = generate_lite(
         params, args, ids, max_tokens=max_new_tokens, sampler=sampler,
         logits_processors=make_logits_processors(repetition_penalty),
         stop_tokens=[tokenizer.eos_id], seed=seed, kv_quant=kv_quant,
     )
-    return tokenizer.detokenize(toks)
+    text = tokenizer.detokenize(toks)
+    return (text, stats) if return_stats else text
 
 
 def beam_search(

@@ -1,0 +1,199 @@
+"""Minimal HTTP inference server over a trained run.
+
+The reference ships model serving as Modal apps (reference:
+modal/deploy.py + modal/client.py — an endpoint wrapping generation and
+a client that posts prompts). This is the platform-free equivalent: a
+dependency-free stdlib HTTP server over the same jitted decode path the
+CLI uses, plus a tiny urllib client helper.
+
+    python -m mlx_cuda_distributed_pretraining_tpu.infer.server \
+        --run myrun --runs-root runs --port 8400
+
+    POST /generate {"prompt": "...", "max_tokens": 64, "temperature": 0.8}
+      -> {"text": ..., "tokens": N, "generation_tps": ..., "logprob": ...}
+    GET /healthz -> {"status": "ok", "model": ..., "params_m": ...}
+
+Generation is serialized by a lock (one chip, one compiled decode);
+concurrent requests queue. The first request pays the jit compile.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..models import llama
+from .generate import generate_text
+
+
+class InferenceService:
+    """Owns the loaded model and serializes generation requests."""
+
+    def __init__(self, params, args, tokenizer, kv_quant: bool = False,
+                 run_name: str = "?", max_tokens_limit: int = 4096):
+        self.params = params
+        self.args = args
+        self.tokenizer = tokenizer
+        self.kv_quant = kv_quant
+        self.run_name = run_name
+        self.max_tokens_limit = max_tokens_limit
+        self.lock = threading.Lock()
+        self.n_params = llama.num_params(params)
+
+    @classmethod
+    def from_run(cls, run: str, runs_root: str = "runs",
+                 kv_quant: bool = False,
+                 max_tokens_limit: int = 4096) -> "InferenceService":
+        from ..train.trainer import load_trained
+
+        params, args, tok, _cfg = load_trained(run, runs_root=runs_root)
+        return cls(params, args, tok, kv_quant=kv_quant, run_name=run,
+                   max_tokens_limit=max_tokens_limit)
+
+    @staticmethod
+    def _quantize(x: float, step: float = 0.05) -> float:
+        """Samplers/processors are STATIC jit args of the decode step and
+        cached by identity (lru, maxsize 64): every distinct param combo
+        compiles and retains a decode executable. Snapping client floats
+        to a 0.05 grid bounds the variant space a long-lived server can
+        accumulate (and keeps repeat combos cache-hits)."""
+        return round(round(x / step) * step, 2)
+
+    def generate(self, prompt: str, max_tokens: int = 64,
+                 temperature: float = 0.0, top_p: float = 0.0,
+                 min_p: float = 0.0,
+                 repetition_penalty: Optional[float] = None,
+                 seed: int = 0) -> dict:
+        # Cap: an unbounded client value would allocate a huge KV cache
+        # while holding the lock (XLA OOM can abort the process).
+        max_tokens = max(1, min(int(max_tokens), self.max_tokens_limit))
+        with self.lock:
+            text, stats = generate_text(
+                self.params, self.args, self.tokenizer, prompt,
+                max_new_tokens=max_tokens,
+                temperature=self._quantize(temperature),
+                top_p=self._quantize(top_p),
+                min_p=self._quantize(min_p),
+                repetition_penalty=(self._quantize(repetition_penalty)
+                                    if repetition_penalty else None),
+                seed=seed, kv_quant=self.kv_quant, return_stats=True,
+            )
+        return {
+            "text": text,
+            "tokens": int(stats["generation_tokens"]),
+            **{k: round(float(v), 4) for k, v in stats.items()},
+        }
+
+    def health(self) -> dict:
+        return {
+            "status": "ok",
+            "run": self.run_name,
+            "architecture": "llama",
+            "params_m": round(self.n_params / 1e6, 2),
+            "vocab_size": self.args.vocab_size,
+            "kv_quant": self.kv_quant,
+            "max_tokens_limit": self.max_tokens_limit,
+        }
+
+
+def make_handler(service: InferenceService):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *a):  # quiet by default
+            pass
+
+        def _reply(self, code: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path.rstrip("/") in ("", "/healthz"):
+                self._reply(200, service.health())
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):
+            if self.path.rstrip("/") != "/generate":
+                self._reply(404, {"error": f"unknown path {self.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(req, dict) or "prompt" not in req:
+                    raise ValueError("body must be a JSON object with 'prompt'")
+                rp = req.get("repetition_penalty")
+                out = service.generate(
+                    prompt=str(req["prompt"]),
+                    max_tokens=int(req.get("max_tokens", 64)),
+                    temperature=float(req.get("temperature", 0.0)),
+                    top_p=float(req.get("top_p", 0.0)),
+                    min_p=float(req.get("min_p", 0.0)),
+                    repetition_penalty=float(rp) if rp is not None else None,
+                    seed=int(req.get("seed", 0)),
+                )
+                self._reply(200, out)
+            except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+                self._reply(400, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 - surface, don't kill the server
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+    return Handler
+
+
+def serve(service: InferenceService, host: str = "127.0.0.1",
+          port: int = 8400) -> ThreadingHTTPServer:
+    """Start serving in a background thread; returns the server — stop
+    with ``httpd.shutdown(); httpd.server_close()`` (shutdown alone
+    leaves the listening socket open). Port 0 picks a free port."""
+    httpd = ThreadingHTTPServer((host, port), make_handler(service))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="infer-server")
+    t.start()
+    return httpd
+
+
+def request_generate(url: str, prompt: str, timeout: float = 300.0,
+                     **kwargs) -> dict:
+    """Client helper (reference: modal/client.py posts prompts to the
+    deployed endpoint): ``request_generate("http://h:8400", "hi")``."""
+    import urllib.request
+
+    body = json.dumps({"prompt": prompt, **kwargs}).encode()
+    req = urllib.request.Request(
+        url.rstrip("/") + "/generate", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--run", required=True)
+    p.add_argument("--runs-root", default="runs")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8400)
+    p.add_argument("--kv-quant", action="store_true")
+    p.add_argument("--max-tokens-limit", type=int, default=4096)
+    a = p.parse_args(argv)
+
+    service = InferenceService.from_run(a.run, a.runs_root,
+                                        kv_quant=a.kv_quant,
+                                        max_tokens_limit=a.max_tokens_limit)
+    httpd = ThreadingHTTPServer((a.host, a.port), make_handler(service))
+    print(f"serving {a.run} ({service.n_params / 1e6:.1f}M params) "
+          f"on http://{a.host}:{httpd.server_address[1]}")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
