@@ -99,6 +99,43 @@ class InferenceService:
         }
 
 
+def _to_openai_completion(out: dict, req: dict, run_name: str,
+                          tokenizer=None, effective_max: int = 0) -> dict:
+    """Map the native /generate result onto the OpenAI completions shape
+    so existing OpenAI-client tooling can point at this server. ``stop``
+    strings are applied by truncation (generation stops on EOS; string
+    stops are a post-filter); usage counts the RETURNED text after
+    truncation, not the discarded tail."""
+    import uuid
+
+    text = out["text"]
+    completion_tokens = out["tokens"]
+    # "length" = the decode hit its budget — the server-clamped budget,
+    # not the raw client value (a cap-limited generation IS truncated).
+    finish = "length" if completion_tokens >= effective_max else "stop"
+    stops = req.get("stop")
+    if isinstance(stops, str):
+        stops = [stops]
+    for s in stops or []:
+        idx = text.find(s)
+        if idx >= 0:
+            text = text[:idx]
+            finish = "stop"
+    if text != out["text"] and tokenizer is not None:
+        completion_tokens = len(tokenizer.tokenize(text))
+    prompt_tokens = int(out.get("prompt_tokens", 0))
+    return {
+        "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+        "object": "text_completion",
+        "model": str(req.get("model") or run_name),
+        "choices": [{"text": text, "index": 0, "logprobs": None,
+                     "finish_reason": finish}],
+        "usage": {"prompt_tokens": prompt_tokens,
+                  "completion_tokens": completion_tokens,
+                  "total_tokens": prompt_tokens + completion_tokens},
+    }
+
+
 def make_handler(service: InferenceService):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *a):  # quiet by default
@@ -119,7 +156,8 @@ def make_handler(service: InferenceService):
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
         def do_POST(self):
-            if self.path.rstrip("/") != "/generate":
+            path = self.path.rstrip("/")
+            if path not in ("/generate", "/v1/completions"):
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
             try:
@@ -128,15 +166,32 @@ def make_handler(service: InferenceService):
                 if not isinstance(req, dict) or "prompt" not in req:
                     raise ValueError("body must be a JSON object with 'prompt'")
                 rp = req.get("repetition_penalty")
+                prompt = req["prompt"]
+                if isinstance(prompt, list):  # OpenAI allows str | [str]
+                    if len(prompt) != 1 or not isinstance(prompt[0], str):
+                        raise ValueError(
+                            "list prompts must hold exactly one string "
+                            "(batched completions are not supported)")
+                    prompt = prompt[0]
+                elif not isinstance(prompt, str):
+                    raise ValueError("'prompt' must be a string")
+                effective_max = max(
+                    1, min(int(req.get("max_tokens", 64)),
+                           service.max_tokens_limit))
                 out = service.generate(
-                    prompt=str(req["prompt"]),
-                    max_tokens=int(req.get("max_tokens", 64)),
+                    prompt=prompt,
+                    max_tokens=effective_max,
                     temperature=float(req.get("temperature", 0.0)),
                     top_p=float(req.get("top_p", 0.0)),
                     min_p=float(req.get("min_p", 0.0)),
                     repetition_penalty=float(rp) if rp is not None else None,
                     seed=int(req.get("seed", 0)),
                 )
+                if path == "/v1/completions":
+                    out = _to_openai_completion(
+                        out, req, service.run_name,
+                        tokenizer=service.tokenizer,
+                        effective_max=effective_max)
                 self._reply(200, out)
             except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
                 self._reply(400, {"error": str(e)})

@@ -400,3 +400,40 @@ def test_inference_http_server(tmp_path):
     finally:
         httpd.shutdown()
         httpd.server_close()
+
+
+def test_openai_completions_route(tmp_path):
+    """/v1/completions maps the native generate result onto the OpenAI
+    completions shape (choices/usage/finish_reason, stop-string
+    truncation) so OpenAI-client tooling can point at the server."""
+    import urllib.request
+
+    from mlx_cuda_distributed_pretraining_tpu.infer.server import (
+        InferenceService,
+        serve,
+    )
+
+    cfg = _tiny_config(tmp_path, name="oai", iters=8)
+    Trainer(cfg, runs_root=str(tmp_path / "runs"), quiet=True).train()
+    service = InferenceService.from_run("oai", runs_root=str(tmp_path / "runs"))
+    httpd = serve(service, port=0)
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}/v1/completions"
+        body = json.dumps({"prompt": "the quick", "max_tokens": 6,
+                           "stop": [" "]}).encode()
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            out = json.loads(r.read())
+        assert out["object"] == "text_completion"
+        choice = out["choices"][0]
+        assert choice["finish_reason"] in ("stop", "length")
+        assert " " not in choice["text"]  # stop-string truncation applied
+        u = out["usage"]
+        assert u["total_tokens"] == u["prompt_tokens"] + u["completion_tokens"]
+        # usage counts the RETURNED text: stop-truncation may cut it to 0
+        assert 0 <= u["completion_tokens"] <= 6
+        assert out["id"].startswith("cmpl-")
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
