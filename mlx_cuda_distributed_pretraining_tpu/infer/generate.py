@@ -433,19 +433,38 @@ def generate_text(
     seed: int = 0,
     kv_quant: bool = False,
     return_stats: bool = False,
+    speculative: bool = False,
+    draft_len: int = 8,
 ):
     """Convenience: str → str with EOS stop. With ``return_stats`` returns
     ``(text, stats)`` — the single place prompt encoding / sampler / stop
-    wiring lives, shared by the CLI and the HTTP server."""
+    wiring lives, shared by the CLI and the HTTP server. ``speculative``
+    uses prompt-lookup self-drafting (greedy-exact / temperature-exact;
+    incompatible with top_p/min_p/repetition_penalty)."""
     from .samplers import make_logits_processors
 
     ids = [tokenizer.bos_id] + tokenizer.tokenize(prompt)
-    sampler = make_sampler(temp=temperature, top_p=top_p, min_p=min_p)
-    toks, stats = generate_lite(
-        params, args, ids, max_tokens=max_new_tokens, sampler=sampler,
-        logits_processors=make_logits_processors(repetition_penalty),
-        stop_tokens=[tokenizer.eos_id], seed=seed, kv_quant=kv_quant,
-    )
+    if speculative:
+        # repetition_penalty=1.0 is the no-op value make_logits_processors
+        # itself skips — only penalties that actually reshape logits
+        # conflict with the acceptance rule.
+        if top_p or min_p or (repetition_penalty or 1.0) != 1.0:
+            raise ValueError(
+                "speculative decoding supports temperature only "
+                "(top_p/min_p/repetition_penalty reshape the proposal "
+                "distribution the acceptance rule assumes)")
+        toks, stats = generate_speculative(
+            params, args, ids, max_tokens=max_new_tokens,
+            draft_len=draft_len, stop_tokens=[tokenizer.eos_id],
+            temperature=temperature, seed=seed, kv_quant=kv_quant,
+        )
+    else:
+        sampler = make_sampler(temp=temperature, top_p=top_p, min_p=min_p)
+        toks, stats = generate_lite(
+            params, args, ids, max_tokens=max_new_tokens, sampler=sampler,
+            logits_processors=make_logits_processors(repetition_penalty),
+            stop_tokens=[tokenizer.eos_id], seed=seed, kv_quant=kv_quant,
+        )
     text = tokenizer.detokenize(toks)
     return (text, stats) if return_stats else text
 

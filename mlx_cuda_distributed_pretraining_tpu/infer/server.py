@@ -33,25 +33,30 @@ class InferenceService:
     """Owns the loaded model and serializes generation requests."""
 
     def __init__(self, params, args, tokenizer, kv_quant: bool = False,
-                 run_name: str = "?", max_tokens_limit: int = 4096):
+                 run_name: str = "?", max_tokens_limit: int = 4096,
+                 speculative: bool = False, draft_len: int = 8):
         self.params = params
         self.args = args
         self.tokenizer = tokenizer
         self.kv_quant = kv_quant
         self.run_name = run_name
         self.max_tokens_limit = max_tokens_limit
+        self.speculative = speculative
+        self.draft_len = draft_len
         self.lock = threading.Lock()
         self.n_params = llama.num_params(params)
 
     @classmethod
     def from_run(cls, run: str, runs_root: str = "runs",
-                 kv_quant: bool = False,
-                 max_tokens_limit: int = 4096) -> "InferenceService":
+                 kv_quant: bool = False, max_tokens_limit: int = 4096,
+                 speculative: bool = False,
+                 draft_len: int = 8) -> "InferenceService":
         from ..train.trainer import load_trained
 
         params, args, tok, _cfg = load_trained(run, runs_root=runs_root)
         return cls(params, args, tok, kv_quant=kv_quant, run_name=run,
-                   max_tokens_limit=max_tokens_limit)
+                   max_tokens_limit=max_tokens_limit,
+                   speculative=speculative, draft_len=draft_len)
 
     @staticmethod
     def _quantize(x: float, step: float = 0.05) -> float:
@@ -70,20 +75,28 @@ class InferenceService:
         # Cap: an unbounded client value would allocate a huge KV cache
         # while holding the lock (XLA OOM can abort the process).
         max_tokens = max(1, min(int(max_tokens), self.max_tokens_limit))
+        q_top_p = self._quantize(top_p)
+        q_min_p = self._quantize(min_p)
+        q_rep = (self._quantize(repetition_penalty)
+                 if repetition_penalty else None)
+        # Speculation accelerates exact greedy/temperature decoding only;
+        # requests whose EFFECTIVE (post-quantization, no-op-filtered)
+        # sampling knobs reshape logits fall back to plain decode.
+        spec = self.speculative and not (
+            q_top_p or q_min_p or (q_rep or 1.0) != 1.0)
         with self.lock:
             text, stats = generate_text(
                 self.params, self.args, self.tokenizer, prompt,
                 max_new_tokens=max_tokens,
                 temperature=self._quantize(temperature),
-                top_p=self._quantize(top_p),
-                min_p=self._quantize(min_p),
-                repetition_penalty=(self._quantize(repetition_penalty)
-                                    if repetition_penalty else None),
+                top_p=q_top_p, min_p=q_min_p, repetition_penalty=q_rep,
                 seed=seed, kv_quant=self.kv_quant, return_stats=True,
+                speculative=spec, draft_len=self.draft_len,
             )
         return {
             "text": text,
             "tokens": int(stats["generation_tokens"]),
+            "speculative": spec,
             **{k: round(float(v), 4) for k, v in stats.items()},
         }
 
@@ -96,6 +109,8 @@ class InferenceService:
             "vocab_size": self.args.vocab_size,
             "kv_quant": self.kv_quant,
             "max_tokens_limit": self.max_tokens_limit,
+            "speculative": self.speculative,
+            "draft_len": self.draft_len,
         }
 
 
@@ -235,11 +250,17 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=8400)
     p.add_argument("--kv-quant", action="store_true")
     p.add_argument("--max-tokens-limit", type=int, default=4096)
+    p.add_argument("--spec", action="store_true",
+                   help="prompt-lookup speculative decoding for greedy/"
+                        "temperature requests (>1 token per device step)")
+    p.add_argument("--draft-len", type=int, default=8)
     a = p.parse_args(argv)
 
     service = InferenceService.from_run(a.run, a.runs_root,
                                         kv_quant=a.kv_quant,
-                                        max_tokens_limit=a.max_tokens_limit)
+                                        max_tokens_limit=a.max_tokens_limit,
+                                        speculative=a.spec,
+                                        draft_len=a.draft_len)
     httpd = ThreadingHTTPServer((a.host, a.port), make_handler(service))
     print(f"serving {a.run} ({service.n_params / 1e6:.1f}M params) "
           f"on http://{a.host}:{httpd.server_address[1]}")

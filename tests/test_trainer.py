@@ -437,3 +437,36 @@ def test_openai_completions_route(tmp_path):
     finally:
         httpd.shutdown()
         httpd.server_close()
+
+
+def test_server_speculative_mode(tmp_path):
+    """--spec serving: greedy requests ride prompt-lookup speculation
+    (bit-identical text to plain greedy), while requests using sampler
+    knobs the acceptance rule can't honor fall back to plain decode."""
+    from mlx_cuda_distributed_pretraining_tpu.infer.server import (
+        InferenceService,
+        request_generate,
+        serve,
+    )
+
+    cfg = _tiny_config(tmp_path, name="specsrv", iters=10)
+    Trainer(cfg, runs_root=str(tmp_path / "runs"), quiet=True).train()
+    plain = InferenceService.from_run("specsrv", runs_root=str(tmp_path / "runs"))
+    spec = InferenceService.from_run("specsrv", runs_root=str(tmp_path / "runs"),
+                                     speculative=True)
+    httpd = serve(spec, port=0)
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        out_spec = request_generate(url, "the quick brown fox", max_tokens=12)
+        assert out_spec["speculative"] is True
+        assert "verify_calls" in out_spec
+        # bit-identical to plain greedy decode on the same run
+        out_plain = plain.generate("the quick brown fox", max_tokens=12)
+        assert out_spec["text"] == out_plain["text"]
+        # sampler knobs force the plain path
+        out_tp = request_generate(url, "the", max_tokens=4, top_p=0.9,
+                                  temperature=0.8)
+        assert out_tp["speculative"] is False
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
