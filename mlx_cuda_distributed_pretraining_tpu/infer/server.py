@@ -22,6 +22,7 @@ from __future__ import annotations
 import argparse
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -45,6 +46,7 @@ class InferenceService:
         self.draft_len = draft_len
         self.lock = threading.Lock()
         self.n_params = llama.num_params(params)
+        self.started_at = int(time.time())
 
     @classmethod
     def from_run(cls, run: str, runs_root: str = "runs",
@@ -165,8 +167,20 @@ def make_handler(service: InferenceService):
             self.wfile.write(body)
 
         def do_GET(self):
-            if self.path.rstrip("/") in ("", "/healthz"):
+            path = self.path.rstrip("/")
+            if path in ("", "/healthz"):
                 self._reply(200, service.health())
+            elif path == "/v1/models":
+                # OpenAI clients list models before completing against one.
+                self._reply(200, {
+                    "object": "list",
+                    # `created` is required by the OpenAI SDK's Model type;
+                    # local runs have no registry timestamp, so serve the
+                    # server process start (stable within a server's life).
+                    "data": [{"id": service.run_name, "object": "model",
+                              "created": service.started_at,
+                              "owned_by": "local"}],
+                })
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 

@@ -434,6 +434,15 @@ def test_openai_completions_route(tmp_path):
         # usage counts the RETURNED text: stop-truncation may cut it to 0
         assert 0 <= u["completion_tokens"] <= 6
         assert out["id"].startswith("cmpl-")
+
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        with urllib.request.urlopen(base + "/v1/models", timeout=30) as r:
+            models = json.loads(r.read())
+        assert models["object"] == "list"
+        entry = models["data"][0]
+        assert entry["id"] == "oai"
+        # required by the OpenAI SDK's Model pydantic type
+        assert isinstance(entry["created"], int) and entry["owned_by"]
     finally:
         httpd.shutdown()
         httpd.server_close()
