@@ -57,6 +57,11 @@ from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# The package __init__ installs the JAX_PLATFORMS=cpu guard (drops the
+# force-registered axon plugin before any backend initializes, so a
+# half-up tunnel can't hang CPU-only bench/test invocations in C).
+import mlx_cuda_distributed_pretraining_tpu  # noqa: F401
+
 BASELINE_TOKS_PER_SEC = 27500.0  # reference README.md:60 implied
 V5E_PEAK_FLOPS = 197e12  # TPU v5e bf16 peak per chip
 
@@ -146,10 +151,17 @@ def build_doc(matrix, device, vocab, reason, elapsed_s=None):
     scripts/merge_bench_outputs.py so self-captured artifacts merged from
     ``--one`` runs keep exactly this schema."""
     flash_2m = next((r for r in matrix if r.get("case") == "2m_flash" and r.get("tok_s")), None)
+    mega_2m = next((r for r in matrix if r.get("case") == "2m_mega" and r.get("tok_s")), None)
     best_mfu = max((r.get("mfu", 0.0) or 0.0 for r in matrix), default=0.0)
-    headline = flash_2m or next((r for r in matrix if r.get("tok_s")), {"case": "none", "tok_s": 0})
-    # vs_baseline (M3-Max 2M anchor) only makes sense for the 2M case.
-    vs = round(headline["tok_s"] / BASELINE_TOKS_PER_SEC, 3) if headline is flash_2m else None
+    # Headline prefers the megastep (chip-rate) 2m row when captured: the
+    # per-step 2m row's wall clock is dominated by tunnel dispatch RTT
+    # (~11ms compute inside a ~195ms step, TUNNEL_NOTE_r4), so it measures
+    # the tunnel, not the chip. Both rows stay in the matrix.
+    headline = mega_2m or flash_2m \
+        or next((r for r in matrix if r.get("tok_s")), {"case": "none", "tok_s": 0})
+    # vs_baseline (M3-Max 2M anchor) only makes sense for the 2M cases.
+    vs = (round(headline["tok_s"] / BASELINE_TOKS_PER_SEC, 3)
+          if headline in (mega_2m, flash_2m) else None)
     doc = {
         "metric": f"pretrain_tokens_per_sec_per_chip_llama_{headline['case']}"
                   f"_vocab{vocab}",
@@ -290,21 +302,27 @@ def bench_train_case(name, scale_key, attn, vocab, steps, fused_ce=True,
         mega_fn = jax.jit(_mega, donate_argnums=0)
         n_disp = max(1, steps // mega)
 
-        state, last_loss = mega_fn(state)  # compile + warm
+        # AOT-compile ONCE and drive the loop through the compiled
+        # executable: the same object later serves memory_analysis() (HBM
+        # fallback) without a second remote compile — through the tunnel
+        # a big-stack compile is the documented window-killer.
+        timed_exec = mega_fn.lower(state).compile()
+        state, last_loss = timed_exec(state)  # warm
         float(last_loss)
         t0 = time.perf_counter()
         for _ in range(n_disp):
-            state, last_loss = mega_fn(state)
+            state, last_loss = timed_exec(state)
         final_loss = float(last_loss)  # host fetch syncs the chain
         dt = time.perf_counter() - t0
         steps = n_disp * mega
     else:
-        state, metrics = step(state, b)  # compile + warm
+        timed_exec = step.lower(state, b).compile()  # one compile total
+        state, metrics = timed_exec(state, b)  # warm
         float(metrics["loss"])
 
         t0 = time.perf_counter()
         for _ in range(steps):
-            state, metrics = step(state, b)
+            state, metrics = timed_exec(state, b)
         final_loss = float(metrics["loss"])  # host fetch syncs the whole chain
         dt = time.perf_counter() - t0
 
@@ -313,13 +331,31 @@ def bench_train_case(name, scale_key, attn, vocab, steps, fused_ce=True,
     ft = flops_per_token(n_params, args.num_layers, seq,
                          args.num_heads * args.head_dim)
     hbm_peak_gb = None
+    hbm_src = None
     try:  # self-documenting fit analysis (1b cases ride the HBM edge)
         stats = jax.local_devices()[0].memory_stats() or {}
         peak = stats.get("peak_bytes_in_use") or stats.get("bytes_in_use")
         if peak:
             hbm_peak_gb = round(peak / 2**30, 2)
+            hbm_src = "memory_stats"
     except Exception:  # noqa: BLE001 - tunnel-dependent introspection
         pass
+    if hbm_peak_gb is None:
+        # Fallback for plugins that don't populate runtime memory stats
+        # (the axon tunnel returns {} — every r4-captured row had
+        # hbm_peak_gb null): the timed executable's static memory
+        # analysis needs no runtime support and no extra compile. live
+        # args + outputs - donated aliases + XLA temp ≈ peak HBM.
+        try:
+            ma = timed_exec.memory_analysis()
+            if ma is not None:
+                total = (ma.argument_size_in_bytes + ma.output_size_in_bytes
+                         - ma.alias_size_in_bytes + ma.temp_size_in_bytes)
+                if total > 0:
+                    hbm_peak_gb = round(total / 2**30, 2)
+                    hbm_src = "memory_analysis"
+        except Exception:  # noqa: BLE001 - best-effort introspection
+            pass
     return {
         "case": name, "params_m": round(n_params / 1e6, 1), "attn": attn,
         "optimizer": optimizer, "scan_layers": scan,
@@ -330,6 +366,7 @@ def bench_train_case(name, scale_key, attn, vocab, steps, fused_ce=True,
         "mfu": round(ft * tok_s / V5E_PEAK_FLOPS, 4),
         "final_loss": round(final_loss, 3),
         "hbm_peak_gb": hbm_peak_gb,
+        "hbm_src": hbm_src,
         **({"megastep": mega} if mega > 1 else {}),
     }
 
@@ -507,8 +544,11 @@ def bench_trainer_case(vocab, workdir="/tmp/bench_trainer", spd=1):
         "logging": {"steps": {"logging_interval": 10,
                               "checkpoint_interval": 0,
                               "validation_interval": 0}},
+        # scan_layers: the one live r4 window died in this case's compile
+        # of an unscanned 12-layer stack (TUNNEL_NOTE_r4); scan shrinks the
+        # XLA program ~12x here for identical math (parity-tested).
         "system": {"seed": 0, "compute_dtype": "bfloat16",
-                   "steps_per_dispatch": spd},
+                   "steps_per_dispatch": spd, "scan_layers": True},
     }
     import yaml
 
@@ -543,7 +583,7 @@ def bench_trainer_case(vocab, workdir="/tmp/bench_trainer", spd=1):
 def build_plan(vocab, steps):
     """Ordered case plan shared by the parent orchestrator and ``--one``
     children. Cheap-and-diverse first: a budget-truncated run still covers
-    every case family. (trainer before 40m: it IS a 40m e2e run.)
+    every case family.
     Each entry: (case_id, family, thunk, reserve_s)."""
     return [
         # "tiny" is a CI-only family (not in the default BENCH_CASES): it
@@ -562,7 +602,6 @@ def build_plan(vocab, steps):
         ("decode_2m", "decode", lambda: bench_decode_case("2m", vocab), 120),
         ("100m_flash", "100m",
          lambda: bench_train_case("100m_flash", "100m", "flash", vocab, steps), 150),
-        ("trainer", "trainer", lambda: bench_trainer_case(vocab), 240),
         ("40m_flash", "40m",
          lambda: bench_train_case("40m_flash", "40m", "flash", vocab, steps), 120),
         ("400m_flash", "400m",
@@ -605,6 +644,11 @@ def build_plan(vocab, steps):
         ("400m_mega", "400m",
          lambda: bench_train_case("400m_mega", "400m", "flash", vocab,
                                   max(steps, 10), megastep=10), 260),
+        # Trainer e2e cases sit BEHIND the cheap matrix rows: each pays a
+        # big-stack compile, and the one live r4 window died inside the
+        # trainer compile with 400m/650m/1b still uncaptured
+        # (TUNNEL_NOTE_r4). Both now run a scanned stack.
+        ("trainer", "trainer", lambda: bench_trainer_case(vocab), 240),
         # Same e2e Trainer with 8 steps per dispatch: through the tunnel
         # this is the production analog of the *_mega rows (the trainer
         # tok/s should approach the bare-step megastep rate).

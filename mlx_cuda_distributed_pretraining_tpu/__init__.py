@@ -14,3 +14,25 @@ ICI — replacing the reference's thread-queue + JSON/HTTP/Modal RPC layer
 """
 
 __version__ = "0.1.0"
+
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS") == "cpu":
+    # CPU-only invocation (tests, smoke runs, data prep). The session
+    # sitecustomize force-registers the axon TPU plugin and overrides
+    # jax_platforms to "axon,cpu" at the CONFIG level, so the env var
+    # alone does not keep this process off the TPU tunnel — and a
+    # half-up tunnel HANGS backend init inside a C call rather than
+    # erroring. Mirror tests/conftest.py: reset the config and drop the
+    # axon factory before any backend initializes. No-op when the
+    # factory is absent or backends are already live.
+    try:
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", "cpu")
+        from jax._src import xla_bridge as _xb
+
+        if not _xb.backends_are_initialized():
+            _xb._backend_factories.pop("axon", None)
+    except Exception:  # noqa: BLE001 - guard must never break imports
+        pass

@@ -136,10 +136,6 @@ class Trainer:
 
         scan_layers = bool(getattr(cfg.system, "scan_layers", False))
         z_loss_weight = float(cfg.training.hyperparameters.get("z_loss") or 0.0)
-        if scan_layers and self.remat_ratio < 1.0:
-            self.logger.log(
-                "scan_layers ignored: remat_ratio < 1 needs per-layer "
-                "checkpoint selection, which a scanned stack cannot express")
 
         def loss_fn(params, batch):
             return arch.loss_fn(

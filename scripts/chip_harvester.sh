@@ -35,23 +35,29 @@ cd "$REPO"
 # Priority order = VERDICT r3 asks: complete the scale matrix first, then
 # the MFU attribution breakdowns, then the on-chip real-text training run,
 # then decode/longctx/1b rows, then comparison variants.
+#
+# r5 ordering: chip-rate (mega) proof first — one_2m_mega is the single
+# most valuable missing datum and fits a sub-10-minute window; the scanned
+# one_400m_mega lands in the first 3 so a {400m,650m,1b} row arrives early.
+# Trainer cases sit behind the cheap matrix rows (they cost a big compile).
 JOBS=(
-  "one_40m_flash 420"
   "one_2m_mega 400"
-  "one_400m_flash 700"
   "one_100m_mega 500"
   "one_400m_mega 700"
-  "breakdown_100m 700"
-  "sweep_100m 2200"
-  "one_trainer 700"
-  "one_decode_100m 450"
-  "one_decode_100m_16k_int8 560"
-  "one_650m_flash 800"
-  "one_trainer_spd8 700"
-  "train40m 1600"
+  "one_40m_flash 420"
+  "one_400m_flash 700"
   "one_1b_adafactor 1000"
   "breakdown_400m 1000"
+  "one_650m_flash 800"
+  "breakdown_100m 700"
+  "one_decode_100m 450"
+  "one_decode_100m_16k_int8 560"
+  "one_trainer_spd8 700"
+  "train40m 1600"
+  "infbench40m 700"
   "sweep_400m 4400"
+  "sweep_100m 2200"
+  "one_trainer 700"
   "one_400m_bs32 900"
   "one_1b_lion 1000"
   "one_40m_flash_s8k 500"
@@ -81,7 +87,10 @@ run_one() { # [-strict] id timeout cmd...
   local id=$1 t=$2; shift 2
   echo "$(stamp) START $id (timeout ${t}s strict=$strict)" >> "$LOG"
   local rows_before
-  rows_before=$(grep -c '^BENCHCASE ' "$BASE/out/$id.out" 2>/dev/null || echo 0)
+  # No `|| echo 0` here: grep -c prints "0" AND exits 1 on a zero-row file,
+  # so `|| echo 0` would yield "0\n0" and break the -gt comparison below.
+  rows_before=$(grep -c '^BENCHCASE ' "$BASE/out/$id.out" 2>/dev/null)
+  rows_before=${rows_before:-0}
   # Append across retries: a partial first attempt (e.g. 5 of 6 breakdown
   # lines before a tunnel death) is captured data, not garbage.
   timeout -k 15 "$t" "$@" >> "$BASE/out/$id.out" 2>> "$BASE/out/$id.err"
@@ -94,7 +103,8 @@ run_one() { # [-strict] id timeout cmd...
     # quarantine, mirroring train40m's new-checkpoint rule.
     if [ "$ok" = 0 ]; then
       local rows_after
-      rows_after=$(grep -c '^BENCHCASE ' "$BASE/out/$id.out" 2>/dev/null || echo 0)
+      rows_after=$(grep -c '^BENCHCASE ' "$BASE/out/$id.out" 2>/dev/null)
+      rows_after=${rows_after:-0}
       if [ "$rows_after" -gt "$rows_before" ]; then
         echo "$(stamp) PROGRESS $id rc=$rc ($rows_before -> $rows_after rows)" >> "$LOG"
         return 1
@@ -169,6 +179,23 @@ while :; do
     fi
     case $id in
       train40m) train40m "$t" ;;
+      infbench40m)
+        # On-chip decode/speculative benchmark over the REAL trained 40m
+        # model (VERDICT r4 #7): only meaningful once train40m finished.
+        if train40m_done; then
+          run_one "$id" "$t" python -m \
+            mlx_cuda_distributed_pretraining_tpu.tools.benchmark_inference \
+            --run llama-40m-realtext-tpu --runs-root /tmp/realrun/runs \
+            --prompts /tmp/realrun/data2/val.jsonl --n-prompts 4 \
+            --max-tokens 128 --modes plain,spec,spec-t0.8
+        elif [ "$(nfail train40m)" -ge "$MAX_FAIL" ]; then
+          # train40m quarantined -> this job can never become runnable;
+          # quarantine it too so the loop keeps its termination guarantee.
+          echo x >> "$BASE/fail/$id"
+          echo "$(stamp) FAIL $id (train40m quarantined)" >> "$LOG"
+        else
+          echo "$(stamp) WAIT infbench40m (train40m not done)" >> "$LOG"
+        fi ;;
       breakdown_*) run_one "$id" "$t" python scripts/bench_breakdown.py --scale "${id#breakdown_}" ;;
       sweep_*) run_one -strict "$id" "$t" python scripts/bench_sweep.py \
                  --case "${id#sweep_}_flash" --timeout 600 \

@@ -117,17 +117,30 @@ def main():
     a = ap.parse_args()
 
     rows, device, breakdowns, vocab = {}, None, {}, None
+
+    def fold(case, r):
+        # Clean-beats-preempted applies across EVERY source pair (--also
+        # docs can be previously-merged artifacts that keep preempted
+        # flags): a SIGTERM-truncated row never displaces a clean one.
+        prev = rows.get(case)
+        if (prev is not None and not prev.get("preempted")
+                and r.get("preempted")):
+            return
+        rows[case] = r
+
     for path in a.also:
         if not os.path.exists(path):
             continue
         doc = parse_doc(path)
-        rows.update({r["case"]: r for r in doc.get("matrix", [])
-                     if "case" in r and "skipped" not in r and "error" not in r})
+        for r in doc.get("matrix", []):
+            if "case" in r and "skipped" not in r and "error" not in r:
+                fold(r["case"], r)
         device = doc.get("device") or device
         breakdowns.update(doc.get("breakdowns", {}))
     if os.path.isdir(a.chiprun):
         more, dev = rows_from_one_files(a.chiprun)
-        rows.update(more)
+        for case, r in more.items():
+            fold(case, r)
         device = dev or device
         breakdowns.update(breakdowns_from_out_files(a.chiprun))
 
