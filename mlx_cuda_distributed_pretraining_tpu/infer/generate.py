@@ -167,11 +167,13 @@ def generate_lite(
     t0 = time.perf_counter()
     out: List[int] = []
     logprobs: List[float] = []
+    stopped = False
     for tok, lp in generate_step(
         params, args, prompt_tokens, max_tokens, sampler, logits_processors,
         prefill_step_size, seed, kv_quant=kv_quant,
     ):
         if tok in stop:
+            stopped = True
             break
         out.append(tok)
         logprobs.append(lp)
@@ -181,6 +183,10 @@ def generate_lite(
         "generation_tps": len(out) / dt,
         "mean_logprob": float(np.mean(logprobs)) if logprobs else 0.0,
         "prompt_tokens": float(len(prompt_tokens)),
+        # Distinguishes "decode hit a stop token" from "ran out the token
+        # budget" — a generation that meets EOS exactly at the budget is a
+        # stop, and the serving layer's finish_reason reads this flag.
+        "stopped_on_token": float(stopped),
     }
     if verbose:
         print(f"[generate] {len(out)} tokens at {stats['generation_tps']:.1f} tok/s")
@@ -323,7 +329,8 @@ def generate_speculative(
         return [], {"generation_tokens": 0.0, "generation_tps": 0.0,
                     "mean_logprob": 0.0,
                     "prompt_tokens": float(len(prompt_tokens)),
-                    "verify_calls": 0.0, "tokens_per_call": 0.0}
+                    "verify_calls": 0.0, "tokens_per_call": 0.0,
+                    "stopped_on_token": 0.0}
     tokens = np.asarray(prompt_tokens, np.int32)[None, :]
     P = tokens.shape[1]
     # + k headroom: the last verify window may write past the final token.
@@ -346,6 +353,7 @@ def generate_speculative(
     out: List[int] = [first]
     logprobs: List[float] = [lp_first]
     seq.append(first)
+    stopped = first in stop
 
     pos = P
     calls = 0
@@ -392,6 +400,7 @@ def generate_speculative(
             logprobs.append(float(lp_h[i]))
             seq.append(t)
             if t in stop:
+                stopped = True
                 break
         # Rewind to the slot of the LAST emitted token: its KV was never
         # written (like `first` after prefill, it was an output, not an
@@ -416,6 +425,7 @@ def generate_speculative(
         # Excludes the prefill-produced first token: it cost zero verify
         # calls, so counting it would overstate the speculation payoff.
         "tokens_per_call": round(max(len(out) - 1, 0) / max(calls, 1), 2),
+        "stopped_on_token": float(stopped),
     }
     return out, stats
 

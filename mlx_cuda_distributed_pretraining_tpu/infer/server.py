@@ -86,11 +86,12 @@ class InferenceService:
         # sampling knobs reshape logits fall back to plain decode.
         spec = self.speculative and not (
             q_top_p or q_min_p or (q_rep or 1.0) != 1.0)
+        q_temp = self._quantize(temperature)
         with self.lock:
             text, stats = generate_text(
                 self.params, self.args, self.tokenizer, prompt,
                 max_new_tokens=max_tokens,
-                temperature=self._quantize(temperature),
+                temperature=q_temp,
                 top_p=q_top_p, min_p=q_min_p, repetition_penalty=q_rep,
                 seed=seed, kv_quant=self.kv_quant, return_stats=True,
                 speculative=spec, draft_len=self.draft_len,
@@ -99,6 +100,14 @@ class InferenceService:
             "text": text,
             "tokens": int(stats["generation_tokens"]),
             "speculative": spec,
+            # The params the decode ACTUALLY ran with: client floats are
+            # snapped to a 0.05 grid (see _quantize) and max_tokens is
+            # server-clamped, so a client can see when its request was
+            # adjusted rather than silently served with different knobs.
+            "effective_params": {
+                "temperature": q_temp, "top_p": q_top_p, "min_p": q_min_p,
+                "repetition_penalty": q_rep, "max_tokens": max_tokens,
+            },
             **{k: round(float(v), 4) for k, v in stats.items()},
         }
 
@@ -127,9 +136,14 @@ def _to_openai_completion(out: dict, req: dict, run_name: str,
 
     text = out["text"]
     completion_tokens = out["tokens"]
-    # "length" = the decode hit its budget — the server-clamped budget,
-    # not the raw client value (a cap-limited generation IS truncated).
-    finish = "length" if completion_tokens >= effective_max else "stop"
+    # "stop" when the decode ended on a stop/EOS token (the generator
+    # reports this directly — a generation that meets EOS exactly at the
+    # token budget is a stop, not a truncation); "length" = it ran out
+    # the server-clamped budget (a cap-limited generation IS truncated).
+    if out.get("stopped_on_token"):
+        finish = "stop"
+    else:
+        finish = "length" if completion_tokens >= effective_max else "stop"
     stops = req.get("stop")
     if isinstance(stops, str):
         stops = [stops]

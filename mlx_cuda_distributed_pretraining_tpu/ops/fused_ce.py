@@ -137,7 +137,6 @@ def fused_cross_entropy_sp(
     are just distributed; the psum is the same fp32 sum re-associated per
     device (tests assert loss AND grad parity on a dp x sp mesh).
     """
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     def size(a):
@@ -164,8 +163,8 @@ def fused_cross_entropy_sp(
                                      with_z=True)
         return jax.lax.psum((nll, z), tuple(mesh.axis_names))
 
-    fn = shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
-                   out_specs=(P(), P()), check_rep=False)
+    fn = jax.shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
+                       out_specs=(P(), P()), check_vma=False)
     nll_sum, z_sum = fn(*args)
     if with_z:
         return nll_sum, z_sum

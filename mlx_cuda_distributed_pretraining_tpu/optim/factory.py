@@ -12,7 +12,7 @@ from typing import Any, Optional
 from .adafactor import adafactor
 from .base import Schedule, Transform, partition
 from .enhanced import adam, adamw, lion, sgd
-from .muon import matrix_label_fn, muon
+from .muon import embedding_rest_label_fn, matrix_label_fn, muon
 from .schedules import build_schedule
 from .shampoo import shampoo
 
@@ -96,8 +96,21 @@ def build_optimizer(
         # optimizers/hybrid_optimizer.py).
         matrix_name = str(_opt(training_cfg, "matrix_optimizer", "muon"))
         rest_name = str(_opt(training_cfg, "non_matrix_optimizer", "adamw"))
+        # hybrid_embeddings: "matrix" (default — ndim routing, embeddings
+        # included) or "rest" (Muon-convention: vocab matrices go to the
+        # elementwise optimizer; makes the pairing meaningful on
+        # tied-embedding models where the vocab matrix dominates).
+        emb_to = str(_opt(training_cfg, "hybrid_embeddings", "matrix"))
+        if emb_to not in ("matrix", "rest"):
+            # A typo here would silently reproduce the default routing —
+            # the exact statistically-identical-column failure the knob
+            # exists to fix. Fail at build time instead.
+            raise ValueError(
+                f"hybrid_embeddings must be 'matrix' or 'rest', got {emb_to!r}")
+        label_fn = (embedding_rest_label_fn if emb_to == "rest"
+                    else matrix_label_fn)
         return partition(
-            matrix_label_fn,
+            label_fn,
             {
                 "matrix": build_optimizer(training_cfg, total_steps, matrix_name, schedule),
                 "rest": build_optimizer(training_cfg, total_steps, rest_name, schedule),

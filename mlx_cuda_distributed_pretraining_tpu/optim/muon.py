@@ -94,6 +94,25 @@ def matrix_label_fn(params):
     )
 
 
+def embedding_rest_label_fn(params):
+    """``matrix_label_fn`` variant that also routes embedding / output-head
+    leaves to ``'rest'`` by path — the standard Muon/Shampoo deployment
+    convention (structured preconditioning on hidden matrices only; the
+    vocab-dimension matrices get the elementwise optimizer). With tied
+    embeddings at small scale the vocab matrix is MOST of the params, so a
+    hybrid pairing under this routing gives its second optimizer a
+    meaningful param fraction instead of only norms/biases (hybrid config:
+    ``hybrid_embeddings: rest``)."""
+    base = matrix_label_fn(params)
+
+    def fix(path, label):
+        names = {getattr(k, "key", None) or getattr(k, "name", None)
+                 for k in path}
+        return "rest" if names & {"tok_embeddings", "output"} else label
+
+    return jax.tree_util.tree_map_with_path(fix, base)
+
+
 def muon(
     schedule: Schedule,
     momentum: float = 0.95,
