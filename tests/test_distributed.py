@@ -64,6 +64,7 @@ def test_mesh_axis_sizes():
         mesh_axis_sizes(SystemConfig(seed=0, device="cpu", mesh={"dp": -1, "tp": 3}), 8)
 
 
+@pytest.mark.slow
 def test_dp_matches_single_device():
     """8-way DP step == single-device step on the same global batch."""
     batch = _batch()
@@ -89,6 +90,7 @@ def test_dp_matches_single_device():
 
 
 @pytest.mark.parametrize("mesh_cfg", [{"dp": 2, "tp": 4}, {"dp": 2, "fsdp": 2, "tp": 2}])
+@pytest.mark.slow
 def test_tp_fsdp_matches_single_device(mesh_cfg):
     batch = _batch()
     mesh, step, state, shardings = _setup(mesh_cfg)
@@ -160,6 +162,7 @@ def test_sharding_no_shape_collision():
     assert wq_param.spec != wo_param.spec  # transposed rules really differ
 
 
+@pytest.mark.slow
 def test_sp_fused_ce_matches_dense():
     """Sequence-sharded fused CE (ops/fused_ce.py::fused_cross_entropy_sp,
     auto-routed by llama.loss_fn on sp meshes with tp == 1): loss AND
@@ -204,6 +207,7 @@ def test_sp_fused_ce_matches_dense():
         set_mesh(None)
 
 
+@pytest.mark.slow
 def test_multi_step_sharded_matches_single_dispatch():
     """K scanned steps in ONE dispatch (make_multi_step) on a dp+tp mesh
     == K individual dispatched steps with the same batches (the trainer's

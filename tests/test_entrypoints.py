@@ -1,5 +1,7 @@
 """Shipped configs load; graft entry points run on the CPU mesh."""
 
+import pytest
+
 import glob
 import os
 
@@ -28,6 +30,7 @@ def test_all_shipped_configs_load():
         assert build_optimizer(cfg.training, 100) is not None
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_8():
     import __graft_entry__
 
@@ -44,6 +47,7 @@ def test_entry_compiles():
     assert out is not None
 
 
+@pytest.mark.slow
 def test_bench_subprocess_harness_end_to_end(tmp_path):
     """Drive the real bench.py parent -> probe -> --one child machinery on
     CPU with the CI-only tiny case: the stdout contract line must appear

@@ -1,3 +1,5 @@
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -20,6 +22,7 @@ ARGS = LlamaArgs(
 PARAMS = llama.init_params(jax.random.PRNGKey(0), ARGS)
 
 
+@pytest.mark.slow
 def test_greedy_matches_argmax_full_forward():
     prompt = [1, 5, 9, 3]
     toks, stats = generate_lite(PARAMS, ARGS, prompt, max_tokens=5)
@@ -129,6 +132,7 @@ def test_kv_quant_decode_logits_close_to_full_forward():
     )
 
 
+@pytest.mark.slow
 def test_decode_across_attend_bucket_boundary_matches_full_forward():
     """Decode attends over a power-of-two bucket of the cache; crossing a
     bucket boundary (pos 256) must not change outputs (VERDICT r1 weak #4)."""
@@ -158,6 +162,7 @@ def test_attend_bucket_helper():
     assert _attend_bucket(5000, 6000) == 6000  # clamped to cache
 
 
+@pytest.mark.slow
 def test_moe_decode_matches_full_forward():
     """Cached single-token decode through MoE blocks must equal full-forward
     greedy — expert capacity at S=1 must not silently drop the token."""
@@ -177,6 +182,7 @@ def test_moe_decode_matches_full_forward():
     assert toks == cur[len(prompt):]
 
 
+@pytest.mark.slow
 def test_speculative_matches_greedy_exactly():
     """Prompt-lookup speculative decoding is bit-identical to plain greedy
     decode — the draft only proposes; every emitted token is the model's
@@ -302,6 +308,7 @@ def test_spec_accept_preserves_distribution():
         assert abs(acc_rate - float(probs[draft])) < 0.02
 
 
+@pytest.mark.slow
 def test_speculative_sampling_runs_and_reproduces():
     """temperature > 0 speculation: seeded-reproducible, full stats, and
     the temperature=0 path stays bit-identical to greedy."""

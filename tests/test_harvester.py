@@ -76,6 +76,7 @@ def test_merge_bench_outputs(tmp_path):
 
 
 @pytest.mark.skipif(os.name != "posix", reason="bash required")
+@pytest.mark.slow
 def test_harvester_chain(tmp_path):
     """The full loop on CPU: probe -> run a tiny case -> done-marker ->
     ALL DONE exit; a second run is a no-op thanks to the marker."""

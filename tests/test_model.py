@@ -1,3 +1,5 @@
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -116,6 +118,7 @@ def test_loss_decreases_tiny_overfit():
     assert float(loss) < float(loss0) * 0.7
 
 
+@pytest.mark.slow
 def test_remat_matches_no_remat():
     params = llama.init_params(jax.random.PRNGKey(0), ARGS)
     batch = {
@@ -148,6 +151,7 @@ def _batch_for(args, B=2, S=16, seed=3):
     }
 
 
+@pytest.mark.slow
 def test_fused_ce_matches_unfused_loss_and_grads():
     """Fused chunked CE (ops/fused_ce.py) is exact: same loss and same
     gradients as the materialized-logits path, including a chunk size that
@@ -214,6 +218,7 @@ def test_fused_ce_bit_identical_bf16():
     np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_scan_layers_matches_loop():
     """lax.scan over stacked layers is numerically identical to the
     unrolled Python loop — loss and grads, dense and MoE, with and

@@ -68,6 +68,7 @@ def test_balanced_router_aux_loss_is_one():
     assert float(moe.load_balancing_loss(probs, idx, 4)) == pytest.approx(1.0)
 
 
+@pytest.mark.slow
 def test_moe_forward_and_all_experts_get_gradients():
     params = llama.init_params(jax.random.PRNGKey(0), MOE_ARGS)
     batch = _batch()
@@ -150,6 +151,7 @@ def test_moe_token_grouping_keeps_capacity_bounded():
     assert moe.expert_capacity(8, 4, 2, 1.25) < moe.expert_capacity(32, 4, 2, 1.25)
 
 
+@pytest.mark.slow
 def test_moe_decode_cache_matches_full_forward():
     params = llama.init_params(jax.random.PRNGKey(0), MOE_ARGS)
     tokens = jnp.asarray(np.random.default_rng(1).integers(1, 120, (1, 8)), jnp.int32)
@@ -169,6 +171,7 @@ def test_moe_decode_cache_matches_full_forward():
     )
 
 
+@pytest.mark.slow
 def test_moe_train_step_on_ep_mesh_matches_single_device():
     if jax.device_count() < 4:
         pytest.skip("needs 4 virtual devices")
@@ -202,6 +205,7 @@ def test_moe_train_step_on_ep_mesh_matches_single_device():
     assert spec and spec[0] == "ep", f"expert dim not ep-sharded: {spec}"
 
 
+@pytest.mark.slow
 def test_shampoo_bank_stats_shard_over_ep():
     """Shampoo's per-expert preconditioner stats [E, m, m] must shard over
     ep with their bank, not replicate (parallel/sharding_rules.py

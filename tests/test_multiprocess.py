@@ -119,6 +119,7 @@ def _run_phase(workdir, phase):
     return outs
 
 
+@pytest.mark.slow
 def test_two_process_fsdp_train_save_resume(tmp_path):
     import json as _json
 

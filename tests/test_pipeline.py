@@ -86,6 +86,7 @@ def test_pipeline_loss_matches_single_device():
     np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_grads_match_single_device():
     mesh = _mesh()
     params = llama.init_params(jax.random.PRNGKey(0), ARGS)
@@ -101,6 +102,7 @@ def test_pipeline_grads_match_single_device():
         )
 
 
+@pytest.mark.slow
 def test_pipeline_remat_matches():
     mesh = _mesh()
     params = llama.init_params(jax.random.PRNGKey(0), ARGS)
@@ -114,6 +116,7 @@ def test_pipeline_remat_matches():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_train_step_runs_and_shards():
     from mlx_cuda_distributed_pretraining_tpu.config import TrainingConfig
     from mlx_cuda_distributed_pretraining_tpu.optim import build_optimizer
@@ -155,6 +158,7 @@ def test_pipeline_moe_loss_finite():
     assert float(loss) > float(l_eval)
 
 
+@pytest.mark.slow
 def test_trainer_pipeline_end_to_end(tmp_path):
     """Full Trainer drive over a pp mesh: train, checkpoint, resume."""
     import json

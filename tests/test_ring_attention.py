@@ -4,6 +4,7 @@ exact parity with single-device attention, gradients included."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mlx_cuda_distributed_pretraining_tpu.config import SystemConfig
@@ -69,6 +70,7 @@ def test_ring_gradients_match():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_ring_model_level_end_to_end():
     """Full model with attention_type='ring' on an sp mesh == simple
     attention single device, and a sharded train step executes."""
@@ -136,6 +138,7 @@ def test_flash_raw_entries_reject_non_divisible():
         flash_fwd(q, q, q, block_q=256, block_kv=256)
 
 
+@pytest.mark.slow
 def test_ring_sliding_window_tiled_grads_match():
     """The statically-unrolled tiled sliding-window ring (fwd+bwd custom
     VJP) matches single-device reference gradients, across window sizes

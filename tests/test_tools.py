@@ -221,6 +221,7 @@ def test_inspect_data(tmp_path):
     assert any(f["path"].endswith("c.jsonl") for f in files)
 
 
+@pytest.mark.slow
 def test_compare_optimizers(tmp_path):
     from mlx_cuda_distributed_pretraining_tpu.tools import compare_optimizers
 
@@ -257,6 +258,7 @@ def test_compare_optimizers(tmp_path):
     assert header == ["step", "adamw", "muon"]
 
 
+@pytest.mark.slow
 def test_hf_export_loads_in_transformers_with_matching_logits(trained_run, tmp_path):
     """The strongest parity check: the exported directory loads with real
     ``transformers.LlamaForCausalLM`` (torch CPU) and produces the same
@@ -481,6 +483,7 @@ def test_prepare_dataset_token_shards(tmp_path):
     assert "fox" in text or "Document" in text
 
 
+@pytest.mark.slow
 def test_evaluate_ppl_and_mc(tmp_path):
     """Offline eval tool (reference README.md:110-125 shows an external
     lm-eval ARC-Easy run): ppl over a text file is finite and near-uniform

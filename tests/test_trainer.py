@@ -92,6 +92,7 @@ def test_train_loss_decreases_and_logs(tmp_path):
     assert "step_15_state.json" in ckpts
 
 
+@pytest.mark.slow
 def test_resume_continues(tmp_path):
     cfg = _tiny_config(tmp_path, name="resumable", iters=15)
     tr = Trainer(cfg, runs_root=str(tmp_path / "runs"), quiet=True)
@@ -112,6 +113,7 @@ def test_resume_continues(tmp_path):
     assert "Resumed from checkpoint 15" in log
 
 
+@pytest.mark.slow
 def test_resume_reset_optimizer(tmp_path):
     cfg = _tiny_config(tmp_path, name="reset", iters=10)
     Trainer(cfg, runs_root=str(tmp_path / "runs"), quiet=True).train()
@@ -135,6 +137,7 @@ def test_load_trained_and_generate(tmp_path):
     assert isinstance(text, str)
 
 
+@pytest.mark.slow
 def test_grad_accumulation_equivalence(tmp_path):
     """accum=2 with bs=4 must match accum=1 with bs=4 on the same data
     (same total batch, scan-accumulated grads averaged)."""
@@ -176,6 +179,7 @@ def test_mixed_precision_and_remat_run(tmp_path):
     assert np.isfinite(result["final_loss"])
 
 
+@pytest.mark.slow
 def test_lr_finder(tmp_path):
     cfg = _tiny_config(
         tmp_path, name="lrf", iters=3,
@@ -273,6 +277,7 @@ def test_lr_finder_for_optimizer_uses_real_update_rule(tmp_path):
     assert len(set(out.values())) >= 2, out
 
 
+@pytest.mark.slow
 def test_benchmark_inference_tool(tmp_path):
     """tools/benchmark_inference: runs all modes on a trained run, reports
     per-mode tok/s, and certifies speculative outputs identical to plain."""
@@ -297,6 +302,7 @@ def test_benchmark_inference_tool(tmp_path):
     json.dumps(report)
 
 
+@pytest.mark.slow
 def test_adafactor_checkpoint_resume(tmp_path):
     """Adafactor's factored state (row/col vectors + (1,) placeholders)
     round-trips through save/resume."""
@@ -314,6 +320,7 @@ def test_adafactor_checkpoint_resume(tmp_path):
     assert result["steps"] == 15 and np.isfinite(result["final_loss"])
 
 
+@pytest.mark.slow
 def test_steps_per_dispatch_equivalence(tmp_path):
     """K steps scanned into one dispatch must match K dispatched steps
     exactly (same data order, same schedule counters), with per-step log
@@ -356,6 +363,7 @@ def test_steps_per_dispatch_equivalence(tmp_path):
     assert ca == cb
 
 
+@pytest.mark.slow
 def test_inference_http_server(tmp_path):
     """Train a tiny run, serve it over HTTP (infer/server.py — the
     platform-free analog of the reference's Modal deploy/client apps),
@@ -400,6 +408,24 @@ def test_inference_http_server(tmp_path):
     finally:
         httpd.shutdown()
         httpd.server_close()
+
+
+def test_finish_reason_eos_at_budget():
+    """A generation that hits EOS exactly at the token budget is a 'stop',
+    not a 'length' (ADVICE r4): the generator's stopped_on_token flag wins
+    over the completion_tokens >= budget heuristic."""
+    from mlx_cuda_distributed_pretraining_tpu.infer.server import (
+        _to_openai_completion,
+    )
+
+    base = {"text": "hello", "tokens": 6, "generation_tps": 1.0,
+            "prompt_tokens": 2.0}
+    eos_at_budget = _to_openai_completion(
+        dict(base, stopped_on_token=1.0), {}, "run", effective_max=6)
+    assert eos_at_budget["choices"][0]["finish_reason"] == "stop"
+    ran_out = _to_openai_completion(
+        dict(base, stopped_on_token=0.0), {}, "run", effective_max=6)
+    assert ran_out["choices"][0]["finish_reason"] == "length"
 
 
 def test_openai_completions_route(tmp_path):
