@@ -43,6 +43,13 @@ to cancel the fixed overhead.
 Env knobs: BENCH_CASES (comma list: 2m,40m,100m,400m,650m,1b,simple,
 decode,longctx,trainer; default all; plus CI-only "tiny"), BENCH_STEPS,
 BENCH_VOCAB, BENCH_BUDGET_S.
+
+Harvester fold: at emit time the parent merges any same-vocab rows the
+session's chip harvester captured (``$CHIPRUN_OUT``, default
+/tmp/chiprun/out; disable with BENCH_MERGE_CHIPRUN=0) into the matrix for
+cases this run could not measure itself, tagged ``source: harvester`` with
+per-row device provenance — a tunnel that dies before the driver's run no
+longer erases the session's measurements.
 """
 
 from __future__ import annotations
@@ -150,14 +157,23 @@ def build_doc(matrix, device, vocab, reason, elapsed_s=None):
     """The stdout-contract document. Shared with
     scripts/merge_bench_outputs.py so self-captured artifacts merged from
     ``--one`` runs keep exactly this schema."""
-    flash_2m = next((r for r in matrix if r.get("case") == "2m_flash" and r.get("tok_s")), None)
-    mega_2m = next((r for r in matrix if r.get("case") == "2m_mega" and r.get("tok_s")), None)
+    def _clean(case):
+        # Headline candidates must be complete measurements: a preempted
+        # (SIGTERM-truncated) row may sit in the matrix for transparency
+        # but must never become the doc's headline value.
+        return next((r for r in matrix if r.get("case") == case
+                     and r.get("tok_s") and not r.get("preempted")), None)
+
+    flash_2m = _clean("2m_flash")
+    mega_2m = _clean("2m_mega")
     best_mfu = max((r.get("mfu", 0.0) or 0.0 for r in matrix), default=0.0)
     # Headline prefers the megastep (chip-rate) 2m row when captured: the
     # per-step 2m row's wall clock is dominated by tunnel dispatch RTT
     # (~11ms compute inside a ~195ms step, TUNNEL_NOTE_r4), so it measures
     # the tunnel, not the chip. Both rows stay in the matrix.
     headline = mega_2m or flash_2m \
+        or next((r for r in matrix
+                 if r.get("tok_s") and not r.get("preempted")), None) \
         or next((r for r in matrix if r.get("tok_s")), {"case": "none", "tok_s": 0})
     # vs_baseline (M3-Max 2M anchor) only makes sense for the 2M cases.
     vs = (round(headline["tok_s"] / BASELINE_TOKS_PER_SEC, 3)
@@ -178,6 +194,77 @@ def build_doc(matrix, device, vocab, reason, elapsed_s=None):
     return doc
 
 
+def harvester_case_rows(out_dir) -> dict:
+    """Parse chip-harvester ``--one`` out-files into ``{case: row}``.
+    Shared by emit()'s fold and scripts/merge_bench_outputs.py so the
+    merge policy (CASE_MARK scan, truncated-line skip, clean-beats-
+    preempted) lives in exactly one place. Rows keep their ``device``
+    field; callers hoist or keep it as their artifact needs."""
+    import glob
+
+    found = {}
+    for path in sorted(glob.glob(os.path.join(out_dir, "*.out"))):
+        try:
+            with open(path) as f:
+                for line in f:
+                    if not line.startswith(_CASE_MARK):
+                        continue
+                    try:
+                        r = json.loads(line[len(_CASE_MARK):])
+                    except json.JSONDecodeError:
+                        continue  # line truncated by a mid-write SIGKILL
+                    case = r.get("case")
+                    if not case:
+                        continue
+                    prev = found.get(case)
+                    # A clean row never loses to a preempted one.
+                    if prev is not None and not prev.get("preempted") \
+                            and r.get("preempted"):
+                        continue
+                    found[case] = r
+        except OSError:
+            continue
+    return found
+
+
+def _fold_harvester_rows() -> int:
+    """Fold rows self-captured by scripts/chip_harvester.sh (``--one``
+    out-files under ``$CHIPRUN_OUT``, default ``$CHIPRUN_BASE/out``) into
+    the emitted matrix, so the driver's end-of-round bench run reports
+    every row the session harvested even when the tunnel is dead during
+    the run itself — the r2-r4 failure mode where BENCH_rNN.json recorded
+    value 0 while measured rows sat in /tmp. Only fills cases this run
+    did not measure itself (missing / skipped / error); rows at a
+    DIFFERENT vocab are excluded (keeps CI runs at toy vocabs
+    uncontaminated) but rows with no vocab key (pre-r5 decode rows) are
+    accepted; each folded row is tagged ``source: harvester``."""
+    global _DEVICE
+    if os.environ.get("BENCH_MERGE_CHIPRUN", "1") == "0":
+        return 0
+    out_dir = os.environ.get(
+        "CHIPRUN_OUT",
+        os.path.join(os.environ.get("CHIPRUN_BASE", "/tmp/chiprun"), "out"))
+    if not os.path.isdir(out_dir):
+        return 0
+
+    have = {r.get("case") for r in _MATRIX
+            if r.get("case") and "skipped" not in r and "error" not in r}
+    found = {case: r for case, r in harvester_case_rows(out_dir).items()
+             if case not in have and r.get("vocab") in (None, _VOCAB)}
+    for case, r in found.items():
+        # Keep the row's own device string: when the parent run never saw
+        # the tunnel (device "unknown" or a CI CPU), the folded row's
+        # provenance must stay readable per-row.
+        dev = r.get("device")
+        if dev and _DEVICE == "unknown":
+            _DEVICE = dev
+        r["source"] = "harvester"
+        # A folded measurement replaces this run's skipped/error marker.
+        _MATRIX[:] = [m for m in _MATRIX if m.get("case") != case]
+        _MATRIX.append(r)
+    return len(found)
+
+
 def emit(reason: str = "final") -> None:
     """Print the one-line stdout contract exactly once, from wherever we
     are — normal exit, atexit, or a termination signal."""
@@ -185,8 +272,15 @@ def emit(reason: str = "final") -> None:
     if _EMITTED:
         return
     _EMITTED = True
-    print(json.dumps(build_doc(_MATRIX, _DEVICE, _VOCAB, reason,
-                               elapsed_s=elapsed())), flush=True)
+    folded = 0
+    try:
+        folded = _fold_harvester_rows()
+    except Exception as e:  # noqa: BLE001 - folding must never block emit
+        log(f"[bench] harvester fold failed: {e}")
+    doc = build_doc(_MATRIX, _DEVICE, _VOCAB, reason, elapsed_s=elapsed())
+    if folded:
+        doc["harvester_rows_merged"] = folded
+    print(json.dumps(doc), flush=True)
 
 
 _ACTIVE_CHILD = None  # Popen of the in-flight --one case, if any
@@ -478,6 +572,7 @@ def bench_decode_case(scale_key, vocab, prompt=512, max_len=2048,
     ok = per_step > 1e-6
     return {
         "case": name or f"decode_{scale_key}", "batch": B, "prompt": P,
+        "vocab": vocab,
         "max_len": max_len, "attend_bucket": attend, "kv_int8": quantize,
         "decode_tok_s": round(B / per_step, 1) if ok else None,
         "decode_step_ms": round(per_step * 1e3, 2) if ok else None,

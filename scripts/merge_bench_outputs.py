@@ -27,33 +27,19 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import build_doc
-
-CASE_MARK = "BENCHCASE "
+from bench import build_doc, harvester_case_rows
 
 
 def rows_from_one_files(out_dir):
-    """Case rows from `bench.py --one` outputs. ``device`` is hoisted to
-    the doc level (matching run_case); a ``preempted`` flag is KEPT on the
-    row — it marks a SIGTERM-truncated measurement, and the harvester
-    retries those, so a surviving flag means no clean capture happened."""
-    rows, device = {}, None
-    for path in sorted(glob.glob(os.path.join(out_dir, "*.out"))):
-        with open(path) as f:
-            for line in f:
-                if line.startswith(CASE_MARK):
-                    try:
-                        r = json.loads(line[len(CASE_MARK):])
-                    except json.JSONDecodeError:
-                        continue  # line truncated by a mid-write SIGKILL
-                    if "case" in r:
-                        device = r.pop("device", None) or device
-                        prev = rows.get(r["case"])
-                        # A clean row never loses to a preempted one.
-                        if prev is not None and not prev.get("preempted") \
-                                and r.get("preempted"):
-                            continue
-                        rows[r["case"]] = r
+    """Case rows from `bench.py --one` outputs (parse policy shared with
+    bench.py's emit-time fold — bench.harvester_case_rows). ``device`` is
+    hoisted to the doc level (matching run_case); a ``preempted`` flag is
+    KEPT on the row — it marks a SIGTERM-truncated measurement, and the
+    harvester retries those, so a surviving flag means no clean capture
+    happened."""
+    rows, device = harvester_case_rows(out_dir), None
+    for r in rows.values():
+        device = r.pop("device", None) or device
     return rows, device
 
 
