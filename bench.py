@@ -194,16 +194,26 @@ def build_doc(matrix, device, vocab, reason, elapsed_s=None):
     return doc
 
 
-def harvester_case_rows(out_dir) -> dict:
+def harvester_case_rows(out_dir, max_age_s=None) -> dict:
     """Parse chip-harvester ``--one`` out-files into ``{case: row}``.
     Shared by emit()'s fold and scripts/merge_bench_outputs.py so the
     merge policy (CASE_MARK scan, truncated-line skip, clean-beats-
     preempted) lives in exactly one place. Rows keep their ``device``
-    field; callers hoist or keep it as their artifact needs."""
+    field; callers hoist or keep it as their artifact needs.
+    ``max_age_s`` skips out-files whose mtime is older — a freshness
+    horizon so rows from a previous round are never mistaken for this
+    round's (the harvester also archives cross-round files at startup;
+    this is defense in depth)."""
     import glob
 
     found = {}
     for path in sorted(glob.glob(os.path.join(out_dir, "*.out"))):
+        try:
+            if max_age_s is not None \
+                    and time.time() - os.path.getmtime(path) > max_age_s:
+                continue
+        except OSError:
+            continue
         try:
             with open(path) as f:
                 for line in f:
@@ -247,10 +257,17 @@ def _fold_harvester_rows() -> int:
     if not os.path.isdir(out_dir):
         return 0
 
+    # A preempted own-run row does NOT count as measured: a clean
+    # harvester capture of the same case may replace it.
     have = {r.get("case") for r in _MATRIX
-            if r.get("case") and "skipped" not in r and "error" not in r}
-    found = {case: r for case, r in harvester_case_rows(out_dir).items()
-             if case not in have and r.get("vocab") in (None, _VOCAB)}
+            if r.get("case") and "skipped" not in r and "error" not in r
+            and not r.get("preempted")}
+    max_age_s = 3600.0 * float(os.environ.get("BENCH_CHIPRUN_MAX_AGE_H", "18"))
+    found = {case: r
+             for case, r in harvester_case_rows(out_dir,
+                                                max_age_s=max_age_s).items()
+             if case not in have and r.get("vocab") in (None, _VOCAB)
+             and not r.get("preempted")}
     for case, r in found.items():
         # Keep the row's own device string: when the parent run never saw
         # the tunnel (device "unknown" or a CI CPU), the folded row's
@@ -908,11 +925,13 @@ def run_case(case_id, reserve, inproc_thunk=None):
                         f"stderr tail: {err[-300:]}")
                 r = json.loads(line[len(_CASE_MARK):])
                 _DEVICE = r.pop("device", _DEVICE)
-            if r.pop("preempted", False):
+            if r.get("preempted"):
                 # The child's Trainer consumed a SIGTERM meant for the whole
                 # bench: stop launching cases and let emit() report what we
                 # have (in subprocess mode the child's _TERMINATING flag
                 # cannot reach us directly, so it rides the result dict).
+                # The flag STAYS on the row — build_doc's headline guard
+                # and the fold's clean-beats-preempted policy read it.
                 _TERMINATING = True
             _MATRIX.append(r)
             log(f"[bench] {json.dumps(r)}")

@@ -32,6 +32,24 @@ mkdir -p "$BASE/out" "$BASE/done" "$BASE/fail"
 LOG=$BASE/log
 cd "$REPO"
 
+# Cross-round hygiene: /tmp survives between rounds, and bench.py's
+# emit-time fold reads $BASE/out — rows measured by a PREVIOUS round's
+# code must never be published as this round's results. The driver
+# appends the round number to PROGRESS.jsonl; when it moved on, archive
+# the old round's out-files and reset per-round job state.
+ROUND=$(grep -o '"round": *[0-9]*' "$REPO/PROGRESS.jsonl" 2>/dev/null \
+        | tail -1 | grep -o '[0-9]*$')
+if [ -n "$ROUND" ]; then
+  PREV=$(cat "$BASE/round" 2>/dev/null)
+  if [ -n "$PREV" ] && [ "$PREV" != "$ROUND" ]; then
+    mkdir -p "$BASE/stale_r$PREV"
+    mv "$BASE"/out/* "$BASE/stale_r$PREV/" 2>/dev/null
+    rm -f "$BASE"/done/* "$BASE"/fail/*
+    echo "$(date -u +"%F %T") archived round-$PREV out-files" >> "$LOG"
+  fi
+  echo "$ROUND" > "$BASE/round"
+fi
+
 # Priority order = VERDICT r3 asks: complete the scale matrix first, then
 # the MFU attribution breakdowns, then the on-chip real-text training run,
 # then decode/longctx/1b rows, then comparison variants.

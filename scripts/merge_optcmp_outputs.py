@@ -34,9 +34,15 @@ def main(out_dir: str, in_dirs: list) -> None:
                          if r[j + 1] not in ("", "None")]
         for sub in os.listdir(d):
             if sub.startswith("lr_finder_"):
+                src = os.path.join(d, sub)
                 dst = os.path.join(out_dir, sub)
+                # In-place merge (out_dir listed among in_dirs) must not
+                # rmtree the source it is about to copy; realpath so a
+                # symlinked alias of the same directory is caught too.
+                if os.path.realpath(src) == os.path.realpath(dst):
+                    continue
                 shutil.rmtree(dst, ignore_errors=True)
-                shutil.copytree(os.path.join(d, sub), dst)
+                shutil.copytree(src, dst)
 
     with open(os.path.join(out_dir, "optimizer_comparison.json"), "w") as f:
         json.dump(summary, f, indent=2)

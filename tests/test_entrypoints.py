@@ -65,6 +65,9 @@ def test_bench_emit_folds_harvester_rows(tmp_path):
          "device": "FakeTPU:0"},
         # legacy row with no vocab key (pre-r5 decode format): accepted
         {"case": "decode_100m", "decode_tok_s": 1e4, "device": "FakeTPU:0"},
+        # only a preempted (truncated) capture exists: never folded
+        {"case": "650m_flash", "tok_s": 9.0, "vocab": 512,
+         "preempted": True, "device": "FakeTPU:0"},
     ]
     with open(out / "mixed.out", "w") as f:
         for r in rows:
@@ -86,6 +89,7 @@ def test_bench_emit_folds_harvester_rows(tmp_path):
     assert doc["harvester_rows_merged"] == 3
     assert "100m_flash" not in by_case  # vocab filter
     assert by_case["decode_100m"]["source"] == "harvester"  # legacy no-vocab
+    assert "650m_flash" not in by_case  # preempted-only capture: not folded
     assert by_case["40m_flash"]["tok_s"] == 2e5  # clean beat preempted
     assert by_case["2m_mega"]["source"] == "harvester"
     assert by_case["2m_mega"]["device"] == "FakeTPU:0"  # per-row provenance
