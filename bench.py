@@ -41,8 +41,10 @@ decode/prefill additionally use a two-point (T(n_hi)-T(n_lo)) difference
 to cancel the fixed overhead.
 
 Env knobs: BENCH_CASES (comma list: 2m,40m,100m,400m,650m,1b,simple,
-decode,longctx,trainer; default all; plus CI-only "tiny"), BENCH_STEPS,
-BENCH_VOCAB, BENCH_BUDGET_S.
+decode,serve,longctx,trainer; default all; plus CI-only "tiny"),
+BENCH_STEPS, BENCH_VOCAB, BENCH_BUDGET_S. The "serve" family compares
+the continuous-batching engine (serve/) against the locked server path
+at occupancy 1/4/8 — a scheduling comparison that is meaningful on CPU.
 
 Harvester fold: at emit time the parent merges any same-vocab rows the
 session's chip harvester captured (``$CHIPRUN_OUT``, default
@@ -184,6 +186,14 @@ def build_doc(matrix, device, vocab, reason, elapsed_s=None):
         "value": headline.get("tok_s", 0),
         "unit": "tok/s",
         "vs_baseline": vs,
+        # The basis travels with the ratio: which row was compared against
+        # which anchor. A bare vs_baseline number has repeatedly been
+        # misread as "this device vs that device at equal config".
+        "vs_baseline_basis": (
+            {"case": headline["case"],
+             "baseline_tok_s": BASELINE_TOKS_PER_SEC,
+             "baseline": "reference M3-Max 2M run (reference README.md:60)"}
+            if vs is not None else None),
         "device": device,
         "best_mfu": best_mfu,
         "emit_reason": reason,
@@ -246,8 +256,12 @@ def _fold_harvester_rows() -> int:
     value 0 while measured rows sat in /tmp. Only fills cases this run
     did not measure itself (missing / skipped / error); rows at a
     DIFFERENT vocab are excluded (keeps CI runs at toy vocabs
-    uncontaminated) but rows with no vocab key (pre-r5 decode rows) are
-    accepted; each folded row is tagged ``source: harvester``."""
+    uncontaminated). Rows with no vocab key are accepted for ``decode_*``
+    cases only (pre-r5 decode rows never stamped one) and are stamped
+    ``vocab: "unknown"`` so the provenance stays visible in the folded
+    matrix; a vocab-less row of any other family is dropped rather than
+    silently assumed to match this run's vocab. Each folded row is
+    tagged ``source: harvester``."""
     global _DEVICE
     if os.environ.get("BENCH_MERGE_CHIPRUN", "1") == "0":
         return 0
@@ -263,12 +277,21 @@ def _fold_harvester_rows() -> int:
             if r.get("case") and "skipped" not in r and "error" not in r
             and not r.get("preempted")}
     max_age_s = 3600.0 * float(os.environ.get("BENCH_CHIPRUN_MAX_AGE_H", "18"))
+    def _vocab_ok(case: str, r: dict) -> bool:
+        if r.get("vocab") == _VOCAB:
+            return True
+        # Legacy vocab-less rows: only the decode family predates the
+        # vocab stamp — anything else with no vocab is unattributable.
+        return r.get("vocab") is None and case.startswith("decode")
+
     found = {case: r
              for case, r in harvester_case_rows(out_dir,
                                                 max_age_s=max_age_s).items()
-             if case not in have and r.get("vocab") in (None, _VOCAB)
+             if case not in have and _vocab_ok(case, r)
              and not r.get("preempted")}
     for case, r in found.items():
+        if r.get("vocab") is None:
+            r["vocab"] = "unknown"
         # Keep the row's own device string: when the parent run never saw
         # the tunnel (device "unknown" or a CI CPU), the folded row's
         # provenance must stay readable per-row.
@@ -597,6 +620,75 @@ def bench_decode_case(scale_key, vocab, prompt=512, max_len=2048,
     }
 
 
+def bench_serve_case(vocab, name="serve_batch"):
+    """Continuous-batching engine (serve/) vs the locked single-request
+    path at occupancy 1/4/8. Both sides run the 2m shape, the same
+    64-token prompts and 32 greedy new tokens, warmed compiles; the
+    locked figure is 8 SEQUENTIAL generations (exactly what the locked
+    server does with 8 concurrent clients). Meaningful on CPU — the
+    acceptance bar is batch >= 3x locked at occupancy 8."""
+    import threading as _threading  # noqa: F401 - parity with server usage
+
+    import jax
+    import numpy as np
+
+    from mlx_cuda_distributed_pretraining_tpu.infer.generate import (
+        generate_lite,
+    )
+    from mlx_cuda_distributed_pretraining_tpu.models import llama
+    from mlx_cuda_distributed_pretraining_tpu.serve import (
+        BatchEngine,
+        EngineConfig,
+    )
+
+    sc = SCALES["2m"]
+    P, NEW, MAX_LEN = 64, 32, 256
+    args = llama.LlamaArgs(
+        vocab_size=vocab, max_position_embeddings=MAX_LEN, **sc["shape"])
+    params = llama.init_params(jax.random.PRNGKey(0), args)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(2, vocab, size=P).tolist() for _ in range(8)]
+
+    # locked baseline: sequential — the lock serializes concurrent
+    # clients, so wall clock is the sum either way.
+    generate_lite(params, args, prompts[0], max_tokens=NEW)  # compile
+    t0 = time.perf_counter()
+    for ids in prompts:
+        generate_lite(params, args, ids, max_tokens=NEW)
+    locked_tok_s = len(prompts) * NEW / (time.perf_counter() - t0)
+
+    class _IdTok:
+        """Token-id passthrough: the bench feeds raw ids (no text), and
+        eos -1 never matches so every request runs its full budget."""
+        bos_id, eos_id = 1, -1
+
+        def tokenize(self, s):
+            return []
+
+        def detokenize(self, ids):
+            return ""
+
+    eng = BatchEngine(params, args, _IdTok(),
+                      EngineConfig(num_slots=8, max_len=MAX_LEN,
+                                   prefill_chunk=64)).start()
+    try:
+        eng._submit_ids(prompts[0], NEW, 0.0, 0).wait(600)  # compile
+        row = {"case": name, "vocab": vocab, "prompt": P, "new_tokens": NEW,
+               "num_slots": 8, "locked_tok_s": round(locked_tok_s, 1)}
+        for occ in (1, 4, 8):
+            t0 = time.perf_counter()
+            reqs = [eng._submit_ids(ids, NEW, 0.0, 0)
+                    for ids in prompts[:occ]]
+            for r in reqs:
+                r.wait(600)
+            dt = time.perf_counter() - t0
+            row[f"batch_tok_s_occ{occ}"] = round(occ * NEW / dt, 1)
+        row["speedup_8"] = round(row["batch_tok_s_occ8"] / locked_tok_s, 2)
+    finally:
+        eng.stop()
+    return row
+
+
 def bench_trainer_case(vocab, workdir="/tmp/bench_trainer", spd=1):
     """End-to-end Trainer on-chip (40M, flash, bf16, token-shard data):
     proves the input pipeline keeps the device fed (tok/s must be within
@@ -712,6 +804,10 @@ def build_plan(vocab, steps):
          lambda: bench_train_case("2m_mega", "2m", "flash", vocab,
                                   max(steps, 20), megastep=20), 100),
         ("decode_2m", "decode", lambda: bench_decode_case("2m", vocab), 120),
+        # serve_batch is CPU-meaningful (continuous batching vs the lock
+        # is a scheduling win, not a chip win) and cheap: keep it with the
+        # early diverse families.
+        ("serve_batch", "serve", lambda: bench_serve_case(vocab), 180),
         ("100m_flash", "100m",
          lambda: bench_train_case("100m_flash", "100m", "flash", vocab, steps), 150),
         ("40m_flash", "40m",
@@ -967,7 +1063,8 @@ def main() -> None:
     _VOCAB = vocab = int(os.environ.get("BENCH_VOCAB", "32768"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     cases_env = os.environ.get(
-        "BENCH_CASES", "2m,40m,100m,400m,650m,1b,simple,decode,longctx,trainer")
+        "BENCH_CASES",
+        "2m,40m,100m,400m,650m,1b,simple,decode,serve,longctx,trainer")
     wanted = set(cases_env.split(","))
     inproc = os.environ.get("BENCH_INPROC") == "1"
 

@@ -34,5 +34,13 @@ if _os.environ.get("JAX_PLATFORMS") == "cpu":
 
         if not _xb.backends_are_initialized():
             _xb._backend_factories.pop("axon", None)
-    except Exception:  # noqa: BLE001 - guard must never break imports
-        pass
+    except Exception as _e:  # noqa: BLE001 - guard must never break imports
+        # Swallowing silently cost a debugging session once: when this
+        # guard fails the process can hang later inside TPU backend init
+        # with no clue. One line to stderr keeps the guard harmless but
+        # diagnosable.
+        import sys as _sys
+
+        print(f"mlx_cuda_distributed_pretraining_tpu: CPU-only guard "
+              f"failed ({type(_e).__name__}: {_e}); TPU plugin may still "
+              f"register", file=_sys.stderr)

@@ -13,8 +13,19 @@ CLI uses, plus a tiny urllib client helper.
       -> {"text": ..., "tokens": N, "generation_tps": ..., "logprob": ...}
     GET /healthz -> {"status": "ok", "model": ..., "params_m": ...}
 
-Generation is serialized by a lock (one chip, one compiled decode);
-concurrent requests queue. The first request pays the jit compile.
+Two engines (``--engine``):
+
+- ``locked`` (default) — generation serialized by a lock (one chip, one
+  compiled decode); concurrent requests queue. Byte-compatible with the
+  pre-engine server.
+- ``batch`` — the continuous-batching engine (serve/): concurrent
+  requests share one batched decode step over a slotted KV pool. A full
+  admission queue returns 429; a missed deadline returns 504. Requests
+  whose effective sampling knobs reshape logits (top_p / min_p /
+  repetition_penalty) fall back to the locked path — the batched step
+  samples by temperature only.
+
+The first request pays the jit compile either way.
 """
 
 from __future__ import annotations
@@ -27,6 +38,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..models import llama
+from ..serve.scheduler import QueueFullError
 from .generate import generate_text
 
 
@@ -47,6 +59,29 @@ class InferenceService:
         self.lock = threading.Lock()
         self.n_params = llama.num_params(params)
         self.started_at = int(time.time())
+        self.engine = None  # set by attach_engine (--engine batch)
+
+    def attach_engine(self, cfg=None) -> "object":
+        """Start the continuous-batching engine (serve/) and route
+        compatible requests through it. The locked path stays available
+        for logit-reshaping sampling knobs."""
+        from ..serve import BatchEngine, EngineConfig
+
+        if cfg is None:
+            cfg = EngineConfig(kv_quant=self.kv_quant)
+        if cfg.max_len > self.args.max_position_embeddings:
+            import dataclasses
+
+            cfg = dataclasses.replace(
+                cfg, max_len=self.args.max_position_embeddings)
+        self.engine = BatchEngine(self.params, self.args, self.tokenizer,
+                                  cfg).start()
+        return self.engine
+
+    def close(self) -> None:
+        if self.engine is not None:
+            self.engine.stop()
+            self.engine = None
 
     @classmethod
     def from_run(cls, run: str, runs_root: str = "runs",
@@ -73,7 +108,8 @@ class InferenceService:
                  temperature: float = 0.0, top_p: float = 0.0,
                  min_p: float = 0.0,
                  repetition_penalty: Optional[float] = None,
-                 seed: int = 0) -> dict:
+                 seed: int = 0,
+                 deadline_s: Optional[float] = None) -> dict:
         # Cap: an unbounded client value would allocate a huge KV cache
         # while holding the lock (XLA OOM can abort the process).
         max_tokens = max(1, min(int(max_tokens), self.max_tokens_limit))
@@ -81,12 +117,32 @@ class InferenceService:
         q_min_p = self._quantize(min_p)
         q_rep = (self._quantize(repetition_penalty)
                  if repetition_penalty else None)
-        # Speculation accelerates exact greedy/temperature decoding only;
-        # requests whose EFFECTIVE (post-quantization, no-op-filtered)
-        # sampling knobs reshape logits fall back to plain decode.
-        spec = self.speculative and not (
-            q_top_p or q_min_p or (q_rep or 1.0) != 1.0)
+        # Effective (post-quantization, no-op-filtered) knobs that reshape
+        # logits: they gate BOTH speculative decoding and the batch engine
+        # (the batched step samples by temperature only).
+        reshapes = bool(q_top_p or q_min_p or (q_rep or 1.0) != 1.0)
+        spec = self.speculative and not reshapes
         q_temp = self._quantize(temperature)
+        if self.engine is not None and not reshapes:
+            out = self.engine.generate(prompt, max_tokens=max_tokens,
+                                       temperature=q_temp, seed=seed,
+                                       deadline_s=deadline_s)
+            stats_keys = ("generation_tokens", "generation_tps",
+                          "mean_logprob", "prompt_tokens",
+                          "stopped_on_token", "ttft_ms")
+            return {
+                "text": out["text"],
+                "tokens": int(out["tokens"]),
+                "engine": "batch",
+                "finish_reason": out.get("finish_reason"),
+                "effective_params": {
+                    "temperature": q_temp, "top_p": q_top_p,
+                    "min_p": q_min_p, "repetition_penalty": q_rep,
+                    "max_tokens": max_tokens,
+                },
+                **{k: round(float(out[k]), 4)
+                   for k in stats_keys if k in out},
+            }
         with self.lock:
             text, stats = generate_text(
                 self.params, self.args, self.tokenizer, prompt,
@@ -112,7 +168,7 @@ class InferenceService:
         }
 
     def health(self) -> dict:
-        return {
+        d = {
             "status": "ok",
             "run": self.run_name,
             "architecture": "llama",
@@ -123,6 +179,17 @@ class InferenceService:
             "speculative": self.speculative,
             "draft_len": self.draft_len,
         }
+        # Locked mode keeps the pre-engine health shape byte-for-byte;
+        # batch mode advertises itself plus a live metrics snapshot.
+        if self.engine is not None:
+            d["engine"] = "batch"
+            d["serve"] = self.engine.metrics()
+        return d
+
+    def metrics(self) -> dict:
+        if self.engine is not None:
+            return self.engine.metrics()
+        return {"engine": "locked"}
 
 
 def _to_openai_completion(out: dict, req: dict, run_name: str,
@@ -184,6 +251,8 @@ def make_handler(service: InferenceService):
             path = self.path.rstrip("/")
             if path in ("", "/healthz"):
                 self._reply(200, service.health())
+            elif path == "/metrics":
+                self._reply(200, service.metrics())
             elif path == "/v1/models":
                 # OpenAI clients list models before completing against one.
                 self._reply(200, {
@@ -221,6 +290,7 @@ def make_handler(service: InferenceService):
                 effective_max = max(
                     1, min(int(req.get("max_tokens", 64)),
                            service.max_tokens_limit))
+                dl = req.get("deadline_s")
                 out = service.generate(
                     prompt=prompt,
                     max_tokens=effective_max,
@@ -229,6 +299,7 @@ def make_handler(service: InferenceService):
                     min_p=float(req.get("min_p", 0.0)),
                     repetition_penalty=float(rp) if rp is not None else None,
                     seed=int(req.get("seed", 0)),
+                    deadline_s=float(dl) if dl is not None else None,
                 )
                 if path == "/v1/completions":
                     out = _to_openai_completion(
@@ -236,6 +307,11 @@ def make_handler(service: InferenceService):
                         tokenizer=service.tokenizer,
                         effective_max=effective_max)
                 self._reply(200, out)
+            except QueueFullError as e:
+                self._reply(429, {"error": str(e)})
+            except TimeoutError as e:
+                # Batch-engine deadline eviction (partial tokens dropped).
+                self._reply(504, {"error": str(e)})
             except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
                 self._reply(400, {"error": str(e)})
             except Exception as e:  # noqa: BLE001 - surface, don't kill the server
@@ -282,6 +358,24 @@ def main(argv=None) -> int:
                    help="prompt-lookup speculative decoding for greedy/"
                         "temperature requests (>1 token per device step)")
     p.add_argument("--draft-len", type=int, default=8)
+    p.add_argument("--engine", choices=("locked", "batch"), default="locked",
+                   help="locked = one request at a time behind a lock "
+                        "(default, byte-compatible); batch = continuous-"
+                        "batching engine over a slotted KV pool")
+    p.add_argument("--slots", type=int, default=8,
+                   help="batch engine: concurrent decode slots")
+    p.add_argument("--kv-len", type=int, default=2048,
+                   help="batch engine: per-slot KV length (clamped to the "
+                        "model's max_position_embeddings)")
+    p.add_argument("--max-queue", type=int, default=32,
+                   help="batch engine: admission queue depth before 429")
+    p.add_argument("--prefill-chunk", type=int, default=256,
+                   help="batch engine: prompt tokens prefilled per iteration")
+    p.add_argument("--deadline-s", type=float, default=None,
+                   help="batch engine: default per-request deadline")
+    p.add_argument("--stats-url", default=None,
+                   help="batch engine: ws:// URL of the obs stats server "
+                        "for per-iteration serving metrics")
     a = p.parse_args(argv)
 
     service = InferenceService.from_run(a.run, a.runs_root,
@@ -289,13 +383,22 @@ def main(argv=None) -> int:
                                         max_tokens_limit=a.max_tokens_limit,
                                         speculative=a.spec,
                                         draft_len=a.draft_len)
+    if a.engine == "batch":
+        from ..serve import EngineConfig
+
+        service.attach_engine(EngineConfig(
+            num_slots=a.slots, max_len=a.kv_len, max_queue=a.max_queue,
+            prefill_chunk=a.prefill_chunk, kv_quant=a.kv_quant,
+            default_deadline_s=a.deadline_s, stats_url=a.stats_url))
     httpd = ThreadingHTTPServer((a.host, a.port), make_handler(service))
-    print(f"serving {a.run} ({service.n_params / 1e6:.1f}M params) "
-          f"on http://{a.host}:{httpd.server_address[1]}")
+    print(f"serving {a.run} ({service.n_params / 1e6:.1f}M params, "
+          f"engine={a.engine}) on http://{a.host}:{httpd.server_address[1]}")
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
         pass
+    finally:
+        service.close()
     return 0
 
 

@@ -71,10 +71,14 @@ class StatsState:
 
     def aggregated(self) -> Dict[str, Any]:
         """Cross-worker aggregate: mean loss, summed throughput, max step
-        (reference: stats_client.py collector aggregates per-worker)."""
+        (reference: stats_client.py collector aggregates per-worker).
+        Serving engines (serve/engine.py) report through the same
+        protocol; their gauges aggregate under ``serve_*`` keys ONLY when
+        present, so training-only runs keep the original shape."""
         losses, toks = [], 0.0
         max_step = 0
         alive = 0
+        queue_depth, occupancy, serve_workers = 0, 0, 0
         now = time.time()
         for w in self.workers.values():
             m = w.get("metrics", {})
@@ -86,13 +90,22 @@ class StatsState:
                 toks += float(m["tok/s"])
             if isinstance(w.get("step"), int):
                 max_step = max(max_step, w["step"])
-        return {
+            if isinstance(m.get("batch_occupancy"), (int, float)):
+                serve_workers += 1
+                occupancy += int(m["batch_occupancy"])
+                queue_depth += int(m.get("queue_depth", 0) or 0)
+        agg = {
             "num_workers": len(self.workers),
             "alive_workers": alive,
             "mean_loss": sum(losses) / len(losses) if losses else None,
             "total_tok_s": toks,
             "max_step": max_step,
         }
+        if serve_workers:
+            agg["serve_engines"] = serve_workers
+            agg["serve_occupancy"] = occupancy
+            agg["serve_queue_depth"] = queue_depth
+        return agg
 
     def snapshot(self) -> Dict[str, Any]:
         return {

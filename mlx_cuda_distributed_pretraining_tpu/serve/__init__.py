@@ -1,0 +1,32 @@
+"""Continuous-batching inference engine (Orca/vLLM-style).
+
+The locked HTTP server (infer/server.py) serializes every request behind
+one lock — throughput is one sequence at a time. This subsystem serves
+many requests concurrently from ONE compiled decode step:
+
+- ``kv_pool``   — slotted KV-cache pool: one preallocated
+  ``[num_slots, max_len, heads, dim]`` buffer per layer with per-slot
+  position state and allocate/free/reset (optional int8 slots via the
+  existing KV-quant path);
+- ``batch_step`` — the jitted batched decode step (every occupied slot
+  advances one token per iteration; free slots are padded/masked so the
+  compiled shape never changes) plus chunked prefill that writes a new
+  request into its slot without stalling in-flight decodes;
+- ``scheduler`` — admission queue with max-depth rejection (429),
+  per-request deadlines/max-token limits, iteration-level join/evict;
+- ``engine``    — the background engine thread tying it together, with
+  per-iteration metrics published through the obs stats protocol.
+"""
+
+from .engine import BatchEngine, EngineConfig, QueueFullError
+from .kv_pool import SlotKVPool
+from .scheduler import Request, Scheduler
+
+__all__ = [
+    "BatchEngine",
+    "EngineConfig",
+    "QueueFullError",
+    "Request",
+    "Scheduler",
+    "SlotKVPool",
+]

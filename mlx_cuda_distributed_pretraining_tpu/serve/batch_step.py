@@ -1,0 +1,310 @@
+"""Jitted steps for the continuous-batching engine.
+
+Two compiled functions drive the whole engine:
+
+- ``decode_step`` advances EVERY pool slot one token in one dispatch.
+  Each row carries its own position (requests join mid-flight at
+  different depths), so RoPE and the cache write are per-row: rotation
+  tables are computed from a ``[num_slots]`` position vector and the KV
+  write is a row-wise scatter ``cache.at[row, pos[row]]``. Free /
+  still-prefilling rows ride along masked: the host points them at the
+  reserved junk position (``max_len - 1``) with token 0 and discards
+  their outputs — the compiled shape never changes with occupancy.
+
+- ``prefill_step`` writes one chunk of one request's prompt into its
+  slot. Chunks are fixed-size (compile-once per attend bucket); the last
+  chunk is padded and the true-last-token logits row is selected by a
+  traced index. Junk written past the true length is overwritten by
+  decode before it can ever be attended — the same invariant the
+  single-sequence bucketed prefill relies on (infer/generate.py).
+
+Numerics deliberately replicate the locked decode path op-for-op
+(llama building blocks, fp32 compute, the same positional validity
+mask), so batch-1 greedy output is token-identical to ``generate_text``
+(tests/test_serve.py). Sampling is greedy/temperature per slot — the
+same per-request rng chain (split-then-sample per token) as
+``generate_step``, vmapped over rows.
+
+Like infer/generate.py, compiled steps are cached per (args, shape
+bucket); attend lengths are power-of-two buckets so a long-serving
+engine compiles O(log max_len) variants, not one per position.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..infer.generate import _attend_bucket, _round_up
+from ..models import llama
+from ..ops.attention import reference_attention
+
+_STEP_CACHE: Dict[Any, Any] = {}
+
+# Re-exported so the scheduler/engine size buckets the same way the
+# single-sequence generator does.
+attend_bucket = _attend_bucket
+round_up = _round_up
+
+
+def _rope_rows(x: jnp.ndarray, positions: jnp.ndarray,
+               args: llama.LlamaArgs) -> jnp.ndarray:
+    """Per-row RoPE: ``x [B, S, H, D]`` rotated by ``positions [B, S]``.
+
+    Elementwise-identical to ``rope_cos_sin`` + ``apply_rope`` (which
+    take one shared position vector); only the broadcast differs."""
+    pos = positions.astype(jnp.float32)
+    if args.rope_scaling_factor:
+        pos = pos / args.rope_scaling_factor
+    Dh = args.head_dim
+    inv_freq = 1.0 / (args.rope_theta
+                      ** (jnp.arange(0, Dh, 2, dtype=jnp.float32) / Dh))
+    angles = pos[:, :, None] * inv_freq[None, None, :]  # [B, S, Dh//2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    if args.rope_traditional:
+        x1 = xf[..., 0::2]
+        x2 = xf[..., 1::2]
+        out = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                        axis=-1).reshape(x.shape)
+    else:
+        half = x.shape[-1] // 2
+        x1 = xf[..., :half]
+        x2 = xf[..., half:]
+        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                              axis=-1)
+    return out.astype(dtype)
+
+
+def _write_kv_rows(layer_cache, k, v, rows, pos):
+    """Scatter decode K/V ``[B, 1, H, D]`` at per-row positions; returns
+    (new_layer_cache, keys_fp, values_fp) with the full-buffer fp views."""
+    if "k_q" in layer_cache:
+        kq, ks = llama._quantize_kv(k)
+        vq, vs = llama._quantize_kv(v)
+        new = {
+            "k_q": layer_cache["k_q"].at[rows, pos].set(kq[:, 0]),
+            "k_s": layer_cache["k_s"].at[rows, pos].set(ks[:, 0]),
+            "v_q": layer_cache["v_q"].at[rows, pos].set(vq[:, 0]),
+            "v_s": layer_cache["v_s"].at[rows, pos].set(vs[:, 0]),
+        }
+        keys = new["k_q"].astype(jnp.float32) * new["k_s"]
+        values = new["v_q"].astype(jnp.float32) * new["v_s"]
+    else:
+        dt = layer_cache["k"].dtype
+        new = {
+            "k": layer_cache["k"].at[rows, pos].set(k[:, 0].astype(dt)),
+            "v": layer_cache["v"].at[rows, pos].set(v[:, 0].astype(dt)),
+        }
+        keys, values = new["k"], new["v"]
+    return new, keys, values
+
+
+def _write_kv_slot(layer_cache, k, v, slot, pos):
+    """Write a prefill chunk ``[1, C, H, D]`` into one slot at ``pos``;
+    returns (new_layer_cache, keys_fp [1, T, H, D], values_fp)."""
+    if "k_q" in layer_cache:
+        kq, ks = llama._quantize_kv(k)
+        vq, vs = llama._quantize_kv(v)
+        dus = jax.lax.dynamic_update_slice
+        new = {
+            "k_q": dus(layer_cache["k_q"], kq, (slot, pos, 0, 0)),
+            "k_s": dus(layer_cache["k_s"], ks, (slot, pos, 0, 0)),
+            "v_q": dus(layer_cache["v_q"], vq, (slot, pos, 0, 0)),
+            "v_s": dus(layer_cache["v_s"], vs, (slot, pos, 0, 0)),
+        }
+        T = new["k_q"].shape[1]
+        sl = lambda a: jax.lax.dynamic_slice(
+            a, (slot, 0, 0, 0), (1,) + a.shape[1:])
+        keys = sl(new["k_q"]).astype(jnp.float32) * sl(new["k_s"])
+        values = sl(new["v_q"]).astype(jnp.float32) * sl(new["v_s"])
+        del T
+    else:
+        dt = layer_cache["k"].dtype
+        dus = jax.lax.dynamic_update_slice
+        new = {
+            "k": dus(layer_cache["k"], k.astype(dt), (slot, pos, 0, 0)),
+            "v": dus(layer_cache["v"], v.astype(dt), (slot, pos, 0, 0)),
+        }
+        sl = lambda a: jax.lax.dynamic_slice(
+            a, (slot, 0, 0, 0), (1,) + a.shape[1:])
+        keys, values = sl(new["k"]), sl(new["v"])
+    return new, keys, values
+
+
+def _ffn(p, x, args):
+    """Post-attention half of a block (dense MLP or MoE) — the MoE block
+    is position-free, so it is shared with the training forward as-is."""
+    if args.is_moe:
+        from ..models.moe import moe_block
+
+        ff, _aux = moe_block(p["feed_forward"], x, args)
+        return ff
+    return llama.mlp_block(p["feed_forward"], x)
+
+
+def _project_logits(params, x, args):
+    """Output projection, op-identical to llama.forward's logits path
+    (fp32 accumulation; params assumed fp32 — serving compute dtype)."""
+    if args.tie_word_embeddings or "output" not in params:
+        logits = jax.lax.dot_general(
+            x, params["tok_embeddings"]["weight"],
+            (((2,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    else:
+        logits = jax.lax.dot_general(
+            x, params["output"]["weight"],
+            (((2,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        if "bias" in params["output"]:
+            logits = logits + params["output"]["bias"].astype(jnp.float32)
+    if args.logit_scale:
+        logits = logits * args.logit_scale
+    return logits
+
+
+def _donate_cache():
+    # Donating the pool buffers makes the per-iteration cache update
+    # in-place on accelerators; the CPU backend has no donation support
+    # and would warn once per compile, so skip it there.
+    return () if jax.default_backend() == "cpu" else (1,)
+
+
+def decode_step(args: llama.LlamaArgs, attend_len: int):
+    """Compiled once per (args, attend bucket) — cached.
+
+    Returns ``step(params, cache, tokens, pos, temps, keys)`` →
+    ``(cache, tok, logprob, keys)`` where every array's leading axis is
+    the pool's ``num_slots``:
+
+    - ``tokens [B] int32`` — last emitted token per row (0 for masked rows);
+    - ``pos [B] int32``    — write position per row (``max_len - 1`` for
+      masked rows: the reserved junk target);
+    - ``temps [B] f32``    — 0 = greedy, >0 = temperature sample;
+    - ``keys [B, 2] u32``  — per-row PRNG keys, split-then-sample per
+      token exactly like ``generate_step``.
+    """
+    key_ = ("decode", args, attend_len)
+    if key_ in _STEP_CACHE:
+        return _STEP_CACHE[key_]
+
+    Hq, Hkv, Dh = args.num_heads, args.num_kv_heads, args.head_dim
+
+    @partial(jax.jit, donate_argnums=_donate_cache())
+    def step(params, cache, tokens, pos, temps, keys):
+        B = tokens.shape[0]
+        rows = jnp.arange(B)
+        positions = pos[:, None]  # [B, 1]
+        x = params["tok_embeddings"]["weight"][tokens][:, None, :]  # [B,1,D]
+        k_idx = jnp.arange(attend_len, dtype=jnp.int32)
+        # keys at or before each row's own position (junk beyond a row's
+        # write head is never attendable — pool invariant)
+        mask = (k_idx[None, None, :] <= positions[:, :, None])  # [B,1,L]
+        new_cache = []
+        for p, layer_cache in zip(params["layers"], cache):
+            h = llama.rms_norm(x, p["attention_norm"]["weight"],
+                               args.rms_norm_eps)
+            pa = p["attention"]
+            q = llama._linear(h, pa["wq"]).reshape(B, 1, Hq, Dh)
+            k = llama._linear(h, pa["wk"]).reshape(B, 1, Hkv, Dh)
+            v = llama._linear(h, pa["wv"]).reshape(B, 1, Hkv, Dh)
+            q = _rope_rows(q, positions, args)
+            k = _rope_rows(k, positions, args)
+            new_layer, ck, cv = _write_kv_rows(layer_cache, k, v, rows, pos)
+            new_cache.append(new_layer)
+            out = reference_attention(
+                q, ck[:, :attend_len], cv[:, :attend_len],
+                explicit_mask=mask[:, None, None, :, :])
+            x = x + llama._linear(out.reshape(B, 1, Hq * Dh), pa["wo"])
+            x = x + _ffn(p, llama.rms_norm(x, p["ffn_norm"]["weight"],
+                                           args.rms_norm_eps), args)
+        x = llama.rms_norm(x, params["norm"]["weight"], args.rms_norm_eps)
+        logits = _project_logits(params, x, args)[:, 0, :]  # [B, V]
+        lp_all = jax.nn.log_softmax(logits, axis=-1)
+        split = jax.vmap(lambda kk: jax.random.split(kk, 2))(keys)  # [B,2,2]
+        new_keys, subs = split[:, 0], split[:, 1]
+        sampled = jax.vmap(
+            lambda kk, lg, t: jax.random.categorical(
+                kk, lg / jnp.maximum(t, 1e-6)))(subs, logits, temps)
+        tok = jnp.where(temps > 0.0, sampled.astype(jnp.int32),
+                        jnp.argmax(logits, axis=-1).astype(jnp.int32))
+        lp = jnp.take_along_axis(lp_all, tok[:, None], axis=-1)[:, 0]
+        return new_cache, tok, lp, new_keys
+
+    _STEP_CACHE[key_] = step
+    return step
+
+
+def prefill_step(args: llama.LlamaArgs, chunk: int, attend_len: int,
+                 with_logits: bool):
+    """Compiled once per (args, chunk, attend bucket, with_logits).
+
+    Returns ``step(params, cache, tokens, slot, pos, last_idx)`` →
+    ``(cache, last_logits [1, V] | None)``: writes one ``chunk``-sized
+    piece of a prompt into ``slot`` starting at ``pos``. Only the FINAL
+    chunk needs logits (``with_logits=True``): the full-chunk projection
+    is computed and the true-last-token row selected at ``last_idx`` —
+    pad junk past the true length is overwritten by decode before it is
+    ever attendable."""
+    key_ = ("prefill", args, chunk, attend_len, with_logits)
+    if key_ in _STEP_CACHE:
+        return _STEP_CACHE[key_]
+
+    Hq, Hkv, Dh = args.num_heads, args.num_kv_heads, args.head_dim
+
+    @partial(jax.jit, donate_argnums=_donate_cache())
+    def step(params, cache, tokens, slot, pos, last_idx):
+        x = params["tok_embeddings"]["weight"][tokens][None]  # [1, C, D]
+        positions = jnp.arange(chunk, dtype=jnp.int32) + pos  # [C]
+        cos, sin = llama.rope_cos_sin(positions, Dh, args.rope_theta,
+                                      args.rope_scaling_factor)
+        k_idx = jnp.arange(attend_len, dtype=jnp.int32)
+        # same positional validity mask as the single-sequence cached
+        # decode (llama._cached_attention)
+        mask = (k_idx[None, :] <= positions[:, None]) \
+            & (k_idx[None, :] < pos + chunk)  # [C, L]
+        new_cache = []
+        for p, layer_cache in zip(params["layers"], cache):
+            h = llama.rms_norm(x, p["attention_norm"]["weight"],
+                               args.rms_norm_eps)
+            pa = p["attention"]
+            q = llama._linear(h, pa["wq"]).reshape(1, chunk, Hq, Dh)
+            k = llama._linear(h, pa["wk"]).reshape(1, chunk, Hkv, Dh)
+            v = llama._linear(h, pa["wv"]).reshape(1, chunk, Hkv, Dh)
+            q = llama.apply_rope(q, cos, sin, args.rope_traditional)
+            k = llama.apply_rope(k, cos, sin, args.rope_traditional)
+            new_layer, ck, cv = _write_kv_slot(layer_cache, k, v, slot, pos)
+            new_cache.append(new_layer)
+            out = reference_attention(q, ck[:, :attend_len],
+                                      cv[:, :attend_len], explicit_mask=mask)
+            x = x + llama._linear(out.reshape(1, chunk, Hq * Dh), pa["wo"])
+            x = x + _ffn(p, llama.rms_norm(x, p["ffn_norm"]["weight"],
+                                           args.rms_norm_eps), args)
+        if not with_logits:
+            return new_cache, None
+        x = llama.rms_norm(x, params["norm"]["weight"], args.rms_norm_eps)
+        logits = _project_logits(params, x, args)  # [1, C, V]
+        last = jax.lax.dynamic_slice_in_dim(logits, last_idx, 1, axis=1)
+        return new_cache, last[:, 0, :]  # [1, V]
+
+    _STEP_CACHE[key_] = step
+    return step
+
+
+def sample_token(logits: jnp.ndarray, temperature: float,
+                 key) -> Tuple[int, float, Any]:
+    """Sample one token from ``logits [1, V]`` with the request's rng
+    chain — the same split-then-sample the locked path applies to the
+    prefill logits (generate_step). Returns (token, logprob, new_key)."""
+    key, sub = jax.random.split(key)
+    if temperature > 0.0:
+        tok = jax.random.categorical(sub, logits / max(temperature, 1e-6),
+                                     axis=-1)
+    else:
+        tok = jnp.argmax(logits, axis=-1)
+    lp = jnp.take_along_axis(jax.nn.log_softmax(logits, axis=-1),
+                             tok[:, None], axis=-1)[0, 0]
+    return int(tok[0]), float(lp), key

@@ -1,0 +1,338 @@
+"""The continuous-batching engine thread.
+
+``BatchEngine`` owns the model params, the slotted KV pool and the
+scheduler, and runs one iteration loop on a background thread:
+
+    evict expired -> admit queued -> one prefill chunk -> one batched
+    decode step (all occupied slots advance one token) -> metrics
+
+Requests join and leave the batch at iteration granularity (Orca-style
+continuous batching): a finishing request frees its slot this iteration
+and a queued one takes it the next, so occupancy tracks offered load
+instead of draining batch-by-batch.
+
+The HTTP front end (infer/server.py, ``--engine batch``) submits
+requests and blocks on per-request waiters; ``QueueFullError`` maps to
+429. Per-iteration metrics (occupancy, queue depth, admitted / rejected
+/ evicted counts, TTFT, decode tok/s) publish through the existing obs
+stats protocol (obs/stats_client.py) so the live dashboard picks them up
+unmodified.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import batch_step
+from .kv_pool import SlotKVPool
+from .scheduler import (
+    DECODE,
+    PREFILL,
+    QueueFullError,
+    Request,
+    Scheduler,
+)
+
+__all__ = ["BatchEngine", "EngineConfig", "QueueFullError"]
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Slot/queue knobs (configs/serve-sample.yaml documents each)."""
+
+    num_slots: int = 8          # decode batch width = max concurrent requests
+    max_len: int = 2048         # per-slot KV length (last position reserved)
+    max_queue: int = 32         # admission queue depth; beyond -> 429
+    prefill_chunk: int = 256    # prompt tokens written per iteration
+    kv_quant: bool = False      # int8 pool slots (same path as --kv-quant)
+    default_deadline_s: Optional[float] = None  # per-request unless overridden
+    stats_url: Optional[str] = None  # ws://host:port of obs stats server
+    stats_interval_s: float = 1.0
+    worker_id: str = "serve-engine"
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "EngineConfig":
+        import yaml
+
+        with open(path) as f:
+            doc = yaml.safe_load(f) or {}
+        serve = doc.get("serve", doc)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in serve.items() if k in known})
+
+
+class BatchEngine:
+    def __init__(self, params, args, tokenizer,
+                 cfg: Optional[EngineConfig] = None):
+        self.params = params
+        self.args = args
+        self.tokenizer = tokenizer
+        self.cfg = cfg or EngineConfig()
+        if self.cfg.max_len > args.max_position_embeddings:
+            raise ValueError(
+                f"max_len {self.cfg.max_len} exceeds the model's "
+                f"max_position_embeddings {args.max_position_embeddings}")
+        self.pool = SlotKVPool(args, self.cfg.num_slots, self.cfg.max_len,
+                               quantize=self.cfg.kv_quant)
+        self.scheduler = Scheduler(max_queue=self.cfg.max_queue)
+        self.chunk = max(1, min(self.cfg.prefill_chunk, self.cfg.max_len))
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._stats = None
+        self.iterations = 0
+        # sliding decode-throughput window + last-published snapshot
+        self._win_t0 = time.monotonic()
+        self._win_tokens = 0
+        self._last_publish = 0.0
+        self._last_ttft_ms: Optional[float] = None
+        self._metrics: Dict[str, Any] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "BatchEngine":
+        if self._thread is None:
+            if self.cfg.stats_url:
+                from ..obs.stats_client import StatsClient
+
+                self._stats = StatsClient(self.cfg.stats_url,
+                                          self.cfg.worker_id).start()
+                self._stats.register({"role": "serve",
+                                      "num_slots": self.cfg.num_slots,
+                                      "max_len": self.cfg.max_len})
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="batch-engine")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self.scheduler.drain(self.pool)
+        if self._stats is not None:
+            self._stats.close()
+            self._stats = None
+
+    def warmup(self, prompt_ids: Optional[List[int]] = None) -> None:
+        """Pay the prefill/decode jit compiles before traffic arrives."""
+        running = self._thread is not None
+        if not running:
+            self.start()
+        req = self._submit_ids(prompt_ids or [self.tokenizer.bos_id, 1],
+                               max_tokens=2, temperature=0.0, seed=0)
+        req.wait(timeout=300.0)
+        if not running:
+            self.stop()
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, prompt: str, max_tokens: int = 64,
+               temperature: float = 0.0, seed: int = 0,
+               deadline_s: Optional[float] = None) -> Request:
+        """Tokenize and enqueue; raises QueueFullError (-> 429) past the
+        queue bound, ValueError when the request can never fit a slot."""
+        ids = [self.tokenizer.bos_id] + self.tokenizer.tokenize(prompt)
+        return self._submit_ids(ids, max_tokens, temperature, seed,
+                                deadline_s)
+
+    def _submit_ids(self, ids: List[int], max_tokens: int,
+                    temperature: float, seed: int,
+                    deadline_s: Optional[float] = None) -> Request:
+        import jax
+
+        P = len(ids)
+        padded = batch_step.round_up(max(P, 1), self.chunk)
+        if padded > self.pool.max_len or P > self.pool.capacity:
+            raise ValueError(
+                f"prompt of {P} tokens cannot fit a {self.pool.max_len}-"
+                f"token slot (chunked prefill pads to {padded})")
+        max_tokens = max(1, min(int(max_tokens), self.pool.capacity - P))
+        req = Request(ids, max_tokens, temperature=temperature, seed=seed,
+                      deadline_s=(deadline_s if deadline_s is not None
+                                  else self.cfg.default_deadline_s),
+                      stop_ids=[self.tokenizer.eos_id])
+        req.rng_key = np.asarray(jax.random.PRNGKey(seed))
+        self.scheduler.submit(req)
+        self._wake.set()
+        return req
+
+    def generate(self, prompt: str, max_tokens: int = 64,
+                 temperature: float = 0.0, seed: int = 0,
+                 deadline_s: Optional[float] = None,
+                 timeout: float = 600.0) -> dict:
+        """Blocking convenience used by the HTTP front end."""
+        req = self.submit(prompt, max_tokens, temperature, seed, deadline_s)
+        if not req.wait(timeout):
+            req.deadline = 0.0  # force eviction next iteration
+            self._wake.set()
+            req.wait(timeout=30.0)
+        if req.error is not None:
+            raise TimeoutError(req.error)
+        return dict(req.result or {})
+
+    # -- metrics -------------------------------------------------------------
+    def metrics(self) -> Dict[str, Any]:
+        s = self.scheduler
+        snap = {
+            "iterations": self.iterations,
+            "batch_occupancy": self.pool.num_used,
+            "num_slots": self.pool.num_slots,
+            "queue_depth": s.queue_depth(),
+            "admitted": s.admitted,
+            "rejected": s.rejected,
+            "evicted": s.evicted,
+            "completed": s.completed,
+        }
+        snap.update(self._metrics)
+        return snap
+
+    def _publish(self) -> None:
+        now = time.monotonic()
+        if now - self._last_publish < self.cfg.stats_interval_s:
+            return
+        dt = max(now - self._win_t0, 1e-9)
+        tok_s = self._win_tokens / dt
+        self._win_t0, self._win_tokens = now, 0
+        self._last_publish = now
+        self._metrics = {"tok/s": round(tok_s, 2)}
+        if self._last_ttft_ms is not None:
+            self._metrics["ttft_ms"] = round(self._last_ttft_ms, 1)
+        if self._stats is not None:
+            # "tok/s" is the key the stats server's aggregate sums, so a
+            # serving fleet's total decode throughput lands on the
+            # dashboard exactly like training workers' token rates.
+            self._stats.log_metrics(self.iterations, dict(
+                self.metrics(), **{"tok/s": round(tok_s, 2)}))
+
+    # -- the iteration loop --------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                busy = self._iteration()
+            except Exception as e:  # noqa: BLE001 - engine must not die silently
+                # Fail every in-flight request loudly and keep serving.
+                self.scheduler.drain(self.pool,
+                                     error=f"engine error: {type(e).__name__}: {e}")
+                busy = False
+            if not busy:
+                self._wake.wait(timeout=0.02)
+                self._wake.clear()
+
+    def _iteration(self) -> bool:
+        self.iterations += 1
+        sched, pool = self.scheduler, self.pool
+        for r in sched.expire(pool):
+            self._resolve_evicted(r)
+        sched.admit(pool)
+        busy = False
+        pre = sched.prefilling()
+        if pre:
+            self._prefill_chunk(pre[0])
+            busy = True
+        dec = sched.decoding()
+        if dec:
+            self._decode(dec)
+            busy = True
+        self._publish()
+        return busy
+
+    def _resolve_evicted(self, req: Request) -> None:
+        # expire() already resolved the waiter; nothing device-side to undo
+        # (stale slot contents are unattendable once the slot is reused).
+        pass
+
+    def _prefill_chunk(self, req: Request) -> None:
+        pool, C = self.pool, self.chunk
+        P = len(req.prompt_ids)
+        start = req.prefilled
+        n = min(C, P - start)
+        final = start + n >= P
+        toks = np.zeros(C, np.int32)
+        toks[:n] = req.prompt_ids[start:start + n]
+        attend = batch_step.attend_bucket(start + C, pool.max_len)
+        step = batch_step.prefill_step(self.args, C, attend,
+                                       with_logits=final)
+        cache, last_logits = step(self.params, pool.cache, toks,
+                                  np.int32(req.slot), np.int32(start),
+                                  np.int32(max(n - 1, 0)))
+        pool.cache = cache
+        req.prefilled = start + n
+        pool.lengths[req.slot] = min(start + n, P)
+        if not final:
+            return
+        pool.lengths[req.slot] = P
+        tok, lp, key = batch_step.sample_token(last_logits, req.temperature,
+                                               req.rng_key)
+        req.rng_key = np.asarray(key)
+        req.first_token_at = time.monotonic()
+        self._last_ttft_ms = (req.first_token_at - req.submitted_at) * 1e3
+        self._emit(req, tok, lp)
+
+    def _decode(self, dec: List[Request]) -> None:
+        pool = self.pool
+        B = pool.num_slots
+        tokens = np.zeros(B, np.int32)
+        # Free / prefilling rows ride the fixed-shape step pointed at the
+        # reserved junk position; their outputs are discarded.
+        pos = np.full(B, pool.max_len - 1, np.int32)
+        temps = np.zeros(B, np.float32)
+        keys = np.zeros((B, 2), np.uint32)
+        for r in dec:
+            tokens[r.slot] = r.last_token
+            pos[r.slot] = pool.lengths[r.slot]
+            temps[r.slot] = r.temperature
+            keys[r.slot] = r.rng_key
+        bucket = batch_step.attend_bucket(
+            int(pos[[r.slot for r in dec]].max()) + 1, pool.max_len)
+        step = batch_step.decode_step(self.args, bucket)
+        cache, tok, lp, new_keys = step(self.params, pool.cache, tokens,
+                                        pos, temps, keys)
+        pool.cache = cache
+        tok_h, lp_h, keys_h = (np.asarray(tok), np.asarray(lp),
+                               np.asarray(new_keys))
+        for r in dec:
+            pool.lengths[r.slot] += 1
+            r.rng_key = keys_h[r.slot]
+            self._emit(r, int(tok_h[r.slot]), float(lp_h[r.slot]))
+
+    def _emit(self, req: Request, tok: int, lp: float) -> None:
+        """Account one sampled token: stop/length bookkeeping mirrors
+        generate_lite (stop tokens are never appended)."""
+        if tok in req.stop_ids:
+            self._finish(req, "stop")
+            return
+        req.tokens.append(tok)
+        req.logprobs.append(lp)
+        req.last_token = tok
+        self._win_tokens += 1
+        if len(req.tokens) >= req.max_tokens:
+            self._finish(req, "length")
+        elif req.state == PREFILL:
+            req.state = DECODE
+
+    def _finish(self, req: Request, reason: str) -> None:
+        self.scheduler.finish(self.pool, req, reason)
+        done = time.monotonic()
+        dt = max(done - req.submitted_at, 1e-9)
+        ttft_ms = ((req.first_token_at - req.submitted_at) * 1e3
+                   if req.first_token_at else None)
+        req.resolve(result={
+            "text": self.tokenizer.detokenize(req.tokens),
+            "tokens": len(req.tokens),
+            "engine": "batch",
+            "finish_reason": reason,
+            "generation_tokens": float(len(req.tokens)),
+            "generation_tps": len(req.tokens) / dt,
+            "mean_logprob": (float(np.mean(req.logprobs))
+                             if req.logprobs else 0.0),
+            "prompt_tokens": float(len(req.prompt_ids)),
+            "stopped_on_token": float(reason == "stop"),
+            **({"ttft_ms": round(ttft_ms, 1)} if ttft_ms is not None else {}),
+        })

@@ -1,0 +1,98 @@
+"""Slotted KV-cache pool for continuous batching.
+
+One preallocated cache — per layer ``{"k": [num_slots, max_len, Hkv, Dh],
+"v": ...}`` (or the int8 ``k_q/k_s/v_q/v_s`` quartet from the existing
+KV-quant path, models/llama.py:init_cache) — shared by every in-flight
+request. A request owns one slot (one batch row) from admission to
+completion; slot positions are host-side state (the per-layer ``pos``
+scalar of the single-sequence cache does not apply: every row is at its
+own position, passed to the batched step as a ``[num_slots]`` vector).
+
+Freeing a slot is O(1) bookkeeping: the stale rows are never zeroed —
+chunked prefill overwrites from position 0 and the attention validity
+mask (k_idx <= row position) makes unwritten/stale tail entries
+unattendable, the same invariant bucketed prefill relies on
+(infer/generate.py:prefill).
+
+The LAST cache position of every slot is reserved as the junk-write
+target for free/prefilling rows riding the fixed-shape decode step
+(batch_step.decode_step writes ALL rows each iteration), so usable
+sequence length is ``max_len - 1``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..models import llama
+
+
+class SlotKVPool:
+    """Fixed pool of KV-cache slots with per-slot length state."""
+
+    def __init__(self, args: llama.LlamaArgs, num_slots: int, max_len: int,
+                 dtype=None, quantize: bool = False):
+        import jax.numpy as jnp
+
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if max_len < 2:
+            raise ValueError(f"max_len must be >= 2, got {max_len}")
+        self.args = args
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.quantize = quantize
+        self.cache = llama.init_cache(args, num_slots, max_len=max_len,
+                                      dtype=dtype or jnp.float32,
+                                      quantize=quantize)
+        # Slot positions live pool-side, not per layer.
+        for layer in self.cache:
+            layer.pop("pos", None)
+        self._free: List[int] = list(range(num_slots - 1, -1, -1))
+        # Written length per slot (== next write position). Free slots keep
+        # their stale value; allocate() resets it.
+        self.lengths: List[int] = [0] * num_slots
+
+    # -- capacity ------------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        """Longest sequence a slot can hold (last position is the junk-write
+        target for masked rows of the fixed-shape decode step)."""
+        return self.max_len - 1
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return self.num_slots - len(self._free)
+
+    def occupancy(self) -> float:
+        return self.num_used / self.num_slots
+
+    # -- slot lifecycle ------------------------------------------------------
+    def allocate(self) -> Optional[int]:
+        """Claim a free slot (resets its length); None when the pool is full."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self.lengths[slot] = 0
+        return slot
+
+    def free(self, slot: int) -> None:
+        if not 0 <= slot < self.num_slots:
+            raise ValueError(f"slot {slot} out of range 0..{self.num_slots - 1}")
+        if slot in self._free:
+            raise ValueError(f"slot {slot} double-freed")
+        self._free.append(slot)
+
+    def reset(self) -> None:
+        """Free every slot (buffers are NOT zeroed — see module docstring)."""
+        self._free = list(range(self.num_slots - 1, -1, -1))
+        self.lengths = [0] * self.num_slots
+
+    def max_active_len(self, slots) -> int:
+        """Longest written length among ``slots`` — drives the attend bucket
+        of the next batched decode step."""
+        return max((self.lengths[s] for s in slots), default=0)

@@ -1,0 +1,190 @@
+"""Continuous-batching scheduler: admission queue + iteration-level state.
+
+Pure host-side logic (no JAX) so policy is unit-testable without a
+device. The engine drives it once per iteration:
+
+1. ``expire(now)``    — evict queued AND running requests past their
+   deadline (evicted running requests free their slot immediately:
+   iteration-level leave);
+2. ``admit(pool)``    — FIFO: bind queued requests to free slots. A
+   request that can NEVER fit the pool (prompt + budget > slot capacity)
+   is rejected at submit time instead of poisoning the queue head;
+3. the engine then runs ONE prefill chunk for the oldest admitted
+   still-prefilling request (prefill interleaves with decode instead of
+   stalling it) and ONE batched decode step for every decoding slot;
+4. ``finish(req)``    — release the slot, resolve the waiter.
+
+Queue depth is bounded: ``submit`` past ``max_queue`` raises
+``QueueFullError`` which the HTTP front end maps to 429.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+# Request lifecycle states
+QUEUED = "queued"      # accepted, waiting for a slot
+PREFILL = "prefill"    # slot bound, prompt being written chunk by chunk
+DECODE = "decode"      # in the batched decode step
+DONE = "done"          # resolved (result or error set)
+
+
+class QueueFullError(Exception):
+    """Admission queue at max depth — the HTTP layer returns 429."""
+
+
+class Request:
+    """One in-flight generation request (host-side state + waiter)."""
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt_ids: List[int], max_tokens: int,
+                 temperature: float = 0.0, seed: int = 0,
+                 deadline_s: Optional[float] = None,
+                 stop_ids: Optional[List[int]] = None):
+        self.id = next(Request._ids)
+        self.prompt_ids = list(prompt_ids)
+        self.max_tokens = int(max_tokens)
+        self.temperature = float(temperature)
+        self.seed = int(seed)
+        self.stop_ids = set(stop_ids or ())
+        self.submitted_at = time.monotonic()
+        self.deadline = (self.submitted_at + deadline_s
+                         if deadline_s else None)
+        self.state = QUEUED
+        self.slot: Optional[int] = None
+        self.prefilled = 0          # prompt tokens written so far
+        self.last_token: Optional[int] = None  # fed to the next decode step
+        self.rng_key = None         # per-request PRNG chain (engine-owned)
+        self.tokens: List[int] = []
+        self.logprobs: List[float] = []
+        self.first_token_at: Optional[float] = None  # TTFT marker
+        self.finish_reason: Optional[str] = None
+        self.error: Optional[str] = None
+        self.result: Optional[dict] = None
+        self._done = threading.Event()
+
+    # -- waiter --------------------------------------------------------------
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def resolve(self, result: Optional[dict] = None,
+                error: Optional[str] = None) -> None:
+        self.state = DONE
+        self.result = result
+        self.error = error
+        self._done.set()
+
+    @property
+    def position(self) -> int:
+        """Next cache write position = tokens durably written for this
+        request (prompt progress, then prompt + generated-and-fed)."""
+        if self.state == PREFILL:
+            return self.prefilled
+        return len(self.prompt_ids) + max(len(self.tokens) - 1, 0)
+
+
+class Scheduler:
+    def __init__(self, max_queue: int = 32):
+        self.max_queue = max_queue
+        self.queue: Deque[Request] = deque()
+        self.running: Dict[int, Request] = {}  # slot -> request
+        self.lock = threading.Lock()
+        # monotonically increasing counters (metrics)
+        self.admitted = 0
+        self.rejected = 0
+        self.evicted = 0
+        self.completed = 0
+
+    # -- admission -----------------------------------------------------------
+    def submit(self, req: Request) -> Request:
+        with self.lock:
+            if len(self.queue) >= self.max_queue:
+                self.rejected += 1
+                raise QueueFullError(
+                    f"queue full ({self.max_queue} requests waiting)")
+            self.queue.append(req)
+        return req
+
+    def admit(self, pool) -> List[Request]:
+        """Bind FIFO-queued requests to free slots; returns the newly
+        admitted requests (now in PREFILL state, nothing written yet)."""
+        out: List[Request] = []
+        with self.lock:
+            while self.queue and pool.num_free > 0:
+                req = self.queue.popleft()
+                slot = pool.allocate()
+                req.slot = slot
+                req.state = PREFILL
+                req.prefilled = 0
+                self.running[slot] = req
+                self.admitted += 1
+                out.append(req)
+        return out
+
+    # -- iteration-level views ----------------------------------------------
+    def prefilling(self) -> List[Request]:
+        with self.lock:
+            return sorted((r for r in self.running.values()
+                           if r.state == PREFILL), key=lambda r: r.id)
+
+    def decoding(self) -> List[Request]:
+        with self.lock:
+            return sorted((r for r in self.running.values()
+                           if r.state == DECODE), key=lambda r: r.slot)
+
+    def queue_depth(self) -> int:
+        with self.lock:
+            return len(self.queue)
+
+    # -- leave ---------------------------------------------------------------
+    def expire(self, pool, now: Optional[float] = None) -> List[Request]:
+        """Evict queued and running requests whose deadline has passed.
+        Running requests leave the batch mid-flight (slot freed this
+        iteration); each evicted request is resolved with an error and
+        whatever tokens it had already generated."""
+        now = time.monotonic() if now is None else now
+        evicted: List[Request] = []
+        with self.lock:
+            still = deque()
+            for r in self.queue:
+                if r.deadline is not None and now > r.deadline:
+                    evicted.append(r)
+                else:
+                    still.append(r)
+            self.queue = still
+            for slot, r in list(self.running.items()):
+                if r.deadline is not None and now > r.deadline:
+                    del self.running[slot]
+                    pool.free(slot)
+                    evicted.append(r)
+            self.evicted += len(evicted)
+        for r in evicted:
+            r.finish_reason = "deadline"
+            r.resolve(error="deadline exceeded")
+        return evicted
+
+    def finish(self, pool, req: Request, reason: str) -> None:
+        """Normal completion: release the slot and mark the finish reason
+        (the engine resolves the result dict — it owns detokenization)."""
+        with self.lock:
+            if req.slot is not None and req.slot in self.running:
+                del self.running[req.slot]
+                pool.free(req.slot)
+            self.completed += 1
+        req.finish_reason = reason
+
+    def drain(self, pool, error: str = "engine stopped") -> None:
+        """Resolve everything (engine shutdown)."""
+        with self.lock:
+            pending = list(self.queue) + list(self.running.values())
+            self.queue.clear()
+            self.running.clear()
+        pool.reset()
+        for r in pending:
+            if r.state != DONE:
+                r.resolve(error=error)

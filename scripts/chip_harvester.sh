@@ -230,13 +230,18 @@ while :; do
         if model_final /tmp/realrun/runs/llama-2m-realtext-r5; then
           run_infbench "$id" "$t" llama-2m-realtext-r5 \
             /tmp/realrun/data/val.jsonl
-        elif [ -n "$(find /tmp/realrun/run2m_r5.yaml -mmin +300 2>/dev/null)" ]; then
+        elif [ ! -f /tmp/realrun/run2m_r5.yaml ] || \
+             [ -n "$(find /tmp/realrun/run2m_r5.yaml -mmin +300 2>/dev/null)" ]; then
           # The CPU training was staged when its config was written; if
-          # 5h pass with no final model it is not coming (a process
-          # check would be a transient snapshot — a crash-and-relaunch
-          # gap must not permanently quarantine the job).
+          # the config never appeared, or 5h pass with no final model,
+          # it is not coming (a process check would be a transient
+          # snapshot — a crash-and-relaunch gap must not permanently
+          # quarantine the job). NOTE: the find must not be the only
+          # gate — `find missing-file` prints nothing, which previously
+          # read as "young config" and made this WAIT forever when the
+          # yaml was never written at all.
           echo x >> "$BASE/fail/$id"
-          echo "$(stamp) FAIL $id (2m model absent past deadline)" >> "$LOG"
+          echo "$(stamp) FAIL $id (2m config/model absent past deadline)" >> "$LOG"
         else
           echo "$(stamp) WAIT infbench2m (2m training in progress)" >> "$LOG"
         fi ;;

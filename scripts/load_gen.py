@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Concurrent HTTP load generator for the inference server.
+
+Drives N worker threads against ``POST /generate`` (infer/server.py) and
+prints one JSON summary line: request counts by status (200 / 429 / 504 /
+other), latency percentiles, client-side token throughput, and the
+server's /metrics snapshot after the run. Stdlib-only, so it runs
+anywhere the repo does:
+
+    python scripts/load_gen.py --url http://127.0.0.1:8400 \
+        --concurrency 8 --requests 64 --max-tokens 32
+
+Point it at a ``--engine locked`` server and then a ``--engine batch``
+one to see continuous batching under identical offered load (the
+serve_batch bench case does the same comparison in-process).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+
+def _one_request(url: str, body: dict, timeout: float) -> dict:
+    data = json.dumps(body).encode()
+    req = urllib.request.Request(url.rstrip("/") + "/generate", data=data,
+                                 headers={"Content-Type": "application/json"})
+    t0 = time.monotonic()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            out = json.loads(resp.read())
+            return {"status": resp.status, "latency_s": time.monotonic() - t0,
+                    "tokens": int(out.get("tokens", 0))}
+    except urllib.error.HTTPError as e:
+        return {"status": e.code, "latency_s": time.monotonic() - t0,
+                "tokens": 0}
+    except Exception as e:  # noqa: BLE001 - count it, keep loading
+        return {"status": f"error:{type(e).__name__}",
+                "latency_s": time.monotonic() - t0, "tokens": 0}
+
+
+def run_load(url: str, concurrency: int, requests: int, prompt: str,
+             max_tokens: int, temperature: float, deadline_s: float | None,
+             timeout: float) -> dict:
+    results: list = []
+    lock = threading.Lock()
+    counter = iter(range(requests))
+
+    def worker():
+        while True:
+            with lock:
+                i = next(counter, None)
+            if i is None:
+                return
+            body = {"prompt": f"{prompt} [{i}]", "max_tokens": max_tokens,
+                    "temperature": temperature, "seed": i}
+            if deadline_s is not None:
+                body["deadline_s"] = deadline_s
+            r = _one_request(url, body, timeout)
+            with lock:
+                results.append(r)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+
+    by_status: dict = {}
+    for r in results:
+        by_status[str(r["status"])] = by_status.get(str(r["status"]), 0) + 1
+    lats = sorted(r["latency_s"] for r in results if r["status"] == 200)
+
+    def pct(p: float) -> float | None:
+        if not lats:
+            return None
+        return round(lats[min(len(lats) - 1, int(p * len(lats)))], 3)
+
+    toks = sum(r["tokens"] for r in results)
+    summary = {
+        "url": url, "concurrency": concurrency, "requests": requests,
+        "max_tokens": max_tokens, "wall_s": round(wall, 2),
+        "by_status": by_status,
+        "ok": by_status.get("200", 0),
+        "latency_p50_s": pct(0.50), "latency_p90_s": pct(0.90),
+        "latency_max_s": round(lats[-1], 3) if lats else None,
+        "client_tok_s": round(toks / wall, 1) if wall > 0 else None,
+    }
+    try:
+        with urllib.request.urlopen(url.rstrip("/") + "/metrics",
+                                    timeout=10) as resp:
+            summary["server_metrics"] = json.loads(resp.read())
+    except Exception:  # noqa: BLE001 - summary is still useful without it
+        pass
+    return summary
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--url", default="http://127.0.0.1:8400")
+    p.add_argument("--concurrency", type=int, default=8)
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--prompt", default="The quick brown fox")
+    p.add_argument("--max-tokens", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--deadline-s", type=float, default=None,
+                   help="per-request deadline passed to the batch engine")
+    p.add_argument("--timeout", type=float, default=300.0,
+                   help="client-side HTTP timeout per request")
+    a = p.parse_args(argv)
+    summary = run_load(a.url, a.concurrency, a.requests, a.prompt,
+                       a.max_tokens, a.temperature, a.deadline_s, a.timeout)
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -19,6 +19,13 @@ def test_all_shipped_configs_load():
     paths = glob.glob(os.path.join(REPO, "configs", "**", "*.yaml"), recursive=True)
     assert len(paths) >= 25
     for p in paths:
+        if os.path.basename(p).startswith("serve-"):
+            # serving preset: EngineConfig schema, not a training Config
+            from mlx_cuda_distributed_pretraining_tpu.serve import EngineConfig
+
+            scfg = EngineConfig.from_yaml(p)
+            assert scfg.num_slots > 0 and scfg.max_len > 1
+            continue
         cfg = Config.from_yaml(p)
         assert cfg.name
         if "tokenizer-config" in p:

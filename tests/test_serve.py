@@ -1,0 +1,231 @@
+"""Continuous-batching engine (serve/): pool, scheduler, engine and the
+HTTP front end. Everything runs CPU-side on the tiny test shape."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from mlx_cuda_distributed_pretraining_tpu.config import DataConfig
+from mlx_cuda_distributed_pretraining_tpu.infer.generate import generate_text
+from mlx_cuda_distributed_pretraining_tpu.infer.server import (
+    InferenceService,
+    serve,
+)
+from mlx_cuda_distributed_pretraining_tpu.models import llama
+from mlx_cuda_distributed_pretraining_tpu.models.llama import LlamaArgs
+from mlx_cuda_distributed_pretraining_tpu.serve import (
+    BatchEngine,
+    EngineConfig,
+    QueueFullError,
+    Request,
+    Scheduler,
+    SlotKVPool,
+)
+from mlx_cuda_distributed_pretraining_tpu.tokenizer import TokenizerManager
+
+TOK = TokenizerManager(DataConfig())
+ARGS = LlamaArgs(
+    vocab_size=TOK.vocab_size, hidden_size=32, intermediate_size=64,
+    num_layers=2, num_heads=4, num_kv_heads=2, head_dim=8,
+    max_position_embeddings=128,
+)
+PARAMS = llama.init_params(jax.random.PRNGKey(0), ARGS)
+
+# One pool max_len for the whole module: with the tiny shape this matches
+# the locked path's bucketed cache length, so identity tests compare the
+# same attend shapes.
+MAX_LEN = 128
+
+
+def _engine(**kw):
+    cfg = EngineConfig(**{"num_slots": 2, "max_len": MAX_LEN,
+                          "prefill_chunk": 16, **kw})
+    return BatchEngine(PARAMS, ARGS, TOK, cfg)
+
+
+# -- kv pool ------------------------------------------------------------------
+
+def test_pool_allocate_free_reset():
+    pool = SlotKVPool(ARGS, num_slots=3, max_len=MAX_LEN)
+    assert pool.capacity == MAX_LEN - 1  # last position is reserved
+    slots = [pool.allocate() for _ in range(3)]
+    assert sorted(slots) == [0, 1, 2]
+    assert pool.allocate() is None  # full pool: no slot, no exception
+    assert pool.num_used == 3 and pool.occupancy() == 1.0
+    pool.lengths[slots[0]] = 7
+    pool.free(slots[0])
+    with pytest.raises(ValueError):
+        pool.free(slots[0])  # double free
+    with pytest.raises(ValueError):
+        pool.free(99)  # out of range
+    s = pool.allocate()
+    assert s == slots[0] and pool.lengths[s] == 0  # reuse resets length
+    pool.reset()
+    assert pool.num_free == 3 and pool.lengths == [0, 0, 0]
+    # int8 pool builds the quantized quartet per layer
+    qpool = SlotKVPool(ARGS, num_slots=2, max_len=MAX_LEN, quantize=True)
+    assert "k_q" in qpool.cache[0] and "k" not in qpool.cache[0]
+
+
+# -- scheduler (no device) ----------------------------------------------------
+
+def test_scheduler_admit_evict_under_full_pool():
+    pool = SlotKVPool(ARGS, num_slots=2, max_len=MAX_LEN)
+    sched = Scheduler(max_queue=3)
+    reqs = [Request([1, 2, 3], max_tokens=4) for _ in range(3)]
+    for r in reqs:
+        sched.submit(r)
+    admitted = sched.admit(pool)
+    assert [r.id for r in admitted] == [reqs[0].id, reqs[1].id]  # FIFO
+    assert sched.queue_depth() == 1 and pool.num_free == 0
+    assert all(r.state == "prefill" for r in admitted)
+    # finishing one frees its slot; the queued request takes it next admit
+    sched.finish(pool, admitted[0], "stop")
+    assert pool.num_free == 1
+    assert [r.id for r in sched.admit(pool)] == [reqs[2].id]
+    assert sched.admitted == 3 and sched.completed == 1
+
+
+def test_scheduler_queue_full_and_deadline_eviction():
+    pool = SlotKVPool(ARGS, num_slots=1, max_len=MAX_LEN)
+    sched = Scheduler(max_queue=2)
+    running = Request([1], max_tokens=4, deadline_s=0.01)
+    sched.submit(running)
+    sched.admit(pool)
+    queued = Request([1], max_tokens=4, deadline_s=0.01)
+    sched.submit(queued)
+    with pytest.raises(QueueFullError):
+        sched.submit(Request([1], max_tokens=4))
+        sched.submit(Request([1], max_tokens=4))
+    # both the running and the queued request expire; the slot is freed
+    evicted = sched.expire(pool, now=time.monotonic() + 1.0)
+    assert {r.id for r in evicted} == {running.id, queued.id}
+    assert all(r.finish_reason == "deadline" and r.error for r in evicted)
+    assert pool.num_free == 1 and sched.evicted == 2
+
+
+# -- engine -------------------------------------------------------------------
+
+def test_batch1_greedy_token_identity_with_generate_text():
+    prompt = "the quick brown fox"
+    locked_text, stats = generate_text(
+        PARAMS, ARGS, TOK, prompt, max_new_tokens=16, temperature=0.0,
+        return_stats=True)
+    eng = _engine().start()
+    try:
+        out = eng.generate(prompt, max_tokens=16, temperature=0.0,
+                           timeout=300.0)
+    finally:
+        eng.stop()
+    assert out["text"] == locked_text
+    assert out["generation_tokens"] == stats["generation_tokens"]
+    assert out["stopped_on_token"] == stats["stopped_on_token"]
+    assert out["prompt_tokens"] == stats["prompt_tokens"]
+
+
+def test_engine_concurrent_more_requests_than_slots():
+    eng = _engine().start()
+    outs = [None] * 5
+    try:
+        def run(i):
+            outs[i] = eng.generate(f"prompt {i}", max_tokens=6,
+                                   temperature=0.5, seed=i, timeout=300.0)
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(5)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        m = eng.metrics()
+    finally:
+        eng.stop()
+    assert all(o is not None and o["tokens"] == 6 for o in outs)
+    # sampled requests with distinct seeds should not all collapse to one
+    # output (each slot runs its own rng chain)
+    assert m["admitted"] == 5 and m["completed"] == 5
+    assert m["batch_occupancy"] == 0 and m["queue_depth"] == 0
+
+
+def test_engine_deadline_eviction_reported():
+    eng = _engine(num_slots=1).start()
+    try:
+        with pytest.raises(TimeoutError, match="deadline"):
+            eng.generate("slow request", max_tokens=64, deadline_s=1e-4,
+                         timeout=300.0)
+        assert eng.metrics()["evicted"] == 1
+    finally:
+        eng.stop()
+
+
+def test_engine_rejects_oversized_prompt():
+    eng = _engine()
+    with pytest.raises(ValueError):
+        eng._submit_ids(list(range(MAX_LEN + 5)), max_tokens=4,
+                        temperature=0.0, seed=0)
+
+
+# -- HTTP front end -----------------------------------------------------------
+
+def _post(url, body, timeout=300.0):
+    req = urllib.request.Request(
+        url + "/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_server_batch_engine_429_past_max_queue_depth():
+    service = InferenceService(PARAMS, ARGS, TOK, run_name="tiny")
+    # Engine NOT started: submissions stack up in the admission queue so
+    # the over-depth rejection is deterministic.
+    service.engine = _engine(max_queue=2)
+    httpd = serve(service, port=0)
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        # fill the queue without the engine draining it
+        for i in range(2):
+            service.engine.submit(f"fill {i}", max_tokens=4)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(url, {"prompt": "overflow", "max_tokens": 4}, timeout=60.0)
+        assert exc.value.code == 429
+        assert service.engine.metrics()["rejected"] == 1
+        # start the engine: the queued fills drain and new requests serve
+        service.engine.start()
+        status, out = _post(url, {"prompt": "after drain", "max_tokens": 4})
+        assert status == 200 and out["engine"] == "batch"
+        assert out["finish_reason"] in ("stop", "length")
+        # health/metrics surfaces the engine
+        with urllib.request.urlopen(url + "/healthz", timeout=30) as resp:
+            h = json.loads(resp.read())
+        assert h["engine"] == "batch" and "serve" in h
+        with urllib.request.urlopen(url + "/metrics", timeout=30) as resp:
+            m = json.loads(resp.read())
+        assert m["num_slots"] == 2
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        service.close()
+
+
+def test_server_locked_path_unchanged_and_reshaping_knobs_fall_back():
+    service = InferenceService(PARAMS, ARGS, TOK, run_name="tiny")
+    service.engine = _engine().start()
+    try:
+        # top_p reshapes logits -> served by the locked path even with the
+        # engine attached (the batched step samples by temperature only)
+        out = service.generate("abc", max_tokens=4, temperature=0.8,
+                               top_p=0.9)
+        assert "engine" not in out and "speculative" in out
+        out2 = service.generate("abc", max_tokens=4)
+        assert out2["engine"] == "batch"
+    finally:
+        service.close()
+    # without an engine, health keeps the pre-engine shape
+    plain = InferenceService(PARAMS, ARGS, TOK, run_name="tiny")
+    assert "engine" not in plain.health()
+    assert plain.metrics() == {"engine": "locked"}
