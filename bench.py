@@ -210,18 +210,20 @@ def harvester_case_rows(out_dir, max_age_s=None) -> dict:
     merge policy (CASE_MARK scan, truncated-line skip, clean-beats-
     preempted) lives in exactly one place. Rows keep their ``device``
     field; callers hoist or keep it as their artifact needs.
-    ``max_age_s`` skips out-files whose mtime is older — a freshness
-    horizon so rows from a previous round are never mistaken for this
-    round's (the harvester also archives cross-round files at startup;
-    this is defense in depth)."""
+    ``max_age_s`` is a per-ROW freshness horizon so rows from a previous
+    round are never mistaken for this round's (the harvester also archives
+    cross-round files at startup; this is defense in depth). Age comes from
+    the row's own ``emitted_at`` stamp (written by ``--one`` at emit time);
+    legacy rows without one fall back to the out-file's mtime — which can
+    lie in BOTH directions (a later append refreshes every row's apparent
+    age; an archiver touch ages none), hence the per-row stamp."""
     import glob
 
+    now = time.time()
     found = {}
     for path in sorted(glob.glob(os.path.join(out_dir, "*.out"))):
         try:
-            if max_age_s is not None \
-                    and time.time() - os.path.getmtime(path) > max_age_s:
-                continue
+            mtime = os.path.getmtime(path)
         except OSError:
             continue
         try:
@@ -236,6 +238,10 @@ def harvester_case_rows(out_dir, max_age_s=None) -> dict:
                     case = r.get("case")
                     if not case:
                         continue
+                    if max_age_s is not None:
+                        born = r.get("emitted_at") or mtime
+                        if now - born > max_age_s:
+                            continue
                     prev = found.get(case)
                     # A clean row never loses to a preempted one.
                     if prev is not None and not prev.get("preempted") \
@@ -962,6 +968,9 @@ def run_child(case_id) -> None:
     r = plan[case_id]()
     r["bench_wall_s"] = round(time.perf_counter() - t0, 1)
     r["device"] = str(jax.devices()[0])
+    # Emit-time stamp: harvester_case_rows() judges freshness per row, so
+    # a long-lived out-file with rows from several rounds ages correctly.
+    r["emitted_at"] = round(time.time(), 1)
     print(_CASE_MARK + json.dumps(r), flush=True)
 
 
@@ -1058,6 +1067,37 @@ def run_case(case_id, reserve, inproc_thunk=None):
             return
 
 
+def _lint_gate() -> None:
+    """Refuse to produce a BENCH doc from a tree with NEW graftlint
+    findings — a benched number from code with a recompile storm or a
+    per-step host sync measures the bug, not the chip. Baselined and
+    inline-suppressed findings pass (they are triaged); BENCH_LINT=0 is
+    the escape hatch for deliberately benching a dirty work tree. Called
+    before the atexit emit hook is registered, so a refusal emits the
+    error line below as the run's single stdout-contract line."""
+    if os.environ.get("BENCH_LINT") == "0":
+        return
+    try:
+        from mlx_cuda_distributed_pretraining_tpu.analysis import (
+            load_baseline, run_lint)
+        pkg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "mlx_cuda_distributed_pretraining_tpu")
+        result = run_lint([pkg], baseline=load_baseline(None))
+    except Exception as e:  # noqa: BLE001 - a linter bug must not brick benching
+        log(f"[bench] graftlint gate errored ({e}); continuing without it")
+        return
+    if not result.new:
+        return
+    for f in result.new[:20]:
+        log(f"[bench] graftlint: {f.path}:{f.line}: [{f.rule}] {f.message}")
+    print(json.dumps({
+        "error": f"graftlint found {len(result.new)} new finding(s) — fix, "
+                 "suppress, or baseline them first (BENCH_LINT=0 to force)",
+        "value": 0,
+    }), flush=True)
+    sys.exit(1)
+
+
 def main() -> None:
     global _VOCAB, _DEVICE
     _VOCAB = vocab = int(os.environ.get("BENCH_VOCAB", "32768"))
@@ -1110,6 +1150,7 @@ if __name__ == "__main__":
     elif len(sys.argv) >= 2 and sys.argv[1] == "--probe":
         probe_child()
     else:
+        _lint_gate()  # before the atexit hook: a refusal must emit no doc
         atexit.register(emit, "atexit")
         signal.signal(signal.SIGTERM, _on_signal)
         signal.signal(signal.SIGINT, _on_signal)

@@ -1,0 +1,26 @@
+"""graftlint: JAX-aware static analysis for this repo's jit-heavy code.
+
+The TPU silent killers — jit recompile storms, reused PRNG keys,
+host↔device syncs inside hot loops, use-after-donate — leave no
+traceback, just a slow or subtly-wrong run. graftlint catches their
+source shapes at lint time with pure-AST rules (no jax import, no
+backend init), a per-line suppression syntax, and a committed baseline
+for grandfathered findings so the tier-1 gate only ever fails on NEW
+hazards.
+
+    python -m mlx_cuda_distributed_pretraining_tpu.analysis.lint [paths]
+
+See ``rules.py`` for the rule catalogue and README "graftlint" for the
+workflow (suppressing, baselining, regenerating the baseline).
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    LintResult,
+    all_rules,
+    default_baseline_path,
+    lint_file,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)

@@ -1,0 +1,98 @@
+"""graftlint CLI.
+
+    python -m mlx_cuda_distributed_pretraining_tpu.analysis.lint [paths...]
+
+Lints ``paths`` (files or directories; default: the package itself) with
+every registered rule, subtracts inline suppressions and the committed
+baseline, and exits nonzero when any NEW finding remains. ``--format
+json`` emits one machine-readable document (used by tests and the
+bench.py gate); ``--write-baseline`` regenerates the baseline from the
+current findings, preserving the reasons of entries that still match.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+from .core import (
+    PACKAGE_NAME,
+    all_rules,
+    default_baseline_path,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+
+
+def _default_paths() -> List[str]:
+    return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog=f"python -m {PACKAGE_NAME}.analysis.lint",
+        description="JAX-aware static analysis "
+                    "(recompile/RNG/host-sync/donation rules)")
+    ap.add_argument("paths", nargs="*", help="files or directories "
+                    f"(default: the {PACKAGE_NAME} package)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: {default_baseline_path()})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: every finding is new")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from current findings "
+                    "(keeps reasons of entries that still match) and exit 0")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for rid in sorted(rules):
+            print(f"{rid}: {' '.join(rules[rid].description.split())}")
+        return 0
+
+    paths = args.paths or _default_paths()
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"graftlint: no such path: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or default_baseline_path()
+    baseline = [] if args.no_baseline else load_baseline(baseline_path)
+    result = run_lint(paths, baseline=baseline)
+
+    if args.write_baseline:
+        write_baseline(baseline_path, result.findings, old_entries=baseline)
+        print(f"graftlint: wrote {len(result.findings)} finding(s) to "
+              f"{baseline_path}", file=sys.stderr)
+        return 0
+
+    if args.format == "json":
+        print(json.dumps({
+            "tool": "graftlint",
+            "new": [f.to_dict() for f in result.new],
+            "baselined": [f.to_dict() for f in result.baselined],
+            "suppressed": [f.to_dict() for f in result.suppressed],
+            "stale_baseline": result.stale_baseline,
+        }))
+    else:
+        for f in result.new:
+            print(f"{f.path}:{f.line}:{f.col}: [{f.rule}] {f.message}")
+        for e in result.stale_baseline:
+            print(f"note: stale baseline entry (fixed?): [{e.get('rule')}] "
+                  f"{e.get('path')} — {e.get('message')}", file=sys.stderr)
+        summary = (f"graftlint: {len(result.new)} new, "
+                   f"{len(result.baselined)} baselined, "
+                   f"{len(result.suppressed)} suppressed")
+        print(summary, file=sys.stderr)
+    return 1 if result.new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -141,7 +141,10 @@ def generate_step(
                 params, cache, tok, jnp.asarray(pos, jnp.int32), rng, hist_next,
                 sampler=sampler, processors=processors,
             )
-        yield int(tok[0]), float(lp[0])
+        # Yielding the token to the caller each step IS the streaming API;
+        # the next step was already dispatched above, so the sync overlaps
+        # with device work rather than serializing it.
+        yield int(tok[0]), float(lp[0])  # graftlint: disable=host-sync-in-hot-loop
         if nxt is None:
             break
         cache, tok, lp, rng, history = nxt
@@ -397,7 +400,9 @@ def generate_speculative(
             if len(out) >= max_tokens:
                 break
             out.append(t)
-            logprobs.append(float(lp_h[i]))
+            # lp_h is already a host-side numpy array (fetched once per
+            # verify round above); float() here indexes host memory.
+            logprobs.append(float(lp_h[i]))  # graftlint: disable=host-sync-in-hot-loop
             seq.append(t)
             if t in stop:
                 stopped = True
@@ -530,13 +535,17 @@ def beam_search(
 
     pos = P
     for _ in range(max_tokens - 1):
-        if not bool(np.any(np.asarray(alive))):
+        # Beam bookkeeping (sequence reconstruction + early stop) host-
+        # materializes per step by design: num_beams scalars per iteration,
+        # and the alternative — device-side gather of ragged sequences —
+        # costs more than it saves at these sizes.
+        if not bool(np.any(np.asarray(alive))):  # graftlint: disable=host-sync-in-hot-loop
             break
         cache, toks, scores, alive, origin = expand(
             cache, toks, jnp.asarray(pos, jnp.int32), scores, alive,
             attend_len=_attend_bucket(pos + 1, cache_len))
-        origin = np.asarray(origin)
-        toks_h = np.asarray(toks)
+        origin = np.asarray(origin)  # graftlint: disable=host-sync-in-hot-loop
+        toks_h = np.asarray(toks)  # graftlint: disable=host-sync-in-hot-loop
         seqs = [seqs[origin[i]] + [int(toks_h[i])] for i in range(num_beams)]
         pos += 1
 

@@ -399,14 +399,23 @@ class Trainer:
     def validate(self, cap: int = 50) -> Optional[float]:
         if self.data is None or not self.data.has_validation_data:
             return None
-        total_nll, total_toks = 0.0, 0.0
+        # Accumulate on device; a single host sync after the loop instead of
+        # blocking on every batch (each float() through a tunneled chip is a
+        # full RTT).
+        total_nll, total_toks = None, None
         for batch in self.data.iter_validation(cap):
             loss, toks = self.eval_step(self.state["params"], _device_batch(batch))
-            total_nll += float(loss) * float(toks)
-            total_toks += float(toks)
+            if total_nll is None:
+                total_nll, total_toks = loss * toks, toks
+            else:
+                total_nll = total_nll + loss * toks
+                total_toks = total_toks + toks
+        if total_nll is None:
+            return None
+        total_toks = float(total_toks)
         if total_toks == 0:  # no usable batches — report "no signal", not 0.0
             return None
-        return total_nll / total_toks
+        return float(total_nll) / total_toks
 
     # -- sample generation (reference: :1818-1904) --------------------------
     def generate_samples(self, step: int, prompts=None, max_new_tokens: int = 48) -> None:

@@ -1,0 +1,14 @@
+#!/bin/sh
+# graftlint wrapper: JAX-aware static analysis over the package.
+#
+#   scripts/lint.sh                 # lint the package against the baseline
+#   scripts/lint.sh path/to/file.py # lint specific paths
+#   scripts/lint.sh --format json   # machine-readable findings
+#
+# Exit codes: 0 clean (modulo baseline), 1 new findings, 2 bad paths.
+# The linter is pure-AST (never imports the code under analysis), but it
+# runs from the package, so pin JAX to CPU in case an import chain wakes
+# a backend.
+set -eu
+cd "$(dirname "$0")/.."
+JAX_PLATFORMS=cpu exec python -m mlx_cuda_distributed_pretraining_tpu.analysis.lint "$@"

@@ -27,5 +27,21 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 from jax._src import xla_bridge as _xb
 
+# This harness leans on two PRIVATE jax internals. Assert they exist with a
+# loud explanation so a jax upgrade that renames them fails HERE with a
+# pointer, not deep inside the first test with an AttributeError.
+assert hasattr(_xb, "_backend_factories") and hasattr(
+    _xb._backend_factories, "pop"), (
+    "jax._src.xla_bridge._backend_factories (private dict) is gone — the jax "
+    "upgrade renamed it. The test harness pops the 'axon' TPU plugin factory "
+    "from it so CPU test runs never dial the single-client TPU tunnel; find "
+    "the new factory-registry name and update tests/conftest.py (and the CPU "
+    "guard in mlx_cuda_distributed_pretraining_tpu/__init__.py).")
+assert hasattr(_xb, "backends_are_initialized"), (
+    "jax._src.xla_bridge.backends_are_initialized() is gone — the jax "
+    "upgrade renamed it. tests/conftest.py uses it to prove the backend "
+    "de-registration below still happens early enough; find the replacement "
+    "and update this file.")
+
 assert not _xb.backends_are_initialized(), "jax backends initialized before conftest"
 _xb._backend_factories.pop("axon", None)

@@ -1,0 +1,24 @@
+"""BAD fixture: host-sync-in-hot-loop."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def step(s, b):
+    return s + b, s * 2
+
+
+def train(s, batches):
+    tot = 0.0
+    for b in batches:
+        s, m = step(s, b)
+        tot += float(m)  # line 15: unconditional sync every step
+    return tot
+
+
+def materialize(s, batches):
+    rows = []
+    for b in batches:
+        s, m = step(s, b)
+        rows.append(np.asarray(m))  # line 23: device->host copy per step
+    return rows

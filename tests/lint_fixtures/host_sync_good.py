@@ -1,0 +1,31 @@
+"""GOOD fixture: host-sync-in-hot-loop — gated or hoisted syncs."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(s, b):
+    return s + b, s * 2
+
+
+def train_gated(s, batches, log_int=10):
+    last = None
+    for i, b in enumerate(batches):
+        s, m = step(s, b)
+        if i % log_int == 0:  # interval-gated: the allowed logging shape
+            last = float(m)
+    return last
+
+
+def train_accumulated(s, batches):
+    tot = jnp.zeros(())
+    for b in batches:
+        s, m = step(s, b)
+        tot = tot + m  # accumulates on device, no per-step sync
+    return float(tot)  # one sync, after the loop
+
+
+def data_loop(batches):
+    # np.asarray in a loop with NO jit dispatch is host-side data prep
+    return [np.asarray(b) for b in batches] + [np.asarray(b + 1) for b in batches]

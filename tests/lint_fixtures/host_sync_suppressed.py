@@ -1,0 +1,14 @@
+"""SUPPRESSED fixture: host-sync-in-hot-loop acknowledged inline (the
+per-token decode-yield shape, where the sync IS the API)."""
+import jax
+
+
+@jax.jit
+def step(s, b):
+    return s + b, s * 2
+
+
+def decode(s, batches):
+    for b in batches:
+        s, m = step(s, b)
+        yield float(m)  # graftlint: disable=host-sync-in-hot-loop

@@ -1,0 +1,9 @@
+"""BAD fixture: jit-in-loop."""
+import jax
+
+
+def run(fns, x):
+    for f in fns:
+        g = jax.jit(f)  # line 7: fresh wrapper (and cache entry) per iter
+        x = g(x)
+    return x

@@ -1,0 +1,9 @@
+"""GOOD fixture: jit-in-loop — the jit is hoisted out of the loop."""
+import jax
+
+
+def run(f, xs):
+    g = jax.jit(f)  # wrapped once
+    for x in xs:
+        x = g(x)
+    return x

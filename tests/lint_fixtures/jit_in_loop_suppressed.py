@@ -1,0 +1,10 @@
+"""SUPPRESSED fixture: jit-in-loop acknowledged inline (a deliberate
+per-shape wrapper in a bounded sweep)."""
+import jax
+
+
+def sweep(fns, x):
+    for f in fns:
+        g = jax.jit(f)  # graftlint: disable=jit-in-loop
+        x = g(x)
+    return x

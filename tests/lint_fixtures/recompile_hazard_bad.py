@@ -1,0 +1,25 @@
+"""BAD fixture: recompile-hazard. Never imported — analyzed as text."""
+import jax
+from functools import partial
+
+
+@jax.jit
+def branch_on_traced(x, n):
+    if n > 0:  # line 8: Python branch on traced param n
+        return x + 1
+    return x - 1
+
+
+@partial(jax.jit, static_argnums=(2,))
+def loop_on_traced(x, n, m):
+    for _ in range(n):  # line 15: range() over traced n (m IS static)
+        x = x + 1
+    return x
+
+
+def plain(x, cfg):
+    return x
+
+
+plain_j = jax.jit(plain, static_argnums=(1,))
+out = plain_j(1, [1, 2])  # line 25: non-hashable list at static position 1

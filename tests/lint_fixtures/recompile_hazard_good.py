@@ -1,0 +1,31 @@
+"""GOOD fixture: recompile-hazard — static marking / lax control flow."""
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=(1,))
+def branch_on_static(x, n):
+    if n > 0:  # n is static: branch is resolved at trace time
+        return x + 1
+    return x - 1
+
+
+@partial(jax.jit, static_argnames=("m",))
+def loop_on_static(x, m):
+    for _ in range(m):  # m is static by name
+        x = x + 1
+    return x
+
+
+@jax.jit
+def branch_on_device(x, n):
+    return jnp.where(n > 0, x + 1, x - 1)  # device select, no retrace
+
+
+def plain(x, cfg):
+    return x
+
+
+plain_j = jax.jit(plain, static_argnums=(1,))
+out = plain_j(1, (1, 2))  # hashable tuple for the static arg

@@ -1,0 +1,9 @@
+"""SUPPRESSED fixture: recompile-hazard acknowledged inline."""
+import jax
+
+
+@jax.jit
+def branch_on_traced(x, n):
+    if n > 0:  # graftlint: disable=recompile-hazard
+        return x + 1
+    return x - 1

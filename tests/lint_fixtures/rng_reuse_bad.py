@@ -1,0 +1,15 @@
+"""BAD fixture: rng-reuse."""
+import jax
+
+
+def double_consume(key):
+    a = jax.random.normal(key, (2,))
+    b = jax.random.uniform(key, (2,))  # line 7: key consumed twice
+    return a + b
+
+
+def loop_reuse(key, xs):
+    out = []
+    for x in xs:
+        out.append(jax.random.normal(key, (2,)) + x)  # line 14: per-iter reuse
+    return out

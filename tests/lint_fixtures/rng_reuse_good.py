@@ -1,0 +1,31 @@
+"""GOOD fixture: rng-reuse — split/fold_in between consumers."""
+import jax
+
+
+def split_between(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (2,))
+    b = jax.random.uniform(k2, (2,))
+    return a + b
+
+
+def split_in_loop(key, xs):
+    out = []
+    for x in xs:
+        key, sub = jax.random.split(key)
+        out.append(jax.random.normal(sub, (2,)) + x)
+    return out
+
+
+def branch_single_consume(key, flag):
+    if flag:
+        return jax.random.normal(key, (2,))
+    else:
+        return jax.random.uniform(key, (2,))  # other branch: not a reuse
+
+
+def disjoint_rows(key, k):
+    keys = jax.random.split(key, k + 1)
+    head = jax.vmap(lambda kk: jax.random.normal(kk, ()))(keys[:k])
+    tail = jax.random.normal(keys[k], ())  # different rows of the split
+    return head, tail

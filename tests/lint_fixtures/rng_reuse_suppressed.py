@@ -1,0 +1,9 @@
+"""SUPPRESSED fixture: rng-reuse acknowledged inline (e.g. a deliberate
+common-random-numbers experiment)."""
+import jax
+
+
+def crn_pair(key):
+    a = jax.random.normal(key, (2,))
+    b = jax.random.normal(key, (2,))  # graftlint: disable=rng-reuse
+    return a, b

@@ -1,0 +1,18 @@
+"""BAD fixture: tracer-leak."""
+import jax
+
+_CAPTURED = None
+
+
+class Model:
+    @jax.jit
+    def fwd(self, x):
+        self.cache = x * 2  # line 10: traced value escapes onto self
+        return x
+
+
+@jax.jit
+def stash(x):
+    global _CAPTURED
+    _CAPTURED = x  # line 17: traced value escapes to a global
+    return x

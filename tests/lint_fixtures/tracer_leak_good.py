@@ -1,0 +1,14 @@
+"""GOOD fixture: tracer-leak — state returned, not stashed."""
+import jax
+
+
+class Model:
+    @staticmethod
+    @jax.jit
+    def fwd(cache, x):
+        new_cache = x * 2  # local name: fine
+        return new_cache, x
+
+    def drive(self, x):
+        self.cache, y = self.fwd(getattr(self, "cache", x), x)  # outside jit
+        return y

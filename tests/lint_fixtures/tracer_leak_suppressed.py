@@ -1,0 +1,10 @@
+"""SUPPRESSED fixture: tracer-leak acknowledged inline (e.g. a debug
+counter the author accepts is trace-time-only)."""
+import jax
+
+
+class Model:
+    @jax.jit
+    def fwd(self, x):
+        self.trace_count = 1  # graftlint: disable=tracer-leak
+        return x * 2

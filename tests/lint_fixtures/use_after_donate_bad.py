@@ -1,0 +1,22 @@
+"""BAD fixture: use-after-donate."""
+import jax
+
+
+def f(s):
+    return s
+
+
+fj = jax.jit(f, donate_argnums=(0,))
+
+
+def straight_line(s0):
+    out = fj(s0)
+    y = s0 * 2  # line 14: s0's buffer was donated to fj
+    return out + y
+
+
+def in_loop(s0, batches):
+    outs = []
+    for b in batches:
+        outs.append(fj(s0))  # line 21: s0 donated again every iteration
+    return outs

@@ -1,0 +1,20 @@
+"""GOOD fixture: use-after-donate — the name is rebound from the result."""
+import jax
+
+
+def f(s):
+    return s
+
+
+fj = jax.jit(f, donate_argnums=(0,))
+
+
+def rebind(s0):
+    s0 = fj(s0)
+    return s0 * 2  # the rebound name is the live output buffer
+
+
+def rebind_in_loop(s0, batches):
+    for b in batches:
+        s0 = fj(s0)  # rebound every iteration: the donated chain pattern
+    return s0

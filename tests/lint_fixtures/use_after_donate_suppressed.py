@@ -1,0 +1,16 @@
+"""SUPPRESSED fixture: use-after-donate acknowledged inline (e.g. the
+backend is known to ignore donation on CPU)."""
+import jax
+
+
+def f(s):
+    return s
+
+
+fj = jax.jit(f, donate_argnums=(0,))
+
+
+def checked(s0):
+    out = fj(s0)
+    y = s0 * 2  # graftlint: disable=use-after-donate
+    return out + y

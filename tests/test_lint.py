@@ -1,0 +1,193 @@
+"""graftlint gate + rule-behavior tests.
+
+Pure-AST: no jax import, no device work — these run fast on CPU and are
+NOT marked slow. The fixtures under tests/lint_fixtures/ are analyzed as
+text, never imported.
+
+Two jobs:
+  1. Gate the package: the merged tree must produce ZERO non-baselined
+     findings, and every baselined finding must carry a real reason.
+  2. Pin rule behavior: each rule fires at exact (rule, line) positions
+     in its bad fixture, stays silent on its good fixture, and is
+     silenced (but counted) by inline suppression.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mlx_cuda_distributed_pretraining_tpu.analysis import (
+    all_rules,
+    default_baseline_path,
+    lint_file,
+    load_baseline,
+    run_lint,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "mlx_cuda_distributed_pretraining_tpu")
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+
+EXPECTED_RULE_IDS = {
+    "recompile-hazard",
+    "rng-reuse",
+    "host-sync-in-hot-loop",
+    "use-after-donate",
+    "tracer-leak",
+    "jit-in-loop",
+}
+
+
+def _hits(path):
+    """(active findings, suppressed findings) for one fixture file."""
+    return lint_file(os.path.join(FIXTURES, path))
+
+
+def _rule_lines(findings, rule):
+    return sorted(f.line for f in findings if f.rule == rule)
+
+
+# -- the gate ---------------------------------------------------------------
+
+def test_registry_has_all_rules():
+    ids = set(all_rules())
+    assert EXPECTED_RULE_IDS <= ids, f"missing rules: {EXPECTED_RULE_IDS - ids}"
+
+
+def test_package_has_no_new_findings():
+    """The CI gate: the merged tree must be clean modulo the baseline."""
+    result = run_lint([PKG], baseline=load_baseline(None))
+    assert not result.new, "new graftlint findings:\n" + "\n".join(
+        f"  {f.path}:{f.line}: [{f.rule}] {f.message}" for f in result.new)
+
+
+def test_every_baseline_entry_has_a_reason():
+    entries = load_baseline(None)
+    assert entries, "baseline.json should exist and carry the triaged entries"
+    for e in entries:
+        reason = (e.get("reason") or "").strip()
+        assert reason, f"baseline entry without reason: {e}"
+        assert "REPLACE with a one-line justification" not in reason, (
+            f"placeholder reason left in baseline: {e['path']}:{e['line']}")
+
+
+def test_baseline_entries_all_still_match():
+    """A baseline entry whose finding was fixed should be pruned, not kept."""
+    result = run_lint([PKG], baseline=load_baseline(None))
+    assert not result.stale_baseline, (
+        "stale baseline entries (fix was made — prune them):\n" + "\n".join(
+            f"  {e.get('path')}:{e.get('line')}: [{e.get('rule')}]"
+            for e in result.stale_baseline))
+
+
+# -- per-rule fixtures: bad fires at exact lines ----------------------------
+
+@pytest.mark.parametrize("fixture,rule,lines", [
+    ("recompile_hazard_bad.py", "recompile-hazard", [8, 15, 25]),
+    ("rng_reuse_bad.py", "rng-reuse", [7, 14]),
+    ("host_sync_bad.py", "host-sync-in-hot-loop", [15, 23]),
+    ("use_after_donate_bad.py", "use-after-donate", [14, 21]),
+    ("tracer_leak_bad.py", "tracer-leak", [10, 17]),
+    ("jit_in_loop_bad.py", "jit-in-loop", [7]),
+])
+def test_bad_fixture_fires_at_exact_lines(fixture, rule, lines):
+    active, _ = _hits(fixture)
+    assert _rule_lines(active, rule) == lines, (
+        f"{fixture}: expected {rule} at {lines}, got "
+        f"{[(f.rule, f.line) for f in active]}")
+
+
+@pytest.mark.parametrize("fixture", [
+    "recompile_hazard_good.py",
+    "rng_reuse_good.py",
+    "host_sync_good.py",
+    "use_after_donate_good.py",
+    "tracer_leak_good.py",
+    "jit_in_loop_good.py",
+])
+def test_good_fixture_is_clean(fixture):
+    active, suppressed = _hits(fixture)
+    assert not active, [(f.rule, f.line, f.message) for f in active]
+    assert not suppressed, "good fixtures must not rely on suppressions"
+
+
+@pytest.mark.parametrize("fixture,rule,line", [
+    ("recompile_hazard_suppressed.py", "recompile-hazard", 7),
+    ("rng_reuse_suppressed.py", "rng-reuse", 8),
+    ("host_sync_suppressed.py", "host-sync-in-hot-loop", 14),
+    ("use_after_donate_suppressed.py", "use-after-donate", 15),
+    ("tracer_leak_suppressed.py", "tracer-leak", 9),
+    ("jit_in_loop_suppressed.py", "jit-in-loop", 8),
+])
+def test_suppression_silences_but_counts(fixture, rule, line):
+    active, suppressed = _hits(fixture)
+    assert not active, [(f.rule, f.line) for f in active]
+    assert [(f.rule, f.line) for f in suppressed] == [(rule, line)]
+
+
+# -- baseline mechanics -----------------------------------------------------
+
+def test_baseline_absorbs_then_budget_exhausts(tmp_path):
+    """One baseline entry absorbs exactly one matching finding."""
+    bad = os.path.join(FIXTURES, "jit_in_loop_bad.py")
+    active, _ = _hits("jit_in_loop_bad.py")
+    entry = {**active[0].to_dict(), "reason": "test entry"}
+
+    absorbed = run_lint([bad], baseline=[entry])
+    assert not absorbed.new and len(absorbed.baselined) == 1
+
+    # Same entry against a clean file: reported stale, but never failing.
+    clean = os.path.join(FIXTURES, "jit_in_loop_good.py")
+    stale = run_lint([clean], baseline=[entry])
+    assert not stale.new and len(stale.stale_baseline) == 1
+
+
+def test_baseline_match_ignores_line_numbers():
+    """Moving a grandfathered finding (unrelated edits above it) must not
+    break the gate: matching is on (rule, path, message), not line."""
+    bad = os.path.join(FIXTURES, "jit_in_loop_bad.py")
+    active, _ = _hits("jit_in_loop_bad.py")
+    entry = {**active[0].to_dict(), "reason": "test entry", "line": 99999}
+    result = run_lint([bad], baseline=[entry])
+    assert not result.new and len(result.baselined) == 1
+
+
+# -- CLI contract -----------------------------------------------------------
+
+def _run_cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "mlx_cuda_distributed_pretraining_tpu.analysis.lint",
+         *args],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120)
+
+
+def test_cli_exit_zero_on_package():
+    proc = _run_cli(PKG)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_exit_one_on_bad_fixture_and_json_shape():
+    proc = _run_cli("--format", "json", "--no-baseline",
+                    os.path.join(FIXTURES, "host_sync_bad.py"))
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["tool"] == "graftlint"
+    assert {f["rule"] for f in doc["new"]} == {"host-sync-in-hot-loop"}
+    assert sorted(f["line"] for f in doc["new"]) == [15, 23]
+    for key in ("baselined", "suppressed", "stale_baseline"):
+        assert key in doc
+
+
+def test_cli_exit_two_on_missing_path():
+    proc = _run_cli(os.path.join(FIXTURES, "does_not_exist.py"))
+    assert proc.returncode == 2
+
+
+def test_cli_list_rules_names_every_rule():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    for rule_id in EXPECTED_RULE_IDS:
+        assert rule_id in proc.stdout
