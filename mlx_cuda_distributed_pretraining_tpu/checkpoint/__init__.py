@@ -1,4 +1,16 @@
 from .safetensors_io import load_safetensors, save_safetensors
-from .manager import CheckpointManager
+from .manager import (
+    CheckpointIntegrityError,
+    CheckpointManager,
+    StaleBackgroundWriteError,
+)
+from . import faults
 
-__all__ = ["load_safetensors", "save_safetensors", "CheckpointManager"]
+__all__ = [
+    "load_safetensors",
+    "save_safetensors",
+    "CheckpointManager",
+    "CheckpointIntegrityError",
+    "StaleBackgroundWriteError",
+    "faults",
+]

@@ -13,24 +13,44 @@ model CLI) work unchanged:
             step_<N>_model.safetensors
             step_<N>_optimizer.safetensors
             step_<N>_state.json
+            step_<N>_data_p<P>.json       # per-host data-loader position
+            step_<N>.manifest.json        # integrity manifest, written LAST
+            quarantine/                   # artifacts that failed verify
 
 Arrays are gathered to host on save; optimizer state is stored as a
 flattened safetensors file plus a JSON sidecar for non-array leaves.
+
+Crash consistency: a step only *exists* once its manifest does. The
+manifest is written after every other artifact of the step (same
+temp+rename path), lists each artifact with its byte size and CRC32
+(computed from the bytes the writer streamed out, not re-read from
+disk), and is what resume trusts: ``latest_complete_step()`` walks
+candidates newest-first, re-reads and checksums every listed artifact,
+quarantines any step that fails, and falls back to the next older one.
+A crash between ``step_N_model.safetensors`` and
+``step_N_optimizer.safetensors`` therefore leaves a torn, *unmanifested*
+step that resume never selects — instead of a silently reset optimizer.
 """
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 import shutil
+import sys
 import time
-from typing import Any, Dict, Optional, Tuple
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 import jax
 import numpy as np
 
 from ..utils.tree import flatten_dict, unflatten_dict
+from .faults import commit_write
 from .safetensors_io import load_safetensors, save_safetensors
+
+MANIFEST_VERSION = 1
 
 
 def _to_numpy_tree(tree: Any) -> Any:
@@ -57,20 +77,58 @@ class StaleBackgroundWriteError(RuntimeError):
     preemption saves) can catch exactly this and proceed."""
 
 
-def _atomic_json(path: str, obj: Any) -> None:
+class CheckpointIntegrityError(RuntimeError):
+    """A checkpoint failed manifest verification (or an expected artifact
+    is missing/unreadable) and the caller asked for strict handling."""
+
+
+def _atomic_json(path: str, obj: Any) -> Tuple[int, int]:
     """Temp-file + rename: JSON sidecars get the same crash safety as the
     safetensors files (an interrupted rewrite must not truncate a good
-    file — a corrupt metadata.json would silently reset the ledger)."""
+    file — a corrupt metadata.json would silently reset the ledger).
+    Returns ``(nbytes, crc32)`` of the written content for manifesting."""
+    data = json.dumps(obj, indent=2).encode("utf-8")
     tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(obj, f, indent=2)
-    os.replace(tmp, path)
+    with open(tmp, "wb") as f:
+        f.write(data)
+    commit_write(tmp, path)
+    return len(data), zlib.crc32(data)
+
+
+def _crc32_file(path: str, chunk_size: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(chunk_size)
+            if not chunk:
+                return crc
+            crc = zlib.crc32(chunk, crc)
+
+
+def _step_sort_key(tag: str) -> Tuple[int, int]:
+    """Newest-first candidate order: "final" outranks any numeric step
+    (matching latest_step()); numeric steps descend; unknown tags last."""
+    if tag == "final":
+        return (0, 0)
+    if str(tag).isdigit():
+        return (1, -int(tag))
+    return (2, 0)
 
 
 class CheckpointManager:
-    def __init__(self, run_dir: str):
+    def __init__(self, run_dir: str, keep_last: int = 0, keep_every: int = 0,
+                 notify: Optional[Callable[[str], None]] = None):
         self.run_dir = run_dir
         self.checkpoint_dir = os.path.join(run_dir, "checkpoints")
+        # Retention: keep_last=0 disables GC entirely; keep_every=M always
+        # preserves steps divisible by M. "final" and protected steps
+        # (in-flight write, resume source) are never deleted.
+        self.keep_last = int(keep_last or 0)
+        self.keep_every = int(keep_every or 0)
+        self.protect_steps: Set[str] = set()
+        # Integrity events (quarantine, ledger rebuild, GC) must be LOUD;
+        # the trainer points this at its run logger.
+        self.notify = notify
         self._writer = None          # lazy background writer thread
         self._write_error: Optional[Exception] = None
         import threading
@@ -79,6 +137,12 @@ class CheckpointManager:
         # writer (ledger append) and the trainer (summary fields) — one
         # lock serializes every access.
         self._meta_lock = threading.Lock()
+
+    def _notify(self, msg: str) -> None:
+        if self.notify is not None:
+            self.notify(msg)
+        else:
+            print(f"checkpoint: {msg}", file=sys.stderr)
 
     # -- run dir lifecycle --------------------------------------------------
     @staticmethod
@@ -98,6 +162,15 @@ class CheckpointManager:
     def paths_for_step(self, step) -> Tuple[str, str, str]:
         base = os.path.join(self.checkpoint_dir, f"step_{step}")
         return (f"{base}_model.safetensors", f"{base}_optimizer.safetensors", f"{base}_state.json")
+
+    def manifest_path(self, step) -> str:
+        return os.path.join(self.checkpoint_dir, f"step_{step}.manifest.json")
+
+    def _sidecar_paths(self, step) -> List[str]:
+        """Per-host data-loader sidecars for a step (written by every
+        process; globbed here so the chief's manifest covers them)."""
+        return sorted(glob.glob(
+            os.path.join(self.checkpoint_dir, f"step_{step}_data_p*.json")))
 
     # -- save ---------------------------------------------------------------
     def save(
@@ -173,12 +246,41 @@ class CheckpointManager:
         (step, model_path, opt_path, state_path, flat_params,
          arrays, scalars, training_state, metadata_extra) = payload
         os.makedirs(self.checkpoint_dir, exist_ok=True)
-        save_safetensors(model_path, flat_params, metadata={"format": "pt"})
+        artifacts: Dict[str, Tuple[int, int]] = {}
+        artifacts[os.path.basename(model_path)] = save_safetensors(
+            model_path, flat_params, metadata={"format": "pt"})
         if arrays is not None:
-            save_safetensors(opt_path, arrays,
-                             metadata={"scalars": json.dumps(scalars)})
-        _atomic_json(state_path, training_state)
+            artifacts[os.path.basename(opt_path)] = save_safetensors(
+                opt_path, arrays, metadata={"scalars": json.dumps(scalars)})
+        artifacts[os.path.basename(state_path)] = _atomic_json(
+            state_path, training_state)
+        # Per-host data sidecars were written (atomically) by each process
+        # before save(); fold the ones visible now into the manifest so a
+        # torn sidecar fails verification like any other artifact. (On a
+        # multi-host fs a slow peer's sidecar may land after the manifest;
+        # it is then simply unverified, never a false failure.)
+        for sc in self._sidecar_paths(step):
+            artifacts[os.path.basename(sc)] = (os.path.getsize(sc), _crc32_file(sc))
+        self._write_manifest(step, artifacts)
         self._append_metadata(step, model_path, metadata_extra)
+        try:
+            self.gc_checkpoints(in_flight=step)
+        except OSError as e:
+            # Retention is best-effort: a GC hiccup (NFS race, perms) must
+            # never poison the save that just landed.
+            self._notify(f"WARNING: checkpoint GC failed: {e}")
+
+    def _write_manifest(self, step, artifacts: Dict[str, Tuple[int, int]]) -> None:
+        manifest = {
+            "format_version": MANIFEST_VERSION,
+            "step": int(step) if str(step).isdigit() else step,
+            "written_at": time.time(),
+            "artifacts": {
+                name: {"bytes": int(nbytes), "crc32": int(crc)}
+                for name, (nbytes, crc) in sorted(artifacts.items())
+            },
+        }
+        _atomic_json(self.manifest_path(step), manifest)
 
     def _writer_loop(self) -> None:
         while True:
@@ -212,14 +314,58 @@ class CheckpointManager:
             try:
                 with open(meta_path) as f:
                     return json.load(f)
-            except (json.JSONDecodeError, OSError):
+            except json.JSONDecodeError:
+                # A corrupt ledger must not silently reset history on the
+                # next append: preserve the bad bytes for forensics and
+                # rebuild the checkpoint list from what's on disk.
+                corrupt = meta_path + ".corrupt"
+                try:
+                    os.replace(meta_path, corrupt)
+                    self._notify(
+                        f"WARNING: metadata.json is corrupt; preserved as "
+                        f"{corrupt} and rebuilding the ledger from a "
+                        f"checkpoint-dir scan")
+                except OSError:
+                    pass
+                return self._rebuild_ledger()
+            except OSError:
                 pass
         return {}
+
+    def _rebuild_ledger(self) -> Dict[str, Any]:
+        """Reconstruct the checkpoint list by scanning the checkpoint dir
+        (oldest first, matching append order). Timestamps come from the
+        step manifests when present, file mtimes otherwise."""
+        entries: List[Dict[str, Any]] = []
+        if os.path.isdir(self.checkpoint_dir):
+            tags: List[str] = []
+            for fname in os.listdir(self.checkpoint_dir):
+                if fname.endswith("_model.safetensors") and fname.startswith("step_"):
+                    tags.append(fname[len("step_"):-len("_model.safetensors")])
+            for tag in sorted(tags, key=_step_sort_key, reverse=True):
+                model_path, _, _ = self.paths_for_step(tag)
+                ts = os.path.getmtime(model_path)
+                try:
+                    with open(self.manifest_path(tag)) as f:
+                        # pure JSON-field coercion, no device work
+                        ts = float(json.load(f).get("written_at", ts))  # graftlint: disable=host-sync-in-hot-loop
+                except (OSError, json.JSONDecodeError, TypeError, ValueError):
+                    pass
+                entries.append({
+                    "step": int(tag) if tag.isdigit() else tag,
+                    "path": model_path,
+                    "timestamp": ts,
+                    "rebuilt": True,
+                })
+        return {"checkpoints": entries, "ledger_rebuilt_at": time.time()} if entries else {}
 
     def _append_metadata(self, step, model_path: str, extra: Optional[Dict[str, Any]]) -> None:
         with self._meta_lock:
             ledger = self._load_ledger()
             entries = ledger.setdefault("checkpoints", [])
+            # A rebuilt ledger already scanned this step's files off disk;
+            # re-saves of a tag likewise replace rather than duplicate.
+            entries[:] = [e for e in entries if str(e.get("step")) != str(step)]
             entry = {"step": step, "path": model_path, "timestamp": time.time()}
             if extra:
                 entry.update(extra)
@@ -236,32 +382,67 @@ class CheckpointManager:
 
     # -- load ---------------------------------------------------------------
     def load(
-        self, step, like_params: Optional[Any] = None, like_opt_state: Optional[Any] = None
+        self, step, like_params: Optional[Any] = None, like_opt_state: Optional[Any] = None,
+        strict: bool = False,
     ) -> Tuple[Any, Optional[Any], Dict[str, Any]]:
+        """Load the step triplet. When the caller expects optimizer state
+        (``like_opt_state`` given) but the file is missing or unreadable,
+        this WARNS loudly and returns ``opt_state=None`` — the trainer then
+        continues with a fresh optimizer, which silently degrades Adam/Muon
+        moment statistics. ``strict=True`` (config ``resume.strict``) turns
+        that degradation into a hard :class:`CheckpointIntegrityError`."""
         model_path, opt_path, state_path = self.paths_for_step(step)
         params = self.load_params(model_path, like=like_params)
 
         opt_state = None
-        if like_opt_state is not None and os.path.exists(opt_path):
-            arrays, meta = load_safetensors(opt_path)
-            scalars = json.loads(meta.get("scalars", "{}"))
-            flat = dict(arrays)
-            flat.update(scalars)
-            like_flat = flatten_dict(_to_numpy_tree(like_opt_state))
-            rebuilt = {}
-            for k, ref in like_flat.items():
-                if k in flat:
-                    v = flat[k]
-                    if isinstance(ref, np.ndarray) and isinstance(v, np.ndarray):
-                        rebuilt[k] = v.astype(ref.dtype).reshape(ref.shape)
-                    elif ref is None or v is None or isinstance(v, np.ndarray):
-                        rebuilt[k] = v
+        if like_opt_state is not None:
+            flat = None
+            if not os.path.exists(opt_path):
+                msg = (f"checkpoint step {step}: expected optimizer file "
+                       f"{opt_path} is MISSING — resuming would silently "
+                       f"reset the optimizer")
+                if strict:
+                    raise CheckpointIntegrityError(msg)
+                self._notify(f"WARNING: {msg}; continuing with a fresh "
+                             f"optimizer (resume.strict: true to fail instead)")
+            else:
+                try:
+                    arrays, meta = load_safetensors(opt_path)
+                    scalars = json.loads(meta.get("scalars", "{}"))
+                    flat = dict(arrays)
+                    flat.update(scalars)
+                except Exception as e:  # noqa: BLE001 - any torn/garbled file
+                    msg = (f"checkpoint step {step}: optimizer file "
+                           f"{opt_path} is UNREADABLE ({type(e).__name__}: {e})")
+                    if strict:
+                        raise CheckpointIntegrityError(msg) from e
+                    self._notify(f"WARNING: {msg}; continuing with a fresh "
+                                 f"optimizer (resume.strict: true to fail instead)")
+            if flat is not None:
+                like_flat = flatten_dict(_to_numpy_tree(like_opt_state))
+                rebuilt = {}
+                missing = []
+                for k, ref in like_flat.items():
+                    if k in flat:
+                        v = flat[k]
+                        if isinstance(ref, np.ndarray) and isinstance(v, np.ndarray):
+                            rebuilt[k] = v.astype(ref.dtype).reshape(ref.shape)
+                        elif ref is None or v is None or isinstance(v, np.ndarray):
+                            rebuilt[k] = v
+                        else:
+                            rebuilt[k] = type(ref)(v)
                     else:
-                        rebuilt[k] = type(ref)(v)
-                else:
-                    rebuilt[k] = ref
-            nested = unflatten_dict(rebuilt)
-            opt_state = _restructure_like(like_opt_state, nested)
+                        missing.append(k)
+                        rebuilt[k] = ref
+                if missing:
+                    msg = (f"checkpoint step {step}: optimizer file lacks "
+                           f"{len(missing)}/{len(like_flat)} expected leaves "
+                           f"(e.g. {missing[0]!r}) — those keep fresh values")
+                    if strict:
+                        raise CheckpointIntegrityError(msg)
+                    self._notify(f"WARNING: {msg}")
+                nested = unflatten_dict(rebuilt)
+                opt_state = _restructure_like(like_opt_state, nested)
 
         training_state: Dict[str, Any] = {}
         if os.path.exists(state_path):
@@ -302,6 +483,132 @@ class CheckpointManager:
         if has_final:
             return "final"
         return str(max(steps)) if steps else None
+
+    # -- integrity: manifests, verification, quarantine, retention ----------
+    def manifested_steps(self) -> List[str]:
+        """Step tags that have a manifest file, newest first ("final"
+        outranks numeric steps, matching latest_step())."""
+        if not os.path.isdir(self.checkpoint_dir):
+            return []
+        tags = []
+        for fname in os.listdir(self.checkpoint_dir):
+            if fname.startswith("step_") and fname.endswith(".manifest.json"):
+                tags.append(fname[len("step_"):-len(".manifest.json")])
+        return sorted(tags, key=_step_sort_key)
+
+    def has_manifests(self) -> bool:
+        return bool(self.manifested_steps())
+
+    def verify(self, step) -> Tuple[bool, str]:
+        """Re-read every artifact the step's manifest lists and check
+        existence, byte size, and CRC32. Returns ``(ok, reason)``."""
+        mpath = self.manifest_path(step)
+        if not os.path.isfile(mpath):
+            return False, "no manifest"
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+            artifacts = manifest["artifacts"]
+            if not isinstance(artifacts, dict) or not artifacts:
+                raise ValueError("empty artifacts table")
+        except (json.JSONDecodeError, OSError, KeyError, ValueError, TypeError) as e:
+            return False, f"torn manifest ({type(e).__name__}: {e})"
+        for name, info in artifacts.items():
+            path = os.path.join(self.checkpoint_dir, name)
+            if not os.path.isfile(path):
+                return False, f"missing artifact {name}"
+            try:
+                want_bytes, want_crc = int(info["bytes"]), int(info["crc32"])
+            except (KeyError, TypeError, ValueError):
+                return False, f"torn manifest entry for {name}"
+            if os.path.getsize(path) != want_bytes:
+                return False, (f"size mismatch for {name} "
+                               f"({os.path.getsize(path)} != {want_bytes})")
+            if _crc32_file(path) != want_crc:
+                return False, f"crc32 mismatch for {name}"
+        return True, "ok"
+
+    def quarantine_step(self, step, reason: str) -> List[str]:
+        """Move every file of a corrupt step into ``checkpoints/quarantine/``
+        (with a reason note) so it can never shadow a good checkpoint but
+        stays available for forensics."""
+        qdir = os.path.join(self.checkpoint_dir, "quarantine")
+        os.makedirs(qdir, exist_ok=True)
+        victims = glob.glob(os.path.join(self.checkpoint_dir, f"step_{step}_*"))
+        mpath = self.manifest_path(step)
+        if os.path.isfile(mpath):
+            victims.append(mpath)
+        moved = []
+        for path in victims:
+            try:
+                os.replace(path, os.path.join(qdir, os.path.basename(path)))
+                moved.append(os.path.basename(path))
+            except OSError:
+                pass  # partially quarantined is still out of the resume path
+        with open(os.path.join(qdir, f"step_{step}.reason.txt"), "a") as f:
+            f.write(f"{time.time():.0f} {reason}; moved: {', '.join(moved) or 'nothing'}\n")
+        self._notify(f"WARNING: quarantined checkpoint step {step} ({reason}) "
+                     f"-> {qdir}")
+        return moved
+
+    def latest_complete_step(self) -> Optional[str]:
+        """Newest step tag that passes full manifest verification.
+
+        Walks manifested steps newest-first; any candidate that fails
+        verification is QUARANTINED and the next older one is tried, so a
+        torn/corrupt newest checkpoint degrades resume by one interval
+        instead of crashing the run or silently resetting state. Runs
+        predating manifests (none present at all) fall back to
+        :meth:`latest_step` so old checkpoints remain loadable."""
+        candidates = self.manifested_steps()
+        if not candidates:
+            legacy = self.latest_step()
+            if legacy is not None:
+                self._notify(
+                    f"checkpoints in {self.checkpoint_dir} predate integrity "
+                    f"manifests; resuming unverified step {legacy}")
+            return legacy
+        for tag in candidates:
+            ok, reason = self.verify(tag)
+            if ok:
+                return tag
+            self.quarantine_step(tag, reason)
+        return None
+
+    def gc_checkpoints(self, in_flight=None) -> List[str]:
+        """Retention GC, run after each successful manifest write. Deletes
+        the oldest manifested numeric steps beyond ``keep_last``, except
+        steps divisible by ``keep_every``, anything in ``protect_steps``
+        (the resume source), the in-flight step, and "final". Artifacts go
+        first and the manifest last, so a crash mid-delete leaves a step
+        that fails verification (and gets quarantined) rather than a
+        manifest-less orphan that lingers forever."""
+        if self.keep_last <= 0:
+            return []
+        numeric = sorted(
+            (int(t) for t in self.manifested_steps() if str(t).isdigit()))
+        keep = set(numeric[-self.keep_last:])
+        if self.keep_every > 0:
+            keep.update(s for s in numeric if s % self.keep_every == 0)
+        protected = {str(s) for s in self.protect_steps}
+        if in_flight is not None:
+            protected.add(str(in_flight))
+        removed = []
+        for s in numeric:
+            if s in keep or str(s) in protected:
+                continue
+            for path in glob.glob(
+                    os.path.join(self.checkpoint_dir, f"step_{s}_*")):
+                os.unlink(path)
+            mpath = self.manifest_path(s)
+            if os.path.isfile(mpath):
+                os.unlink(mpath)
+            removed.append(str(s))
+        if removed:
+            self._notify(
+                f"retention GC removed step(s) {', '.join(removed)} "
+                f"(keep_last={self.keep_last}, keep_every={self.keep_every})")
+        return removed
 
 
 def _restructure_like(like: Any, nested_dict: Any) -> Any:

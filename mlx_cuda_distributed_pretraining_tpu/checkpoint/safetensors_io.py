@@ -14,10 +14,13 @@ from __future__ import annotations
 import json
 import os
 import struct
+import zlib
 from typing import Any, Dict, Optional, Tuple
 
 import ml_dtypes
 import numpy as np
+
+from .faults import commit_write
 
 _DTYPE_TO_ST = {
     np.dtype(np.float64): "F64",
@@ -43,8 +46,13 @@ def save_safetensors(
     path: str,
     tensors: Dict[str, np.ndarray],
     metadata: Optional[Dict[str, str]] = None,
-) -> None:
-    """Write ``tensors`` (flat dict of numpy arrays) to ``path``."""
+) -> Tuple[int, int]:
+    """Write ``tensors`` (flat dict of numpy arrays) to ``path``.
+
+    Returns ``(nbytes, crc32)`` of the full file content, computed while
+    the bytes stream out — the step manifest records what the writer
+    *intended* to put on disk, so a torn/dropped write shows up as a
+    mismatch on verify instead of being checksummed as-is."""
     header: Dict[str, Any] = {}
     if metadata:
         header["__metadata__"] = {str(k): str(v) for k, v in metadata.items()}
@@ -74,14 +82,18 @@ def save_safetensors(
 
     # Write-to-temp then atomic rename: an interrupted write (crash, killed
     # background checkpoint thread) must never shadow a good checkpoint
-    # with a truncated file.
+    # with a truncated file. The rename goes through the fault-injection
+    # choke point (faults.commit_write — a plain os.replace in production).
     tmp = path + ".tmp"
+    nbytes = 0
+    crc = 0
     with open(tmp, "wb") as f:
-        f.write(struct.pack("<Q", len(header_bytes)))
-        f.write(header_bytes)
-        for data in blobs:
-            f.write(data)
-    os.replace(tmp, path)
+        for chunk in (struct.pack("<Q", len(header_bytes)), header_bytes, *blobs):
+            f.write(chunk)
+            crc = zlib.crc32(chunk, crc)
+            nbytes += len(chunk)
+    commit_write(tmp, path)
+    return nbytes, crc
 
 
 def load_safetensors(path: str) -> Tuple[Dict[str, np.ndarray], Dict[str, str]]:

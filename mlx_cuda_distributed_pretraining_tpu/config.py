@@ -175,6 +175,11 @@ class LoggingConfig:
     # tracing plan).
     profile_start: int = 0
     profile_stop: int = 0
+    # Checkpoint retention: after each successful (manifested) save, delete
+    # interval checkpoints beyond the newest keep_last, except steps
+    # divisible by keep_every, the resume-source step, and "final".
+    # keep_last: 0 disables GC (keep everything).
+    retention: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def logging_interval(self) -> int:
@@ -194,6 +199,14 @@ class LoggingConfig:
         published there each logging interval when set."""
         url = _get(self.metrics, "stats_url", None)
         return str(url) if url else None
+
+    @property
+    def keep_last(self) -> int:
+        return int(_get(self.retention, "keep_last", 0))
+
+    @property
+    def keep_every(self) -> int:
+        return int(_get(self.retention, "keep_every", 0))
 
 
 @dataclass
@@ -273,11 +286,17 @@ class SystemConfig:
 
 @dataclass
 class ResumeConfig:
-    """Section ``resume`` (reference: core/training.py:124-127)."""
+    """Section ``resume`` (reference: core/training.py:124-127).
+
+    ``strict`` (TPU addition): fail hard on ANY checkpoint integrity
+    problem (failed manifest verification, missing/unreadable optimizer
+    state) instead of warning and falling back to an older checkpoint or
+    a fresh optimizer."""
 
     checkpoint: str = ""
     reset_optimizer: bool = False
     reset_training_state: bool = False
+    strict: bool = False
 
 
 _SECTION_TYPES = {

@@ -1,4 +1,6 @@
 from .trainer import Trainer, main
 from .train_step import make_train_step, make_eval_step
+from .supervisor import Supervisor, CrashLoopError
 
-__all__ = ["Trainer", "main", "make_train_step", "make_eval_step"]
+__all__ = ["Trainer", "main", "make_train_step", "make_eval_step",
+           "Supervisor", "CrashLoopError"]

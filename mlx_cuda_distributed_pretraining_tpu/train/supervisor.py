@@ -1,0 +1,219 @@
+"""Auto-resume supervisor: restart crashed/preempted training.
+
+``python train.py --config C --auto-resume`` runs the trainer in a child
+subprocess and restarts it after any non-zero exit, resuming from the
+newest checkpoint that passes manifest verification
+(``CheckpointManager.latest_complete_step`` — torn checkpoints from the
+crash itself are quarantined, never resumed). On preemptible TPU pods
+this closes the loop SURVEY §5 leaves open: checkpoint-resume is the
+entire recovery story, so recovery must not need a human.
+
+Crash-loop detection: restarts back off exponentially (``backoff_base``
+doubling up to ``backoff_max``), and the supervisor gives up after
+``max_crashes_per_step`` consecutive crashes with NO checkpoint progress
+between them — a deterministic crash (bad config, poisoned data batch,
+OOM at a fixed step) fails fast instead of burning the pod forever,
+while a flaky-infra crash that still advances checkpoints resets the
+counter and restarts indefinitely.
+
+SIGTERM/SIGINT to the supervisor forward to the child (which saves a
+preemption checkpoint and exits cleanly — train loop signal handling);
+the supervisor then exits without restarting.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..checkpoint.manager import CheckpointManager
+
+
+class CrashLoopError(RuntimeError):
+    """The child kept crashing without making checkpoint progress."""
+
+
+class Supervisor:
+    """Restart loop around one training subprocess.
+
+    ``build_cmd(resume_tag)`` returns the child argv for a launch that
+    should resume from ``resume_tag`` (a verified step tag, or None for a
+    fresh start) — injected so tests can drive the loop with stub
+    children and so the CLI glue below owns the real trainer command.
+    """
+
+    def __init__(
+        self,
+        build_cmd: Callable[[Optional[str]], List[str]],
+        run_dir: str,
+        max_crashes_per_step: int = 3,
+        backoff_base: float = 2.0,
+        backoff_max: float = 60.0,
+        on_spawn: Optional[Callable[[subprocess.Popen], None]] = None,
+        log: Callable[[str], None] = lambda m: print(m, file=sys.stderr),
+        env: Optional[Dict[str, str]] = None,
+    ):
+        self.build_cmd = build_cmd
+        self.run_dir = run_dir
+        self.max_crashes_per_step = int(max_crashes_per_step)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.on_spawn = on_spawn
+        self.log = log
+        self.env = env
+        self.restarts = 0
+        self._child: Optional[subprocess.Popen] = None
+        self._shutdown_signal: Optional[int] = None
+
+    def latest_resumable(self) -> Optional[str]:
+        """Newest verified step tag, or None. Runs the same quarantining
+        scan the child's resume would, so a corrupt newest checkpoint is
+        already set aside before the child even launches."""
+        try:
+            return CheckpointManager(self.run_dir, notify=self.log).latest_complete_step()
+        except OSError as e:
+            self.log(f"supervisor: checkpoint scan failed ({e}); treating as fresh")
+            return None
+
+    def _forward_signal(self, signum, frame) -> None:
+        self._shutdown_signal = signum
+        child = self._child
+        if child is not None and child.poll() is None:
+            child.send_signal(signum)
+
+    def run(self) -> int:
+        """Drive the child to a zero exit. Returns the final exit code (0,
+        or the child's code after a forwarded shutdown signal); raises
+        :class:`CrashLoopError` after ``max_crashes_per_step`` consecutive
+        no-progress crashes."""
+        prev_handlers = {}
+        try:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                prev = signal.signal(sig, self._forward_signal)
+                prev_handlers[sig] = prev if prev is not None else signal.SIG_DFL
+        except (ValueError, OSError):  # non-main thread (tests)
+            prev_handlers = {}
+
+        crashes = 0
+        tag_after_last_crash: Optional[str] = None
+        try:
+            while True:
+                tag = self.latest_resumable()
+                cmd = self.build_cmd(tag)
+                self.log(f"supervisor: launching child "
+                         f"(resume={tag if tag is not None else 'fresh'})")
+                self._child = subprocess.Popen(cmd, env=self.env)
+                if self.on_spawn is not None:
+                    self.on_spawn(self._child)
+                rc = self._child.wait()
+                if rc == 0:
+                    self.log("supervisor: child completed cleanly")
+                    return 0
+                if self._shutdown_signal is not None:
+                    # Forwarded preemption: the child saved and exited; a
+                    # restart would defeat the point of the signal.
+                    self.log(f"supervisor: shutdown signal "
+                             f"{self._shutdown_signal} forwarded; not restarting")
+                    return rc
+                new_tag = self.latest_resumable()
+                if new_tag is not None and new_tag != tag_after_last_crash:
+                    crashes = 1  # progress since the last crash — reset
+                else:
+                    crashes += 1
+                tag_after_last_crash = new_tag
+                if crashes >= self.max_crashes_per_step:
+                    raise CrashLoopError(
+                        f"giving up after {crashes} consecutive crashes with "
+                        f"no checkpoint progress (stuck at "
+                        f"{new_tag if new_tag is not None else 'no checkpoint'}, "
+                        f"last exit code {rc})")
+                delay = min(self.backoff_base * (2 ** (crashes - 1)),
+                            self.backoff_max)
+                self.restarts += 1
+                self.log(f"supervisor: child exited rc={rc} "
+                         f"(crash {crashes}/{self.max_crashes_per_step} at "
+                         f"checkpoint {new_tag}); restarting in {delay:.1f}s")
+                time.sleep(delay)
+        finally:
+            self._child = None
+            for sig, h in prev_handlers.items():
+                try:
+                    signal.signal(sig, h)
+                except (ValueError, OSError):
+                    pass
+
+
+def _trainer_cmd_builder(args) -> Callable[[Optional[str]], List[str]]:
+    """Child argv for the real trainer, rebuilt from the parsed supervisor
+    args (so ``--auto-resume`` and the supervisor knobs never leak into
+    the child)."""
+    base = [sys.executable, "-m",
+            "mlx_cuda_distributed_pretraining_tpu.train.trainer",
+            "--config", args.config, "--runs-root", args.runs_root]
+    for kv in args.set:
+        base += ["--set", kv]
+    if args.iters is not None:
+        base += ["--iters", str(args.iters)]
+    if args.batch_size is not None:
+        base += ["--batch-size", str(args.batch_size)]
+    if args.learning_rate is not None:
+        base += ["--learning-rate", str(args.learning_rate)]
+    if args.run_name:
+        base += ["--run-name", args.run_name]
+
+    def build(resume_tag: Optional[str]) -> List[str]:
+        cmd = list(base)
+        if resume_tag is not None:
+            # Resume from the tag the SUPERVISOR verified (not "latest"):
+            # deterministic even if files change between scan and launch.
+            cmd += ["--set", f"resume.checkpoint={resume_tag}",
+                    "--set", "overwrite=false"]
+        else:
+            # Fresh (re)start: the run dir may exist from a crash that
+            # never reached a checkpoint — nothing in it is worth more
+            # than getting training going again.
+            cmd += ["--set", "overwrite=true"]
+        return cmd
+
+    return build
+
+
+def supervise_from_args(args) -> Dict[str, Any]:
+    """Entry point used by ``trainer.main`` for ``--auto-resume``."""
+    import yaml
+
+    from ..config import apply_overrides
+    from .trainer import collect_overrides
+
+    with open(args.config) as f:
+        raw = yaml.safe_load(f)
+    merged = apply_overrides(raw, collect_overrides(args))
+    run_dir = os.path.join(args.runs_root, merged["name"])
+
+    sup = Supervisor(
+        _trainer_cmd_builder(args),
+        run_dir,
+        max_crashes_per_step=args.max_crashes,
+        backoff_base=args.backoff_base,
+        backoff_max=args.backoff_max,
+    )
+    rc = sup.run()
+    return {"supervised": True, "exit_code": rc, "restarts": sup.restarts,
+            "run_dir": run_dir}
+
+
+def main(argv=None) -> Dict[str, Any]:
+    """Standalone CLI: ``python -m ...train.supervisor --config C`` — same
+    flags as the trainer; --auto-resume is implied."""
+    from .trainer import build_parser
+
+    args = build_parser().parse_args(argv)
+    return supervise_from_args(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -26,7 +26,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..checkpoint import CheckpointManager
+from ..checkpoint import CheckpointIntegrityError, CheckpointManager
+from ..checkpoint.manager import _atomic_json
 from ..config import Config, apply_overrides
 from ..data import DataManager
 from ..data.streaming import build_data_manager
@@ -80,9 +81,14 @@ class Trainer:
             run_dir = CheckpointManager.setup_run_directory(runs_root, cfg.name, cfg.overwrite)
         self.run_dir = run_dir
         os.makedirs(run_dir, exist_ok=True)
-        self.checkpoints = CheckpointManager(run_dir)
+        self.checkpoints = CheckpointManager(
+            run_dir, keep_last=cfg.logging.keep_last,
+            keep_every=cfg.logging.keep_every)
         is_chief = jax.process_index() == 0
         self.logger = Logger(run_dir, cfg, quiet=quiet or not is_chief, write_files=is_chief)
+        # Integrity events (quarantine, GC, ledger rebuild, degraded
+        # optimizer resume) surface in log.txt, not just stderr.
+        self.checkpoints.notify = self.logger.log
         if for_training and not resume and is_chief:
             cfg.to_yaml(os.path.join(run_dir, "config.yaml"))
 
@@ -326,8 +332,10 @@ class Trainer:
             sidecar = os.path.join(
                 self.checkpoints.checkpoint_dir,
                 f"step_{step}_data_p{jax.process_index()}.json")
-            with open(sidecar, "w") as f:
-                json.dump(self.data.state_dict(), f)
+            # Temp+rename (not a plain json.dump): a crash mid-write must
+            # not leave a torn sidecar that corrupts this host's resume
+            # position. The chief folds the sidecars into the step manifest.
+            _atomic_json(sidecar, self.data.state_dict())
         if jax.process_index() != 0:
             return
         training_state = {
@@ -350,17 +358,69 @@ class Trainer:
         self.checkpoints.update_ledger(
             validation=self.val_history, total_tokens=int(self.total_tokens))
 
-    def _resume(self) -> None:
-        """Resume from ``resume.checkpoint`` (reference: :1545-1564 with
-        reset_optimizer / reset_training_state flags :124-127)."""
+    def _resolve_resume_tag(self) -> Optional[str]:
+        """Map ``resume.checkpoint`` onto a VERIFIED step tag.
+
+        "latest"/"" asks latest_complete_step() for the newest manifested,
+        checksum-clean step (quarantining corrupt ones and falling back
+        through older checkpoints). An explicit tag is verified too: if it
+        fails, strict mode raises; otherwise it is quarantined and resume
+        falls back to the newest verified step. Returns None when nothing
+        resumable exists (caller starts from scratch, or raises in strict
+        mode)."""
         rc = self.config.resume
+        strict = bool(rc.strict)
         tag = rc.checkpoint
         if tag in ("latest", ""):
-            tag = self.checkpoints.latest_step() or "final"
+            resolved = self.checkpoints.latest_complete_step()
+            if resolved is None and strict:
+                raise CheckpointIntegrityError(
+                    f"resume.checkpoint={tag!r} with resume.strict: no "
+                    f"verified checkpoint exists in {self.checkpoints.checkpoint_dir}")
+            return resolved
+        ok, reason = self.checkpoints.verify(tag)
+        if ok:
+            return tag
+        if reason == "no manifest" and not self.checkpoints.has_manifests():
+            # Pre-manifest run: nothing to verify against; load as before.
+            self.logger.log(
+                f"resume: checkpoint {tag} predates integrity manifests; "
+                f"loading unverified")
+            return tag
+        if strict:
+            raise CheckpointIntegrityError(
+                f"resume.checkpoint={tag} failed verification ({reason}) "
+                f"and resume.strict is set")
+        self.logger.log(
+            f"WARNING: resume.checkpoint={tag} failed verification "
+            f"({reason}); quarantining it and falling back to the newest "
+            f"verified checkpoint")
+        self.checkpoints.quarantine_step(tag, reason)
+        return self.checkpoints.latest_complete_step()
+
+    def _resume(self) -> None:
+        """Resume from ``resume.checkpoint`` (reference: :1545-1564 with
+        reset_optimizer / reset_training_state flags :124-127), but only
+        ever from a checkpoint that passed manifest verification."""
+        rc = self.config.resume
+        tag = self._resolve_resume_tag()
+        if tag is None:
+            self.logger.log(
+                "WARNING: no resumable checkpoint found; starting from scratch")
+            return
+        # The resume source must survive retention GC for the whole run:
+        # until the first NEW checkpoint lands it is the only good state.
+        self.checkpoints.protect_steps.add(str(tag))
         params, opt_state, tstate = self.checkpoints.load(
             tag, like_params=self._host_params(),
             like_opt_state=None if rc.reset_optimizer else self._host_opt_state(),
+            strict=bool(rc.strict),
         )
+        if opt_state is None and not rc.reset_optimizer:
+            self.logger.log(
+                f"WARNING: resuming step {tag} WITHOUT optimizer state "
+                f"(missing/unreadable) — moment statistics restart from "
+                f"zero; set resume.strict: true to fail instead")
         step = 0 if rc.reset_training_state else int(tstate.get("step", 0))
         params = jax.tree_util.tree_map(jnp.asarray, params)
         if opt_state is not None:
@@ -751,9 +811,11 @@ def load_trained(run_name_or_dir: str, runs_root: str = "runs"):
     tok = TokenizerManager.from_run_dir(run_dir)
     args = LlamaArgs.from_config(cfg.model, tok.vocab_size)
     ckpts = CheckpointManager(run_dir)
-    tag = ckpts.latest_step()
+    # Verified resolution: never serve a torn checkpoint (falls back to
+    # unverified latest_step() only for pre-manifest runs).
+    tag = ckpts.latest_complete_step()
     if tag is None:
-        raise FileNotFoundError(f"no checkpoints in {run_dir}")
+        raise FileNotFoundError(f"no verified checkpoints in {run_dir}")
     model_path, _, _ = ckpts.paths_for_step(tag)
     ref = resolve_architecture(cfg.model.architecture)
     params0 = jax.eval_shape(lambda: ref.init_params(jax.random.PRNGKey(0), args))
@@ -775,25 +837,9 @@ def _restructure(like, nested):
     return nested
 
 
-def main(argv=None) -> Dict[str, Any]:
-    """CLI: ``python -m mlx_cuda_distributed_pretraining_tpu.train --config C``
-    with dotted overrides (reference: core/training.py:1907-2013 materializes
-    a temp YAML; here overrides apply in-memory)."""
-    parser = argparse.ArgumentParser(description="TPU-native LLM pretraining")
-    parser.add_argument("--config", required=True)
-    parser.add_argument("--runs-root", default="runs")
-    parser.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
-                        help="dotted config override, e.g. training.hyperparameters.batch_size=8")
-    parser.add_argument("--iters", type=int, default=None)
-    parser.add_argument("--batch-size", type=int, default=None)
-    parser.add_argument("--learning-rate", type=float, default=None)
-    parser.add_argument("--run-name", default=None)
-    args = parser.parse_args(argv)
-
-    import yaml
-
-    with open(args.config) as f:
-        raw = yaml.safe_load(f)
+def collect_overrides(args) -> Dict[str, Any]:
+    """Dotted-path overrides from parsed CLI args (shared with the
+    auto-resume supervisor, which must resolve the run name the same way)."""
     overrides: Dict[str, Any] = {}
     for kv in args.set:
         key, _, value = kv.partition("=")
@@ -810,7 +856,52 @@ def main(argv=None) -> Dict[str, Any]:
         overrides["training.hyperparameters.learning_rate"] = args.learning_rate
     if args.run_name:
         overrides["name"] = args.run_name
-    cfg = Config.from_dict(apply_overrides(raw, overrides))
+    return overrides
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description="TPU-native LLM pretraining")
+    parser.add_argument("--config", required=True)
+    parser.add_argument("--runs-root", default="runs")
+    parser.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
+                        help="dotted config override, e.g. training.hyperparameters.batch_size=8")
+    parser.add_argument("--iters", type=int, default=None)
+    parser.add_argument("--batch-size", type=int, default=None)
+    parser.add_argument("--learning-rate", type=float, default=None)
+    parser.add_argument("--run-name", default=None)
+    # Auto-resume supervision (train/supervisor.py): run the trainer in a
+    # restarted subprocess instead of this process.
+    parser.add_argument("--auto-resume", action="store_true",
+                        help="supervise training in a subprocess; on crash/"
+                             "preemption, restart it from the newest VERIFIED "
+                             "checkpoint with exponential backoff")
+    parser.add_argument("--max-crashes", type=int, default=3,
+                        help="give up after this many consecutive crashes "
+                             "without checkpoint progress (with --auto-resume)")
+    parser.add_argument("--backoff-base", type=float, default=2.0,
+                        help="first restart delay in seconds (doubles per "
+                             "no-progress crash; with --auto-resume)")
+    parser.add_argument("--backoff-max", type=float, default=60.0,
+                        help="restart delay ceiling in seconds (with --auto-resume)")
+    return parser
+
+
+def main(argv=None) -> Dict[str, Any]:
+    """CLI: ``python -m mlx_cuda_distributed_pretraining_tpu.train --config C``
+    with dotted overrides (reference: core/training.py:1907-2013 materializes
+    a temp YAML; here overrides apply in-memory)."""
+    args = build_parser().parse_args(argv)
+
+    if args.auto_resume:
+        from .supervisor import supervise_from_args
+
+        return supervise_from_args(args)
+
+    import yaml
+
+    with open(args.config) as f:
+        raw = yaml.safe_load(f)
+    cfg = Config.from_dict(apply_overrides(raw, collect_overrides(args)))
     trainer = Trainer(cfg, runs_root=args.runs_root)
     return trainer.train()
 

@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Launch a SUPERVISED training run in the background: the auto-resume
+# supervisor (train/supervisor.py) restarts the trainer after any crash
+# or preemption, resuming from the newest checkpoint that passes
+# manifest verification. Mirrors scripts/run_train.sh (PID file + log),
+# but the PID is the supervisor's — kill -TERM it for a clean,
+# checkpointed shutdown of the whole tree.
+#
+# Usage: scripts/chaos_train.sh <config.yaml> [runs_root] [max_crashes]
+set -euo pipefail
+
+CONFIG="${1:?usage: chaos_train.sh <config.yaml> [runs_root] [max_crashes]}"
+RUNS_ROOT="${2:-runs}"
+MAX_CRASHES="${3:-3}"
+NAME="$(python - "$CONFIG" <<'EOF'
+import sys, yaml
+print(yaml.safe_load(open(sys.argv[1]))["name"])
+EOF
+)"
+
+mkdir -p "$RUNS_ROOT"
+LOG="$RUNS_ROOT/$NAME.supervisor.log"
+
+nohup python -m mlx_cuda_distributed_pretraining_tpu.train.trainer \
+  --config "$CONFIG" --runs-root "$RUNS_ROOT" \
+  --auto-resume --max-crashes "$MAX_CRASHES" >"$LOG" 2>&1 &
+PID=$!
+echo "$PID" > "$RUNS_ROOT/$NAME.supervisor.pid"
+echo "supervised training started: pid=$PID config=$CONFIG log=$LOG"
+echo "stop cleanly with: kill -TERM $PID   (forwards to the trainer, which checkpoints and exits)"
+echo "monitor with: python -m mlx_cuda_distributed_pretraining_tpu.obs.monitor $NAME --runs-root $RUNS_ROOT"

@@ -169,6 +169,87 @@ def test_blocking_save_writes_before_raising_stale_error(tmp_path):
     np.testing.assert_array_equal(loaded["w"], params["w"])
 
 
+# --- async-writer failure paths (fault-injected) ---------------------------
+
+def test_stale_background_write_error_type_and_semantics(tmp_path):
+    """The stale error is its own type (StaleBackgroundWriteError), and its
+    contract holds: the blocking save that surfaced it DID land, manifest
+    included, so an exit path catching exactly this type loses nothing."""
+    import pytest
+
+    from mlx_cuda_distributed_pretraining_tpu.checkpoint import (
+        StaleBackgroundWriteError,
+        faults,
+    )
+
+    run = str(tmp_path / "run")
+    os.makedirs(os.path.join(run, "checkpoints"))
+    mgr = CheckpointManager(run)
+    params = {"w": np.ones((2, 2), np.float32)}
+    try:
+        faults.inject("model", "enospc", match="step_2")
+        mgr.save(2, params, blocking=False)
+        if mgr._writer is not None:
+            mgr._queue.join()  # let the background write consume and fail
+        with pytest.raises(StaleBackgroundWriteError) as exc:
+            mgr.save("final", params, blocking=True)
+        assert issubclass(StaleBackgroundWriteError, RuntimeError)
+        assert "ENOSPC" in str(exc.value) or "No space" in str(exc.value)
+        # the final save is complete and verified despite the raise
+        ok, reason = mgr.verify("final")
+        assert ok, reason
+        assert mgr.latest_complete_step() == "final"
+        # the stale error is consumed: a later wait() is clean
+        mgr.wait()
+    finally:
+        faults.reset()
+
+
+def test_async_backpressure_blocks_at_two_in_flight(tmp_path):
+    """queue maxsize=1 bounds live host snapshots at two: with one write
+    blocked in the writer thread and one payload queued, a third save()
+    must block on put() until the writer drains — that back-pressure is
+    the memory bound for multi-GB checkpoints."""
+    import threading
+    import time
+
+    from mlx_cuda_distributed_pretraining_tpu.checkpoint import faults
+
+    run = str(tmp_path / "run")
+    os.makedirs(os.path.join(run, "checkpoints"))
+    mgr = CheckpointManager(run)
+    params = {"w": np.ones((2, 2), np.float32)}
+    gate = threading.Event()
+    try:
+        faults.inject("model", "block", match="step_1", event=gate)
+        mgr.save(1, params, blocking=False)   # writer thread parks on gate
+        mgr.save(2, params, blocking=False)   # fills the queue slot
+        third_done = threading.Event()
+
+        def third():
+            mgr.save(3, params, blocking=False)
+            third_done.set()
+
+        t = threading.Thread(target=third)
+        t.start()
+        time.sleep(0.3)
+        assert not third_done.is_set(), "third save should block on put()"
+        gate.set()
+        t.join(timeout=30)
+        assert third_done.is_set()
+        mgr.wait()
+    finally:
+        faults.reset()
+        gate.set()
+    # FIFO drain: all three landed, in order, each fully manifested
+    with open(os.path.join(run, "metadata.json")) as f:
+        ledger = json.load(f)
+    assert [e["step"] for e in ledger["checkpoints"]] == [1, 2, 3]
+    for step in (1, 2, 3):
+        ok, reason = mgr.verify(step)
+        assert ok, (step, reason)
+
+
 # --- safetensors adversarial edges (VERDICT r3 next #7) --------------------
 
 def _roundtrip(tmp_path, tensors, name="x.safetensors", metadata=None):

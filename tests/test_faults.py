@@ -1,0 +1,414 @@
+"""Deterministic fault-injection tests for the checkpoint durability layer.
+
+Every fault the ISSUE's acceptance list names — truncated model file,
+missing optimizer file, torn manifest, ENOSPC during background write,
+corrupt metadata.json — is injected at a named point
+(checkpoint/faults.py) and the invariant pinned: resume selects the
+newest VERIFIED checkpoint, never a torn one, quarantining the wreckage.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mlx_cuda_distributed_pretraining_tpu.checkpoint import (
+    CheckpointIntegrityError,
+    CheckpointManager,
+    faults,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.reset()
+
+
+PARAMS = {"w": np.arange(12, dtype=np.float32).reshape(3, 4)}
+OPT = {"m": np.ones((3, 4), np.float32), "count": 7}
+
+
+def _mgr(tmp_path, name="run", **kw):
+    run = CheckpointManager.setup_run_directory(str(tmp_path), name)
+    notes = []
+    mgr = CheckpointManager(run, notify=notes.append, **kw)
+    mgr._notes = notes
+    return mgr
+
+
+def _save(mgr, step):
+    mgr.save(step, {"w": PARAMS["w"] + float(step)}, OPT, {"step": step})
+
+
+# -- manifest basics ---------------------------------------------------------
+
+def test_manifest_written_last_and_verifies(tmp_path):
+    mgr = _mgr(tmp_path)
+    _save(mgr, 10)
+    mpath = mgr.manifest_path(10)
+    assert os.path.isfile(mpath)
+    with open(mpath) as f:
+        manifest = json.load(f)
+    assert set(manifest["artifacts"]) == {
+        "step_10_model.safetensors", "step_10_optimizer.safetensors",
+        "step_10_state.json"}
+    for info in manifest["artifacts"].values():
+        assert info["bytes"] > 0 and isinstance(info["crc32"], int)
+    ok, reason = mgr.verify(10)
+    assert ok, reason
+    assert mgr.latest_complete_step() == "10"
+
+
+def test_unmanifested_step_never_selected(tmp_path):
+    """A crash between artifact writes leaves no manifest — that step must
+    be invisible to resume even though its model file exists."""
+    mgr = _mgr(tmp_path)
+    _save(mgr, 1)
+    with faults.active("manifest", "drop"):
+        _save(mgr, 2)  # all artifacts land, manifest vanishes
+    model2, _, _ = mgr.paths_for_step(2)
+    assert os.path.isfile(model2)
+    assert mgr.latest_complete_step() == "1"
+    # latest_step (unverified) would have picked the torn step
+    assert mgr.latest_step() == "2"
+
+
+# -- injected write faults ---------------------------------------------------
+
+def test_enospc_on_blocking_model_write_raises_and_leaves_no_manifest(tmp_path):
+    mgr = _mgr(tmp_path)
+    _save(mgr, 1)
+    rule = faults.inject("model", "enospc", match="step_2")
+    with pytest.raises(OSError):
+        _save(mgr, 2)
+    assert rule.hits == 1
+    assert not os.path.isfile(mgr.manifest_path(2))
+    assert mgr.latest_complete_step() == "1"
+
+
+def test_enospc_during_background_write_surfaces_and_resume_falls_back(tmp_path):
+    mgr = _mgr(tmp_path)
+    _save(mgr, 1)
+    faults.inject("model", "enospc", match="step_2")
+    mgr.save(2, PARAMS, OPT, {"step": 2}, blocking=False)
+    with pytest.raises(RuntimeError, match="background checkpoint write failed"):
+        mgr.wait()
+    assert mgr.latest_complete_step() == "1"
+
+
+def test_truncated_model_write_quarantined_on_resume(tmp_path):
+    mgr = _mgr(tmp_path)
+    _save(mgr, 1)
+    with faults.active("model", "truncate", match="step_2", truncate_bytes=16):
+        _save(mgr, 2)
+    ok, reason = mgr.verify(2)
+    assert not ok and "size mismatch" in reason
+    assert mgr.latest_complete_step() == "1"
+    qdir = os.path.join(mgr.checkpoint_dir, "quarantine")
+    assert "step_2_model.safetensors" in os.listdir(qdir)
+    assert any("quarantined checkpoint step 2" in n for n in mgr._notes)
+
+
+def test_dropped_optimizer_write_detected(tmp_path):
+    mgr = _mgr(tmp_path)
+    _save(mgr, 1)
+    with faults.active("optimizer", "drop", match="step_2"):
+        _save(mgr, 2)
+    ok, reason = mgr.verify(2)
+    assert not ok and "missing artifact" in reason
+    assert mgr.latest_complete_step() == "1"
+
+
+def test_torn_manifest_quarantined(tmp_path):
+    mgr = _mgr(tmp_path)
+    _save(mgr, 1)
+    with faults.active("manifest", "truncate", match="step_2", truncate_bytes=40):
+        _save(mgr, 2)
+    ok, reason = mgr.verify(2)
+    assert not ok and "torn manifest" in reason
+    assert mgr.latest_complete_step() == "1"
+    qdir = os.path.join(mgr.checkpoint_dir, "quarantine")
+    assert "step_2.manifest.json" in os.listdir(qdir)
+
+
+def test_bitrot_after_write_detected_by_crc(tmp_path):
+    """Corruption that keeps the size (flipped bytes, not truncation) is
+    caught by the CRC pass."""
+    mgr = _mgr(tmp_path)
+    _save(mgr, 1)
+    _save(mgr, 2)
+    model2, _, _ = mgr.paths_for_step(2)
+    size = os.path.getsize(model2)
+    with open(model2, "r+b") as f:
+        f.seek(size - 8)
+        f.write(b"\xff" * 8)
+    ok, reason = mgr.verify(2)
+    assert not ok and "crc32 mismatch" in reason
+    assert mgr.latest_complete_step() == "1"
+
+
+def test_fallback_walks_multiple_corrupt_steps(tmp_path):
+    mgr = _mgr(tmp_path)
+    for s in (1, 2, 3, 4):
+        _save(mgr, s)
+    for s in (2, 3, 4):
+        model, _, _ = mgr.paths_for_step(s)
+        with open(model, "r+b") as f:
+            f.truncate(10)
+    assert mgr.latest_complete_step() == "1"
+    qdir = os.path.join(mgr.checkpoint_dir, "quarantine")
+    names = os.listdir(qdir)
+    for s in (2, 3, 4):
+        assert f"step_{s}_model.safetensors" in names
+
+
+def test_legacy_unmanifested_checkpoints_still_resumable(tmp_path):
+    """Runs from before the manifest era (no manifests at all) fall back
+    to the unverified latest_step so old checkpoints stay loadable."""
+    mgr = _mgr(tmp_path)
+    for s in (1, 2):
+        _save(mgr, s)
+    for s in (1, 2):
+        os.unlink(mgr.manifest_path(s))
+    assert mgr.latest_complete_step() == "2"
+    assert any("predate integrity manifests" in n for n in mgr._notes)
+
+
+def test_sidecar_fault_injection_point(tmp_path):
+    """The per-host data sidecar is covered: it is folded into the step
+    manifest and a torn sidecar fails verification."""
+    mgr = _mgr(tmp_path)
+    from mlx_cuda_distributed_pretraining_tpu.checkpoint.manager import _atomic_json
+
+    sidecar = os.path.join(mgr.checkpoint_dir, "step_5_data_p0.json")
+    os.makedirs(mgr.checkpoint_dir, exist_ok=True)
+    _atomic_json(sidecar, {"val_ptr": 123, "position": 456})
+    _save(mgr, 5)
+    with open(mgr.manifest_path(5)) as f:
+        assert "step_5_data_p0.json" in json.load(f)["artifacts"]
+    with open(sidecar, "r+b") as f:
+        f.truncate(4)
+    ok, reason = mgr.verify(5)
+    assert not ok and "step_5_data_p0.json" in reason
+
+    # and the sidecar write itself is an injectable point
+    with faults.active("sidecar", "enospc"):
+        with pytest.raises(OSError):
+            _atomic_json(sidecar, {"val_ptr": 1})
+
+
+# -- optimizer-state degradation (silent-reset satellite) --------------------
+
+def test_missing_optimizer_warns_and_strict_raises(tmp_path):
+    mgr = _mgr(tmp_path)
+    _save(mgr, 1)
+    _, opt_path, _ = mgr.paths_for_step(1)
+    os.unlink(opt_path)
+
+    _, opt_state, _ = mgr.load(1, like_params=PARAMS, like_opt_state=OPT)
+    assert opt_state is None
+    assert any("MISSING" in n for n in mgr._notes)
+
+    with pytest.raises(CheckpointIntegrityError, match="MISSING"):
+        mgr.load(1, like_params=PARAMS, like_opt_state=OPT, strict=True)
+
+
+def test_unreadable_optimizer_warns_and_strict_raises(tmp_path):
+    mgr = _mgr(tmp_path)
+    _save(mgr, 1)
+    _, opt_path, _ = mgr.paths_for_step(1)
+    with open(opt_path, "wb") as f:
+        f.write(b"garbage that is not safetensors")
+
+    _, opt_state, _ = mgr.load(1, like_params=PARAMS, like_opt_state=OPT)
+    assert opt_state is None
+    assert any("UNREADABLE" in n for n in mgr._notes)
+
+    with pytest.raises(CheckpointIntegrityError, match="UNREADABLE"):
+        mgr.load(1, like_params=PARAMS, like_opt_state=OPT, strict=True)
+
+
+def test_partial_optimizer_state_warns_and_strict_raises(tmp_path):
+    """An optimizer file missing expected leaves (e.g. optimizer changed
+    between save and resume) is a loud partial reset, not a silent one."""
+    mgr = _mgr(tmp_path)
+    _save(mgr, 1)
+    bigger_like = dict(OPT, extra=np.zeros((2,), np.float32))
+    _, opt_state, _ = mgr.load(1, like_params=PARAMS, like_opt_state=bigger_like)
+    assert opt_state is not None  # partial state still rebuilt...
+    assert any("lacks" in n for n in mgr._notes)  # ...but loudly
+    with pytest.raises(CheckpointIntegrityError, match="lacks"):
+        mgr.load(1, like_params=PARAMS, like_opt_state=bigger_like, strict=True)
+
+
+# -- retention GC ------------------------------------------------------------
+
+def test_retention_gc_keep_last_and_keep_every(tmp_path):
+    mgr = _mgr(tmp_path, keep_last=2, keep_every=10)
+    for s in (5, 10, 15, 20, 25):
+        _save(mgr, s)
+    kept = {t for t in mgr.manifested_steps()}
+    # last two (20, 25) plus keep_every multiples (10, 20); 5 and 15 pruned
+    assert kept == {"10", "20", "25"}
+    assert not os.path.exists(mgr.paths_for_step(5)[0])
+    ok, _ = mgr.verify(10)
+    assert ok
+
+
+def test_retention_gc_never_deletes_final_or_protected(tmp_path):
+    mgr = _mgr(tmp_path, keep_last=1)
+    mgr.protect_steps.add("1")  # the resume source
+    for s in (1, 2, 3):
+        _save(mgr, s)
+    mgr.save("final", PARAMS, OPT, {"step": 3})
+    kept = set(mgr.manifested_steps())
+    assert "final" in kept and "1" in kept and "3" in kept
+    assert "2" not in kept
+
+
+def test_retention_disabled_by_default(tmp_path):
+    mgr = _mgr(tmp_path)
+    for s in (1, 2, 3, 4):
+        _save(mgr, s)
+    assert set(mgr.manifested_steps()) == {"1", "2", "3", "4"}
+
+
+# -- corrupt metadata.json (ledger satellite) --------------------------------
+
+def test_corrupt_ledger_preserved_and_rebuilt_from_scan(tmp_path):
+    mgr = _mgr(tmp_path)
+    for s in (1, 2):
+        _save(mgr, s)
+    meta = os.path.join(mgr.run_dir, "metadata.json")
+    with open(meta, "w") as f:
+        f.write('{"checkpoints": [tru')  # torn mid-write
+
+    _save(mgr, 3)  # next append must NOT reset the ledger
+    with open(meta) as f:
+        ledger = json.load(f)
+    steps = [e["step"] for e in ledger["checkpoints"]]
+    assert steps == [1, 2, 3]
+    assert all(e.get("rebuilt") for e in ledger["checkpoints"][:2])
+    assert os.path.isfile(meta + ".corrupt")
+    assert any("rebuilding the ledger" in n for n in mgr._notes)
+
+
+# -- trainer-level end-to-end ------------------------------------------------
+
+def _tiny_cfg_dict(tmp_path, name, iters, **extra):
+    import json as _json
+
+    train = tmp_path / "train.jsonl"
+    if not train.exists():
+        with open(train, "w") as f:
+            for _ in range(40):
+                f.write(_json.dumps(
+                    {"text": "the quick brown fox jumps over the lazy dog " * 4}) + "\n")
+    d = {
+        "name": name,
+        "overwrite": True,
+        "data": {
+            "input_file": str(train),
+            "preprocessing": {"max_context_size": 64},
+            "tokenizer": {"normal_vocab_size": 256},
+        },
+        "model": {
+            "architecture": "llama",
+            "dimensions": {"hidden_size": 32, "intermediate_size": 64, "num_layers": 2},
+            "attention": {"num_heads": 4, "num_kv_heads": 2, "head_dim": 8},
+        },
+        "training": {
+            "hyperparameters": {"batch_size": 4, "learning_rate": 1e-2, "iters": iters},
+            "scheduler": {"type": "cosine", "min_lr_ratio": 0.1},
+            "optimization": {"optimizer": "adamw"},
+        },
+        "logging": {
+            "steps": {"logging_interval": 5, "checkpoint_interval": 3,
+                      "validation_interval": 0},
+        },
+        "system": {"seed": 0, "device": "cpu"},
+    }
+    for k, v in extra.items():
+        node = d
+        parts = k.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return d
+
+
+def test_trainer_resume_falls_back_to_older_verified(tmp_path):
+    """End-to-end: corrupt the two newest checkpoints of a real run; a
+    resume.checkpoint=latest trainer quarantines both and resumes from the
+    newest step that verifies."""
+    from mlx_cuda_distributed_pretraining_tpu.config import Config
+    from mlx_cuda_distributed_pretraining_tpu.train.trainer import Trainer
+
+    runs = str(tmp_path / "runs")
+    cfg = Config.from_dict(_tiny_cfg_dict(tmp_path, "fallback", iters=9))
+    tr = Trainer(cfg, runs_root=runs, quiet=True)
+    tr.train()  # checkpoints at 3, 6, 9 + final
+
+    mgr = tr.checkpoints
+    for tag in ("final", "9"):
+        model, _, _ = mgr.paths_for_step(tag)
+        with open(model, "r+b") as f:
+            f.truncate(32)
+
+    d = _tiny_cfg_dict(tmp_path, "fallback", iters=9)
+    d["overwrite"] = False
+    d["resume"] = {"checkpoint": "latest"}
+    tr2 = Trainer(Config.from_dict(d), runs_root=runs, quiet=True)
+    assert tr2.start_step == 6
+    qdir = os.path.join(tr2.checkpoints.checkpoint_dir, "quarantine")
+    names = os.listdir(qdir)
+    assert "step_final_model.safetensors" in names
+    assert "step_9_model.safetensors" in names
+    log = open(os.path.join(tr2.run_dir, "log.txt")).read()
+    assert "quarantined checkpoint step final" in log
+    assert "Resumed from checkpoint 6" in log
+
+
+def test_trainer_strict_resume_raises_without_verified_checkpoint(tmp_path):
+    from mlx_cuda_distributed_pretraining_tpu.config import Config
+    from mlx_cuda_distributed_pretraining_tpu.train.trainer import Trainer
+
+    runs = str(tmp_path / "runs")
+    cfg = Config.from_dict(_tiny_cfg_dict(tmp_path, "strictrun", iters=3))
+    tr = Trainer(cfg, runs_root=runs, quiet=True)
+    tr.train()
+    # wipe every checkpoint: nothing resumable remains
+    import shutil
+
+    shutil.rmtree(tr.checkpoints.checkpoint_dir)
+    os.makedirs(tr.checkpoints.checkpoint_dir)
+
+    d = _tiny_cfg_dict(tmp_path, "strictrun", iters=3)
+    d["overwrite"] = False
+    d["resume"] = {"checkpoint": "latest", "strict": True}
+    with pytest.raises(CheckpointIntegrityError, match="no\\s+verified"):
+        Trainer(Config.from_dict(d), runs_root=runs, quiet=True)
+
+
+def test_trainer_nonstrict_resume_starts_fresh_without_checkpoint(tmp_path):
+    from mlx_cuda_distributed_pretraining_tpu.config import Config
+    from mlx_cuda_distributed_pretraining_tpu.train.trainer import Trainer
+
+    runs = str(tmp_path / "runs")
+    cfg = Config.from_dict(_tiny_cfg_dict(tmp_path, "freshrun", iters=3))
+    tr = Trainer(cfg, runs_root=runs, quiet=True)
+    tr.train()
+    import shutil
+
+    shutil.rmtree(tr.checkpoints.checkpoint_dir)
+    os.makedirs(tr.checkpoints.checkpoint_dir)
+
+    d = _tiny_cfg_dict(tmp_path, "freshrun", iters=3)
+    d["overwrite"] = False
+    d["resume"] = {"checkpoint": "latest"}
+    tr2 = Trainer(Config.from_dict(d), runs_root=runs, quiet=True)
+    assert tr2.start_step == 0
+    log = open(os.path.join(tr2.run_dir, "log.txt")).read()
+    assert "no resumable checkpoint found" in log

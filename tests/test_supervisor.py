@@ -1,0 +1,289 @@
+"""Auto-resume supervisor tests.
+
+Fast tier drives the restart loop with stub children (no jax import in
+the child): a tiny ``python -c`` script that consults an attempt counter
+and a behavior plan ("crash", "ckpt+crash", "ok", "sleep"), writing
+hand-rolled but manifest-valid checkpoints when asked. The slow-tier
+chaos test runs REAL CPU training under the supervisor and SIGKILLs it
+at ≥3 random points, then asserts the run completes with the same
+per-step losses as an uninterrupted baseline — checkpoint-resume replay
+is exact (data batches are a pure function of step, optimizer state
+round-trips float32-exact).
+"""
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from mlx_cuda_distributed_pretraining_tpu.train.supervisor import (
+    CrashLoopError,
+    Supervisor,
+    _trainer_cmd_builder,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Stub child: argv = [run_dir, plan]. Reads/bumps an attempt counter,
+# then acts out plan[attempt]: "crash" exits 1; "ckpt+crash" first writes
+# a checkpoint (real manifest: bytes + crc32) for step=attempt+1; "ok"
+# exits 0; "sleep" hangs until signaled. No jax import — fast.
+_STUB = r"""
+import json, os, sys, time, zlib
+run_dir, plan = sys.argv[1], sys.argv[2].split(",")
+cnt = os.path.join(run_dir, "attempt")
+n = int(open(cnt).read()) if os.path.exists(cnt) else 0
+open(cnt, "w").write(str(n + 1))
+action = plan[min(n, len(plan) - 1)]
+if action == "sleep":
+    time.sleep(120)
+    sys.exit(1)
+if action.startswith("ckpt"):
+    ckdir = os.path.join(run_dir, "checkpoints")
+    os.makedirs(ckdir, exist_ok=True)
+    step = n + 1
+    data = ("model-bytes-%d" % step).encode()
+    name = "step_%d_model.safetensors" % step
+    open(os.path.join(ckdir, name), "wb").write(data)
+    manifest = {"format_version": 1, "step": step, "written_at": float(step),
+                "artifacts": {name: {"bytes": len(data),
+                                     "crc32": zlib.crc32(data)}}}
+    with open(os.path.join(ckdir, "step_%d.manifest.json" % step), "w") as f:
+        json.dump(manifest, f)
+sys.exit(0 if action.endswith("ok") else 1)
+"""
+
+
+def _stub_supervisor(tmp_path, plan, **kw):
+    run_dir = str(tmp_path / "run")
+    os.makedirs(run_dir, exist_ok=True)
+    resume_tags = []
+
+    def build_cmd(tag):
+        resume_tags.append(tag)
+        return [sys.executable, "-c", _STUB, run_dir, plan]
+
+    sup = Supervisor(build_cmd, run_dir, backoff_base=0.01, backoff_max=0.05,
+                     log=lambda m: None, **kw)
+    return sup, resume_tags
+
+
+def test_restarts_until_success_and_resumes_from_new_checkpoint(tmp_path):
+    sup, tags = _stub_supervisor(tmp_path, "crash,ckpt+crash,ok")
+    assert sup.run() == 0
+    assert sup.restarts == 2
+    # launch 1 fresh, launch 2 fresh (no ckpt yet), launch 3 resumes from
+    # the step-2 checkpoint attempt 2 wrote before crashing
+    assert tags == [None, None, "2"]
+
+
+def test_crash_loop_gives_up_after_max_crashes(tmp_path):
+    sup, tags = _stub_supervisor(tmp_path, "crash", max_crashes_per_step=3)
+    with pytest.raises(CrashLoopError, match="3 consecutive crashes"):
+        sup.run()
+    assert len(tags) == 3  # exactly max_crashes launches, then give up
+
+
+def test_checkpoint_progress_resets_crash_counter(tmp_path):
+    # two no-progress crashes (counter at 2/3), then a crash WITH a new
+    # checkpoint (counter resets to 1), another no-progress crash (2/3),
+    # then success. Without the progress reset the third crash would be
+    # 3/3 and raise CrashLoopError before ever reaching "ok".
+    sup, tags = _stub_supervisor(
+        tmp_path, "crash,crash,ckpt+crash,crash,ok", max_crashes_per_step=3)
+    assert sup.run() == 0
+    assert sup.restarts == 4
+    assert tags[-1] == "3"
+
+
+def test_forwarded_sigterm_stops_without_restart(tmp_path):
+    sup, tags = _stub_supervisor(tmp_path, "sleep")
+
+    def on_spawn(child):
+        # handler is installed before the first launch; deliver the
+        # preemption signal to the SUPERVISOR process once the child runs
+        threading.Timer(
+            0.2, lambda: os.kill(os.getpid(), signal.SIGTERM)).start()
+
+    sup.on_spawn = on_spawn
+    rc = sup.run()
+    assert rc != 0  # child was terminated by the forwarded signal
+    assert sup.restarts == 0
+    assert len(tags) == 1
+
+
+def test_latest_resumable_skips_corrupt_newest(tmp_path):
+    run_dir = str(tmp_path / "run")
+    ckdir = os.path.join(run_dir, "checkpoints")
+    os.makedirs(ckdir)
+    import zlib
+
+    for step in (1, 2):
+        data = f"model-bytes-{step}".encode()
+        name = f"step_{step}_model.safetensors"
+        with open(os.path.join(ckdir, name), "wb") as f:
+            f.write(data)
+        with open(os.path.join(ckdir, f"step_{step}.manifest.json"), "w") as f:
+            json.dump({"format_version": 1, "step": step, "written_at": 0.0,
+                       "artifacts": {name: {"bytes": len(data),
+                                            "crc32": zlib.crc32(data)}}}, f)
+    # tear the newest one (as a kill -9 mid-write would)
+    with open(os.path.join(ckdir, "step_2_model.safetensors"), "wb") as f:
+        f.write(b"xx")
+    sup = Supervisor(lambda tag: ["true"], run_dir, log=lambda m: None)
+    assert sup.latest_resumable() == "1"
+    assert os.path.isdir(os.path.join(ckdir, "quarantine"))
+
+
+# --- slow tier: real training, real kill -9 --------------------------------
+
+def _child_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO  # drop the axon TPU sitecustomize dir
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    return env
+
+
+def _write_chaos_config(tmp_path, iters):
+    import yaml
+
+    train = tmp_path / "train.jsonl"
+    with open(train, "w") as f:
+        for _ in range(40):
+            f.write(json.dumps(
+                {"text": "the quick brown fox jumps over the lazy dog " * 4}) + "\n")
+    cfg = {
+        "name": "placeholder",
+        "overwrite": True,
+        "data": {
+            "input_file": str(train),
+            "preprocessing": {"max_context_size": 64},
+            "tokenizer": {"normal_vocab_size": 256},
+        },
+        "model": {
+            "architecture": "llama",
+            "dimensions": {"hidden_size": 32, "intermediate_size": 64,
+                           "num_layers": 2},
+            "attention": {"num_heads": 4, "num_kv_heads": 2, "head_dim": 8},
+        },
+        "training": {
+            "hyperparameters": {"batch_size": 4, "learning_rate": 1e-2,
+                                "iters": iters},
+            "scheduler": {"type": "cosine", "min_lr_ratio": 0.1},
+            "optimization": {"optimizer": "adamw"},
+        },
+        "logging": {
+            "steps": {"logging_interval": 1, "checkpoint_interval": 5,
+                      "validation_interval": 0},
+        },
+        "system": {"seed": 0, "device": "cpu"},
+    }
+    path = tmp_path / "chaos.yaml"
+    with open(path, "w") as f:
+        yaml.safe_dump(cfg, f)
+    return str(path)
+
+
+def _step_losses(run_dir):
+    out = {}
+    with open(os.path.join(run_dir, "log.txt")) as f:
+        for line in f.read().splitlines():
+            if line.startswith("Step") and "loss=" in line and "validation" not in line:
+                step = int(line.split()[1].rstrip(":"))
+                out[step] = float(line.split("loss=")[1].split(" |")[0])
+    return out
+
+
+def _manifest_count(ckdir):
+    if not os.path.isdir(ckdir):
+        return 0
+    return sum(1 for n in os.listdir(ckdir) if n.endswith(".manifest.json"))
+
+
+@pytest.mark.slow
+def test_chaos_kill9_training_completes_and_matches_baseline(tmp_path):
+    """The ISSUE's acceptance chaos drill: kill -9 a real CPU training
+    subprocess at >=3 random points; the supervisor must drive the run to
+    completion, and the trajectory must MATCH an uninterrupted baseline —
+    same final step, same per-step losses (resume replays exactly)."""
+    from mlx_cuda_distributed_pretraining_tpu.train.trainer import build_parser
+
+    iters = 300
+    cfg_path = _write_chaos_config(tmp_path, iters)
+    root = str(tmp_path / "runs")
+    env = _child_env()
+
+    # -- uninterrupted baseline (same subprocess env as the chaos children,
+    # so XLA device count and numerics are identical)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mlx_cuda_distributed_pretraining_tpu.train.trainer",
+         "--config", cfg_path, "--runs-root", root, "--run-name", "base"],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    base = _step_losses(os.path.join(root, "base"))
+    assert max(base) == iters
+
+    # -- chaos run under the supervisor
+    args = build_parser().parse_args(
+        ["--config", cfg_path, "--runs-root", root, "--run-name", "chaos",
+         "--auto-resume", "--max-crashes", "3",
+         "--backoff-base", "0.05", "--backoff-max", "0.2"])
+    run_dir = os.path.join(root, "chaos")
+    ckdir = os.path.join(run_dir, "checkpoints")
+    rng = random.Random(0)
+    kills = {"done": 0}
+
+    def on_spawn(child):
+        if kills["done"] >= 3:
+            return  # let the last incarnation run to completion
+        at_spawn = _manifest_count(ckdir)
+
+        def watch():
+            # kill -9 shortly after the child commits a NEW checkpoint: a
+            # random point inside the next interval, never after the final
+            # save (a post-completion kill would test nothing)
+            while child.poll() is None:
+                if os.path.isfile(os.path.join(ckdir, "step_final.manifest.json")):
+                    return
+                if _manifest_count(ckdir) > at_spawn:
+                    time.sleep(rng.uniform(0.0, 0.05))
+                    if child.poll() is None and not os.path.isfile(
+                            os.path.join(ckdir, "step_final.manifest.json")):
+                        child.kill()
+                        kills["done"] += 1
+                    return
+                time.sleep(0.005)
+
+        threading.Thread(target=watch, daemon=True).start()
+
+    sup = Supervisor(_trainer_cmd_builder(args), run_dir,
+                     max_crashes_per_step=3, backoff_base=0.05,
+                     backoff_max=0.2, env=env, on_spawn=on_spawn,
+                     log=lambda m: None)
+    rc = sup.run()
+    assert rc == 0
+    assert kills["done"] >= 3, "chaos drill must kill the child at least 3 times"
+    assert sup.restarts >= 3
+
+    chaos = _step_losses(run_dir)
+    assert max(chaos) == iters
+    # exact replay: every step logged by both runs carries the same loss
+    # (the chaos log's replayed steps keep the LAST occurrence, which is
+    # the one that fed the surviving trajectory)
+    for step in sorted(set(base) & set(chaos)):
+        assert abs(base[step] - chaos[step]) < 1e-3, (
+            step, base[step], chaos[step])
+    assert abs(base[iters] - chaos[iters]) < 1e-3
+
+    # the completed run's final checkpoint is manifested and verified
+    from mlx_cuda_distributed_pretraining_tpu.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(run_dir)
+    assert mgr.latest_complete_step() == "final"
