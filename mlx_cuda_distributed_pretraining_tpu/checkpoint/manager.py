@@ -551,29 +551,61 @@ class CheckpointManager:
                      f"-> {qdir}")
         return moved
 
-    def latest_complete_step(self) -> Optional[str]:
+    def latest_complete_step(self, quarantine: bool = True) -> Optional[str]:
         """Newest step tag that passes full manifest verification.
 
         Walks manifested steps newest-first; any candidate that fails
         verification is QUARANTINED and the next older one is tried, so a
         torn/corrupt newest checkpoint degrades resume by one interval
-        instead of crashing the run or silently resetting state. Runs
-        predating manifests (none present at all) fall back to
-        :meth:`latest_step` so old checkpoints remain loadable."""
+        instead of crashing the run or silently resetting state.
+        ``quarantine=False`` is the read-only scan for consumers that only
+        load (eval/serving): failing candidates are skipped, never moved,
+        so a concurrent trainer's resume/GC state is left untouched.
+
+        Un-manifested steps remain loadable as a last resort: runs
+        predating manifests entirely, and mixed-era runs whose manifested
+        candidates ALL fail verification, fall back to the newest
+        remaining pre-manifest step (with a loud "unverified" warning)
+        instead of reporting that nothing exists."""
         candidates = self.manifested_steps()
-        if not candidates:
-            legacy = self.latest_step()
-            if legacy is not None:
-                self._notify(
-                    f"checkpoints in {self.checkpoint_dir} predate integrity "
-                    f"manifests; resuming unverified step {legacy}")
-            return legacy
+        failed: Set[str] = set()
         for tag in candidates:
             ok, reason = self.verify(tag)
             if ok:
                 return tag
-            self.quarantine_step(tag, reason)
-        return None
+            failed.add(str(tag))
+            if quarantine:
+                self.quarantine_step(tag, reason)
+            else:
+                self._notify(f"skipping checkpoint step {tag} ({reason}); "
+                             f"read-only scan, not quarantining")
+        legacy = self._latest_unmanifested(exclude=failed)
+        if legacy is not None:
+            if candidates:
+                self._notify(
+                    f"every manifested checkpoint failed verification; "
+                    f"resuming unverified pre-manifest step {legacy}")
+            else:
+                self._notify(
+                    f"checkpoints in {self.checkpoint_dir} predate integrity "
+                    f"manifests; resuming unverified step {legacy}")
+        return legacy
+
+    def _latest_unmanifested(self, exclude: Set[str] = frozenset()) -> Optional[str]:
+        """Newest step tag with a model file on disk, skipping ``exclude``
+        (steps whose manifest failed verification this scan — their files
+        may still be present under a read-only scan or a partially failed
+        quarantine, and must never be offered as a fallback)."""
+        if not os.path.isdir(self.checkpoint_dir):
+            return None
+        tags = []
+        for fname in os.listdir(self.checkpoint_dir):
+            if fname.startswith("step_") and fname.endswith("_model.safetensors"):
+                tag = fname[len("step_"):-len("_model.safetensors")]
+                if tag not in exclude:
+                    tags.append(tag)
+        tags.sort(key=_step_sort_key)
+        return tags[0] if tags else None
 
     def gc_checkpoints(self, in_flight=None) -> List[str]:
         """Retention GC, run after each successful manifest write. Deletes
@@ -605,10 +637,25 @@ class CheckpointManager:
                 os.unlink(mpath)
             removed.append(str(s))
         if removed:
+            self._prune_ledger(removed)
             self._notify(
                 f"retention GC removed step(s) {', '.join(removed)} "
                 f"(keep_last={self.keep_last}, keep_every={self.keep_every})")
         return removed
+
+    def _prune_ledger(self, steps: List[str]) -> None:
+        """Drop GC'd steps from the metadata.json checkpoint list — a
+        ledger entry whose ``path`` points at deleted files would read as
+        a phantom checkpoint to every ledger consumer (and to a later
+        :meth:`_rebuild_ledger` cross-check)."""
+        gone = {str(s) for s in steps}
+        with self._meta_lock:
+            ledger = self._load_ledger()
+            entries = ledger.get("checkpoints") or []
+            kept = [e for e in entries if str(e.get("step")) not in gone]
+            if len(kept) != len(entries):
+                ledger["checkpoints"] = kept
+                _atomic_json(os.path.join(self.run_dir, "metadata.json"), ledger)
 
 
 def _restructure_like(like: Any, nested_dict: Any) -> Any:

@@ -72,12 +72,26 @@ class Supervisor:
     def latest_resumable(self) -> Optional[str]:
         """Newest verified step tag, or None. Runs the same quarantining
         scan the child's resume would, so a corrupt newest checkpoint is
-        already set aside before the child even launches."""
-        try:
-            return CheckpointManager(self.run_dir, notify=self.log).latest_complete_step()
-        except OSError as e:
-            self.log(f"supervisor: checkpoint scan failed ({e}); treating as fresh")
-            return None
+        already set aside before the child even launches.
+
+        A scan OSError (NFS blip, transient perms) is retried and then
+        RE-RAISED — it must never be mistaken for "no checkpoints": a
+        fresh launch on a dir full of good checkpoints would discard the
+        run's entire recovery state."""
+        attempts = 3
+        for attempt in range(1, attempts + 1):
+            try:
+                return CheckpointManager(
+                    self.run_dir, notify=self.log).latest_complete_step()
+            except OSError as e:
+                if attempt == attempts:
+                    raise
+                delay = min(self.backoff_base * (2 ** (attempt - 1)),
+                            self.backoff_max)
+                self.log(f"supervisor: checkpoint scan failed ({e}); "
+                         f"retry {attempt}/{attempts - 1} in {delay:.1f}s")
+                time.sleep(delay)
+        return None  # unreachable
 
     def _forward_signal(self, signum, frame) -> None:
         self._shutdown_signal = signum
@@ -147,7 +161,17 @@ class Supervisor:
                     pass
 
 
-def _trainer_cmd_builder(args) -> Callable[[Optional[str]], List[str]]:
+def _checkpoints_present(run_dir: str) -> bool:
+    """Anything under ``<run_dir>/checkpoints`` — good steps, legacy
+    pre-manifest files, or ``quarantine/`` forensics — that a fresh-start
+    rmtree would destroy."""
+    try:
+        return bool(os.listdir(os.path.join(run_dir, "checkpoints")))
+    except OSError:
+        return False
+
+
+def _trainer_cmd_builder(args, run_dir: str) -> Callable[[Optional[str]], List[str]]:
     """Child argv for the real trainer, rebuilt from the parsed supervisor
     args (so ``--auto-resume`` and the supervisor knobs never leak into
     the child)."""
@@ -172,10 +196,19 @@ def _trainer_cmd_builder(args) -> Callable[[Optional[str]], List[str]]:
             # deterministic even if files change between scan and launch.
             cmd += ["--set", f"resume.checkpoint={resume_tag}",
                     "--set", "overwrite=false"]
+        elif _checkpoints_present(run_dir):
+            # Nothing verified to resume from, but the checkpoints dir is
+            # not empty (quarantine/ forensics, legacy files, a step the
+            # scan couldn't vouch for). overwrite=true would rmtree all of
+            # it — never do that. Launch in resume mode instead: the
+            # trainer keeps the existing dir and starts from step 0 in
+            # place if its own resolution also comes up empty.
+            cmd += ["--set", "resume.checkpoint=latest",
+                    "--set", "overwrite=false"]
         else:
-            # Fresh (re)start: the run dir may exist from a crash that
-            # never reached a checkpoint — nothing in it is worth more
-            # than getting training going again.
+            # Run dir absent, or a crash that never even reached a
+            # checkpoint — nothing in it is worth more than getting
+            # training going again.
             cmd += ["--set", "overwrite=true"]
         return cmd
 
@@ -195,7 +228,7 @@ def supervise_from_args(args) -> Dict[str, Any]:
     run_dir = os.path.join(args.runs_root, merged["name"])
 
     sup = Supervisor(
-        _trainer_cmd_builder(args),
+        _trainer_cmd_builder(args, run_dir),
         run_dir,
         max_crashes_per_step=args.max_crashes,
         backoff_base=args.backoff_base,

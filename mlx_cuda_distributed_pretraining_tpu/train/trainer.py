@@ -363,8 +363,10 @@ class Trainer:
 
         "latest"/"" asks latest_complete_step() for the newest manifested,
         checksum-clean step (quarantining corrupt ones and falling back
-        through older checkpoints). An explicit tag is verified too: if it
-        fails, strict mode raises; otherwise it is quarantined and resume
+        through older checkpoints). An explicit tag is verified too: a tag
+        with no manifest but files on disk loads unverified (legacy
+        pre-manifest checkpoint); a tag whose manifest fails size/CRC
+        checks raises in strict mode, otherwise is quarantined and resume
         falls back to the newest verified step. Returns None when nothing
         resumable exists (caller starts from scratch, or raises in strict
         mode)."""
@@ -381,12 +383,28 @@ class Trainer:
         ok, reason = self.checkpoints.verify(tag)
         if ok:
             return tag
-        if reason == "no manifest" and not self.checkpoints.has_manifests():
-            # Pre-manifest run: nothing to verify against; load as before.
+        if reason == "no manifest":
+            # Quarantine is reserved for steps whose manifest EXISTS and
+            # fails size/CRC checks. A requested tag with no manifest but
+            # files on disk is a legacy pre-manifest checkpoint (even in a
+            # mixed-era dir where newer steps do have manifests): honor
+            # the user's explicit choice and load it unverified.
+            model_path, _, _ = self.checkpoints.paths_for_step(tag)
+            if os.path.isfile(model_path):
+                self.logger.log(
+                    f"resume: checkpoint {tag} has no integrity manifest "
+                    f"(pre-manifest checkpoint); loading unverified")
+                return tag
+            # No manifest AND no files: the tag simply doesn't exist —
+            # nothing to quarantine.
+            if strict:
+                raise CheckpointIntegrityError(
+                    f"resume.checkpoint={tag} does not exist in "
+                    f"{self.checkpoints.checkpoint_dir} and resume.strict is set")
             self.logger.log(
-                f"resume: checkpoint {tag} predates integrity manifests; "
-                f"loading unverified")
-            return tag
+                f"WARNING: resume.checkpoint={tag} does not exist; falling "
+                f"back to the newest verified checkpoint")
+            return self.checkpoints.latest_complete_step()
         if strict:
             raise CheckpointIntegrityError(
                 f"resume.checkpoint={tag} failed verification ({reason}) "
@@ -811,9 +829,12 @@ def load_trained(run_name_or_dir: str, runs_root: str = "runs"):
     tok = TokenizerManager.from_run_dir(run_dir)
     args = LlamaArgs.from_config(cfg.model, tok.vocab_size)
     ckpts = CheckpointManager(run_dir)
-    # Verified resolution: never serve a torn checkpoint (falls back to
-    # unverified latest_step() only for pre-manifest runs).
-    tag = ckpts.latest_complete_step()
+    # Verified resolution: never serve a torn checkpoint (falling back to
+    # unverified pre-manifest steps only). Read-only scan: this path may
+    # run concurrently with an active trainer on the same run dir, so it
+    # must never quarantine (move) files out from under the trainer's
+    # resume/GC logic.
+    tag = ckpts.latest_complete_step(quarantine=False)
     if tag is None:
         raise FileNotFoundError(f"no verified checkpoints in {run_dir}")
     model_path, _, _ = ckpts.paths_for_step(tag)

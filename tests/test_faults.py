@@ -176,6 +176,48 @@ def test_legacy_unmanifested_checkpoints_still_resumable(tmp_path):
     assert any("predate integrity manifests" in n for n in mgr._notes)
 
 
+def test_mixed_era_falls_back_to_legacy_after_quarantine(tmp_path):
+    """REGRESSION: old pre-manifest checkpoints alongside newer manifested
+    ones — when every manifested candidate fails verification, resume must
+    fall back to the newest loadable legacy step, not report nothing
+    (which would let a supervisor fresh-start wipe the dir)."""
+    mgr = _mgr(tmp_path)
+    for s in (1, 2):
+        _save(mgr, s)
+    os.unlink(mgr.manifest_path(1))  # step 1 is now "legacy"
+    model2, _, _ = mgr.paths_for_step(2)
+    with open(model2, "r+b") as f:
+        f.truncate(10)  # the only manifested step is corrupt
+    assert mgr.latest_complete_step() == "1"
+    assert any("resuming unverified pre-manifest step 1" in n
+               for n in mgr._notes)
+    # the corrupt manifested step was still quarantined
+    qdir = os.path.join(mgr.checkpoint_dir, "quarantine")
+    assert "step_2.manifest.json" in os.listdir(qdir)
+
+
+def test_read_only_scan_skips_without_quarantining(tmp_path):
+    """latest_complete_step(quarantine=False): eval/serving consumers must
+    not move files out from under a concurrently training process."""
+    mgr = _mgr(tmp_path)
+    for s in (1, 2):
+        _save(mgr, s)
+    model2, _, _ = mgr.paths_for_step(2)
+    with open(model2, "r+b") as f:
+        f.truncate(10)
+    assert mgr.latest_complete_step(quarantine=False) == "1"
+    # nothing moved: the corrupt step's files are all still in place
+    assert os.path.isfile(model2)
+    assert os.path.isfile(mgr.manifest_path(2))
+    assert not os.path.isdir(os.path.join(mgr.checkpoint_dir, "quarantine"))
+    assert any("read-only scan" in n for n in mgr._notes)
+    # and the failed candidate (still on disk, since nothing was moved) is
+    # never offered as the legacy fallback: with step 1 de-manifested, the
+    # fallback must pick legacy step 1, not corrupt-but-newer step 2
+    os.unlink(mgr.manifest_path(1))
+    assert mgr.latest_complete_step(quarantine=False) == "1"
+
+
 def test_sidecar_fault_injection_point(tmp_path):
     """The per-host data sidecar is covered: it is folded into the step
     manifest and a torn sidecar fails verification."""
@@ -273,6 +315,20 @@ def test_retention_disabled_by_default(tmp_path):
     for s in (1, 2, 3, 4):
         _save(mgr, s)
     assert set(mgr.manifested_steps()) == {"1", "2", "3", "4"}
+
+
+def test_retention_gc_prunes_ledger_entries(tmp_path):
+    """REGRESSION: GC'd steps must leave the metadata.json ledger too —
+    entries pointing at deleted files read as phantom checkpoints."""
+    mgr = _mgr(tmp_path, keep_last=2)
+    for s in (1, 2, 3, 4):
+        _save(mgr, s)
+    with open(os.path.join(mgr.run_dir, "metadata.json")) as f:
+        ledger = json.load(f)
+    steps = [e["step"] for e in ledger["checkpoints"]]
+    assert steps == [3, 4]
+    for e in ledger["checkpoints"]:
+        assert os.path.isfile(e["path"])
 
 
 # -- corrupt metadata.json (ledger satellite) --------------------------------
@@ -412,3 +468,59 @@ def test_trainer_nonstrict_resume_starts_fresh_without_checkpoint(tmp_path):
     assert tr2.start_step == 0
     log = open(os.path.join(tr2.run_dir, "log.txt")).read()
     assert "no resumable checkpoint found" in log
+
+
+def test_trainer_explicit_legacy_tag_loads_unverified_not_quarantined(tmp_path):
+    """REGRESSION: resume.checkpoint=<tag> naming a healthy pre-manifest
+    checkpoint in a MIXED-era dir (other steps do have manifests) must
+    load that step unverified — not quarantine the user's known-good
+    checkpoint and silently resume a different step."""
+    from mlx_cuda_distributed_pretraining_tpu.config import Config
+    from mlx_cuda_distributed_pretraining_tpu.train.trainer import Trainer
+
+    runs = str(tmp_path / "runs")
+    cfg = Config.from_dict(_tiny_cfg_dict(tmp_path, "legacytag", iters=9))
+    tr = Trainer(cfg, runs_root=runs, quiet=True)
+    tr.train()  # checkpoints at 3, 6, 9 + final, all manifested
+
+    mgr = tr.checkpoints
+    os.unlink(mgr.manifest_path(6))  # step 6 becomes "pre-manifest"
+
+    d = _tiny_cfg_dict(tmp_path, "legacytag", iters=9)
+    d["overwrite"] = False
+    d["resume"] = {"checkpoint": "6"}
+    tr2 = Trainer(Config.from_dict(d), runs_root=runs, quiet=True)
+    assert tr2.start_step == 6
+    model6, _, _ = tr2.checkpoints.paths_for_step(6)
+    assert os.path.isfile(model6)  # still in place, not quarantined
+    assert not os.path.isdir(
+        os.path.join(tr2.checkpoints.checkpoint_dir, "quarantine"))
+    log = open(os.path.join(tr2.run_dir, "log.txt")).read()
+    assert "no integrity manifest" in log
+
+
+def test_load_trained_read_only_never_quarantines(tmp_path):
+    """REGRESSION: load_trained (eval/serving) runs a read-only scan — a
+    corrupt newest checkpoint is skipped, not moved, so a concurrent
+    trainer's resume/GC view of the dir is undisturbed."""
+    from mlx_cuda_distributed_pretraining_tpu.config import Config
+    from mlx_cuda_distributed_pretraining_tpu.train.trainer import (
+        Trainer,
+        load_trained,
+    )
+
+    runs = str(tmp_path / "runs")
+    cfg = Config.from_dict(_tiny_cfg_dict(tmp_path, "servable", iters=9))
+    tr = Trainer(cfg, runs_root=runs, quiet=True)
+    tr.train()
+
+    mgr = tr.checkpoints
+    model_final, _, _ = mgr.paths_for_step("final")
+    with open(model_final, "r+b") as f:
+        f.truncate(32)
+
+    params, args, tok, _ = load_trained(tr.run_dir, runs_root=runs)
+    assert params is not None
+    # the torn final checkpoint was skipped in place, not quarantined
+    assert os.path.isfile(model_final)
+    assert not os.path.isdir(os.path.join(mgr.checkpoint_dir, "quarantine"))

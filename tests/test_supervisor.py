@@ -141,6 +141,70 @@ def test_latest_resumable_skips_corrupt_newest(tmp_path):
     assert os.path.isdir(os.path.join(ckdir, "quarantine"))
 
 
+def test_scan_oserror_raises_instead_of_treating_as_fresh(tmp_path, monkeypatch):
+    """A transient scan failure must never read as "no checkpoints" — the
+    fresh launch it would trigger could discard the run's recovery state.
+    After retries, the error propagates."""
+    from mlx_cuda_distributed_pretraining_tpu.checkpoint.manager import (
+        CheckpointManager,
+    )
+
+    calls = {"n": 0}
+
+    def boom(self):
+        calls["n"] += 1
+        raise OSError("stale NFS handle")
+
+    monkeypatch.setattr(CheckpointManager, "latest_complete_step", boom)
+    sup = Supervisor(lambda tag: ["true"], str(tmp_path / "run"),
+                     backoff_base=0.001, backoff_max=0.002, log=lambda m: None)
+    with pytest.raises(OSError, match="stale NFS handle"):
+        sup.latest_resumable()
+    assert calls["n"] == 3  # retried before giving up
+
+
+def _builder_args(cfg_path, root, name):
+    from mlx_cuda_distributed_pretraining_tpu.train.trainer import build_parser
+
+    return build_parser().parse_args(
+        ["--config", str(cfg_path), "--runs-root", str(root),
+         "--run-name", name])
+
+
+def test_fresh_restart_never_overwrites_dir_with_checkpoint_data(tmp_path):
+    """REGRESSION: a fresh launch (no verified tag) on a run dir whose
+    checkpoints dir is non-empty (quarantine forensics, legacy files, a
+    step the scan couldn't vouch for) must NOT pass overwrite=true — that
+    rmtree's the whole run dir. It launches in resume mode instead."""
+    run_dir = tmp_path / "runs" / "r"
+    qdir = run_dir / "checkpoints" / "quarantine"
+    os.makedirs(qdir)
+    (qdir / "step_7.reason.txt").write_text("crc32 mismatch")
+
+    build = _trainer_cmd_builder(
+        _builder_args(tmp_path / "c.yaml", tmp_path / "runs", "r"),
+        str(run_dir))
+    cmd = build(None)
+    assert "overwrite=true" not in cmd
+    assert "overwrite=false" in cmd
+    assert "resume.checkpoint=latest" in cmd
+
+
+def test_fresh_start_overwrites_only_without_checkpoint_data(tmp_path):
+    run_dir = tmp_path / "runs" / "r"
+    build = _trainer_cmd_builder(
+        _builder_args(tmp_path / "c.yaml", tmp_path / "runs", "r"),
+        str(run_dir))
+    # run dir doesn't exist at all
+    assert "overwrite=true" in build(None)
+    # exists but checkpoints dir is empty (crash before first checkpoint)
+    os.makedirs(run_dir / "checkpoints")
+    assert "overwrite=true" in build(None)
+    # a verified tag always wins
+    cmd = build("42")
+    assert "resume.checkpoint=42" in cmd and "overwrite=false" in cmd
+
+
 # --- slow tier: real training, real kill -9 --------------------------------
 
 def _child_env():
@@ -263,7 +327,7 @@ def test_chaos_kill9_training_completes_and_matches_baseline(tmp_path):
 
         threading.Thread(target=watch, daemon=True).start()
 
-    sup = Supervisor(_trainer_cmd_builder(args), run_dir,
+    sup = Supervisor(_trainer_cmd_builder(args, run_dir), run_dir,
                      max_crashes_per_step=3, backoff_base=0.05,
                      backoff_max=0.2, env=env, on_spawn=on_spawn,
                      log=lambda m: None)
