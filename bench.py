@@ -507,6 +507,11 @@ def bench_train_case(name, scale_key, attn, vocab, steps, fused_ce=True,
         "final_loss": round(final_loss, 3),
         "hbm_peak_gb": hbm_peak_gb,
         "hbm_src": hbm_src,
+        # Bare-step cases re-dispatch one device-resident batch: the input
+        # pipeline is out of the picture by construction. The honest zero
+        # keeps the column comparable with trainer_e2e rows, where the
+        # fraction is measured by the device prefetcher.
+        "data_wait_frac": 0.0,
         **({"megastep": mega} if mega > 1 else {}),
     }
 
@@ -770,17 +775,26 @@ def bench_trainer_case(vocab, workdir="/tmp/bench_trainer", spd=1):
     t0 = time.perf_counter()
     t.train()
     dt = time.perf_counter() - t0
-    # parse steady-state tok/s from log.txt (last report line)
+    # parse steady-state tok/s + step-time breakdown from log.txt (last
+    # report line; the trainer's device prefetcher measures data_wait /
+    # h2d / dispatch per logging window)
     tok_s = None
+    breakdown = {}
     log_path = os.path.join(workdir, "runs", "bench-trainer", "log.txt")
     with open(log_path) as f:
         for line in f:
             if "tok/s=" in line:
                 tok_s = float(line.split("tok/s=")[1].split()[0].rstrip("|"))
+                for key in ("data_wait_s", "h2d_wait_s", "dispatch_s",
+                            "data_wait_frac"):
+                    if f"{key}=" in line:
+                        breakdown[key] = float(
+                            line.split(f"{key}=")[1].split()[0].rstrip("|"))
     return {
         "case": "trainer_40m_flash_e2e" + (f"_spd{spd}" if spd > 1 else ""),
         "batch": batch, "seq": seq,
         "vocab": vocab, "tok_s": tok_s, "wall_s": round(dt, 1),
+        **breakdown,
         **({"steps_per_dispatch": spd} if spd > 1 else {}),
         # The Trainer's own SIGTERM handler consumed a kill signal (it
         # saves and exits cleanly); run_case reads this flag — in

@@ -36,6 +36,11 @@ class DataConfig:
     # TPU additions: streaming sources ("jsonl" | "hf_stream" | "synthetic")
     source: str = "jsonl"
     streaming: Dict[str, Any] = field(default_factory=dict)
+    # Device-resident batches kept ahead of the step loop by
+    # data/device_prefetch.py (H2D transfer overlaps compute). 0 = fetch
+    # and transfer synchronously inside the loop. Distinct from the
+    # streaming HOST prefetch queue (streaming.prefetch).
+    prefetch_depth: int = 2
 
     @property
     def max_context_size(self) -> int:
@@ -268,6 +273,11 @@ class SystemConfig:
     # still come back (scan stacks the metrics); preemption latency grows
     # to at most K steps. Not supported under pipeline parallelism.
     steps_per_dispatch: int = 1
+    # Persistent XLA compilation cache directory. Crash-restarts (the PR 3
+    # auto-resume supervisor) and repeated runs of the same program reload
+    # compiled executables instead of paying a full recompile; the trainer
+    # logs a warm/cold line at startup. None disables.
+    compilation_cache_dir: Optional[str] = None
 
     def __post_init__(self):
         if self.compute_dtype is None:

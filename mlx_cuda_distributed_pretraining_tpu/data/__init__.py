@@ -1,11 +1,13 @@
 from .packing import pack_documents, pad_documents
 from .memory import DataManager
 from .streaming import DiskSpaceManager, StreamingDataManager, build_data_manager
+from .device_prefetch import DevicePrefetcher
 
 __all__ = [
     "pack_documents",
     "pad_documents",
     "DataManager",
+    "DevicePrefetcher",
     "DiskSpaceManager",
     "StreamingDataManager",
     "build_data_manager",

@@ -1,0 +1,274 @@
+"""Device-side input prefetcher: overlapped H2D for the train step loop.
+
+The jitted train step is one donated XLA program (train/train_step.py), but
+feeding it an inline ``jnp.asarray`` stalls that program every step on a
+synchronous host→device copy — step N's compute never overlaps batch N+1's
+transfer, or (under a mesh) its resharding at dispatch. Production TPU
+stacks hide exactly this latency (MegaScale-style compute/transfer overlap;
+tf.data-style pipelined input). This module restores it: a background
+thread pulls host batches from any loader with the ``generate_batch(step)``
+surface (data/memory.py, data/streaming.py, data/token_shards.py), issues
+``jax.device_put`` with the explicit ``NamedSharding(mesh, batch_pspec)``
+the jitted step expects — so jit never re-shards at dispatch — and keeps up
+to ``depth`` batches already resident on device. The step loop's ``get()``
+then returns immediately in steady state, and its ``data_wait_s`` measures
+the only true input stall.
+
+Checkpoint contract (PR 3 resume depends on it): ``state_dict()`` reflects
+the position of the last batch the TRAINER consumed via ``get()`` —
+batches sitting in the device queue have not been trained on and must not
+advance the saved position. This is the same contract as
+``StreamingDataManager.state_dict`` (streaming.py), which snapshots the
+last *served* batch; stream-stateful loaders advertise it via the
+``stream_stateful`` class attribute and the worker snapshots
+``loader.state_dict()`` after each fetch so the consumer can expose the
+consumed one. Loaders whose ``generate_batch`` is a pure function of the
+step (memory/token_shards) carry no stream position — for those
+``state_dict()`` delegates live so e.g. validation pointers stay current.
+
+``depth <= 0`` selects the synchronous mode: no worker thread; each
+``get()`` fetches and transfers inline. Same code path, same sharding,
+same batch sequence — the parity tests pin prefetch on == off losses.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..parallel.sharding_rules import batch_pspec
+
+
+class DevicePrefetcher:
+    """Wraps a host loader and serves device-resident, pre-sharded batches.
+
+    Single-step mode (``group_len_fn=None``): ``get()`` returns
+    ``(device_batch, local_tokens, waits)`` for data steps ``start_step``,
+    ``start_step+1``, ... — matching the trainer's
+    ``generate_batch(step - 1)`` convention.
+
+    Group mode (``steps_per_dispatch > 1``): ``group_len_fn(step)`` gives
+    the dispatch-group length at each group-start step (the trainer passes
+    ``_dispatch_group_len`` so groups land on exactly the same boundaries
+    as before); ``get()`` returns a stacked ``[K, B, L]`` batch and a list
+    of per-step token counts. A StopIteration mid-group yields the fetched
+    prefix, then end-of-stream on the next ``get()`` — same prefix-dispatch
+    semantics as the old inline loop.
+    """
+
+    def __init__(
+        self,
+        loader: Any,
+        mesh: Any = None,
+        depth: int = 2,
+        start_step: int = 0,
+        total_steps: Optional[int] = None,
+        group_len_fn: Optional[Callable[[int], int]] = None,
+    ):
+        self.loader = loader
+        self.mesh = mesh
+        self.depth = int(depth)
+        self.total_steps = total_steps  # None: run until StopIteration
+        self.group_len_fn = group_len_fn
+
+        self._stateful = bool(getattr(loader, "stream_stateful", False))
+        # Captured before the worker starts fetching: a checkpoint taken
+        # before anything is consumed must not see worker-advanced state.
+        self._initial_state = loader.state_dict() if self._stateful else None
+        self._consumed_state: Optional[Dict[str, Any]] = None
+
+        self._sharding = None
+        self._group_sharding = None
+        if mesh is not None:
+            bp = batch_pspec(mesh)
+            self._sharding = NamedSharding(mesh, bp)
+            # Group batches are [K, B, L]: step axis unsharded, matching
+            # make_multi_step's batch_shardings (train/train_step.py).
+            self._group_sharding = NamedSharding(mesh, PartitionSpec(None, *bp))
+
+        # Group-stacking buffers are reused across groups ONLY when the
+        # transfer is a real copy (TPU/GPU HBM). CPU jax.device_put can be
+        # zero-copy — the device array aliases the host buffer, and a
+        # refill would corrupt a group still in flight.
+        self._reuse_group_bufs = jax.default_backend() != "cpu"
+        self._group_bufs: Dict[int, Dict[str, np.ndarray]] = {}
+        self._cursor = int(start_step) + 1  # next trainer step to feed
+        self._done = False
+        # Consumer-side latch: once an end/error item is consumed the worker
+        # has exited, so a further queue.get() would block forever — repeat
+        # the terminal outcome instead.
+        self._terminal: Optional[Dict[str, Any]] = None
+
+        self._queue: Optional[queue.Queue] = None
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if self.depth > 0:
+            self._queue = queue.Queue(maxsize=self.depth)
+            self._thread = threading.Thread(
+                target=self._worker, daemon=True, name="device-prefetch")
+            self._thread.start()
+
+    # -- producer ------------------------------------------------------------
+
+    def _produce_one(self) -> Dict[str, Any]:
+        """Fetch the next (group of) host batch(es), transfer, advance the
+        cursor. Returns a queue item; never raises (errors become items so
+        they surface at the consumer's ``get()``, not in the thread)."""
+        if self._done or (
+                self.total_steps is not None and self._cursor > self.total_steps):
+            self._done = True
+            return {"kind": "end"}
+        step = self._cursor
+        glen = 1 if self.group_len_fn is None else max(1, int(self.group_len_fn(step)))
+        batches = []
+        snapshot = None
+        exhausted = False
+        t0 = time.perf_counter()
+        try:
+            for i in range(glen):
+                batches.append(self.loader.generate_batch(step - 1 + i))
+                if self._stateful:
+                    snapshot = self.loader.state_dict()
+        except StopIteration:
+            exhausted = True
+        except Exception as exc:  # producer errors (e.g. streaming RuntimeError)
+            self._done = True
+            return {"kind": "error", "error": exc}
+        fetch_s = time.perf_counter() - t0
+        if not batches:
+            self._done = True
+            return {"kind": "end"}
+        # Host-side token counts (non-pad targets) — off the critical path
+        # here, so tok/s stays correct even though device metrics are only
+        # read every logging_interval steps.
+        tokens = [int(b["mask"].sum()) for b in batches]
+        t0 = time.perf_counter()
+        if self.group_len_fn is not None:
+            dev = self._transfer(self._fill_group_buffers(batches), self._group_sharding)
+        else:
+            dev = self._transfer(batches[0], self._sharding)
+        # Block HERE, in the worker: the consumer's get() never waits on the
+        # copy, and the preallocated group buffers are free for reuse.
+        jax.block_until_ready(dev)
+        h2d_s = time.perf_counter() - t0
+        self._cursor = step + len(batches)
+        if exhausted:
+            self._done = True
+        return {
+            "kind": "batch",
+            "batch": dev,
+            "tokens": tokens if self.group_len_fn is not None else tokens[0],
+            "snapshot": snapshot,
+            "fetch_s": fetch_s,
+            "h2d_s": h2d_s,
+        }
+
+    def _transfer(self, host_batch: Dict[str, np.ndarray], sharding):
+        if sharding is not None and jax.process_count() > 1 and hasattr(
+                jax, "make_array_from_process_local_data"):
+            # Multi-host: each process holds only its local rows; assemble
+            # the global sharded array from per-process shards.
+            return {k: jax.make_array_from_process_local_data(sharding, v)
+                    for k, v in host_batch.items()}
+        if sharding is not None:
+            return jax.device_put(host_batch, sharding)
+        return jax.device_put(host_batch)
+
+    def _fill_group_buffers(self, batches):
+        """Stack a dispatch group into ``[K, B, L]`` buffers preallocated
+        once per group length and filled in place (``np.stack`` allocates a
+        fresh array every group). Reuse is safe because ``_produce_one``
+        blocks on the transfer before the next fill of the same buffer."""
+        glen = len(batches)
+        bufs = self._group_bufs.get(glen) if self._reuse_group_bufs else None
+        if bufs is None:
+            bufs = {k: np.empty((glen,) + np.shape(v), np.asarray(v).dtype)
+                    for k, v in batches[0].items()}
+            if self._reuse_group_bufs:
+                self._group_bufs[glen] = bufs
+        for i, b in enumerate(batches):
+            for k, v in b.items():
+                bufs[k][i] = v
+        return bufs
+
+    def _worker(self) -> None:
+        while not self._stop_evt.is_set():
+            item = self._produce_one()
+            while not self._stop_evt.is_set():
+                try:
+                    self._queue.put(item, timeout=0.2)
+                    break
+                except queue.Full:
+                    continue
+            if item["kind"] in ("end", "error"):
+                return
+
+    # -- consumer ------------------------------------------------------------
+
+    def get(self):
+        """Next device-resident batch: ``(batch, tokens, waits)``.
+
+        ``tokens`` is this host's non-pad target count (an int, or a list
+        of per-step ints in group mode). ``waits`` carries ``data_wait_s``
+        (time this call blocked waiting for input — the true stall) and
+        ``h2d_wait_s`` (host→device transfer time for the item: overlapped
+        with compute when the worker thread is running, on the critical
+        path in synchronous mode). Raises StopIteration at end of stream;
+        re-raises loader errors.
+        """
+        if self._terminal is not None:
+            item = self._terminal
+            data_wait = 0.0
+        elif self._queue is None:
+            item = self._produce_one()
+            data_wait = item.get("fetch_s", 0.0)
+        else:
+            t0 = time.perf_counter()
+            item = self._queue.get()
+            data_wait = time.perf_counter() - t0
+        if item["kind"] == "error":
+            self._terminal = item
+            raise item["error"]
+        if item["kind"] == "end":
+            self._terminal = item
+            raise StopIteration("stream exhausted")
+        if item["snapshot"] is not None:
+            self._consumed_state = item["snapshot"]
+        return item["batch"], item["tokens"], {
+            "data_wait_s": data_wait, "h2d_wait_s": item["h2d_s"]}
+
+    # -- loader surface ------------------------------------------------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Loader position as CONSUMED by the trainer (see module
+        docstring). Stream-stateful loaders get the snapshot taken right
+        after the last consumed batch's fetch; pure-function-of-step
+        loaders delegate live."""
+        if self._stateful:
+            if self._consumed_state is not None:
+                return dict(self._consumed_state)
+            return dict(self._initial_state)
+        return self.loader.state_dict()
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.loader.load_state_dict(state)
+
+    def stop(self) -> None:
+        """Stop the worker thread. Does NOT stop the wrapped loader — the
+        trainer owns the loader's lifecycle (it may still run validation)."""
+        self._stop_evt.set()
+        if self._queue is not None:
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

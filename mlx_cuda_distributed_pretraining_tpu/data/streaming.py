@@ -439,6 +439,11 @@ class StreamingDataManager:
     skip-replay (the reference resumes only step count —
     core/training.py:1545-1564)."""
 
+    # state_dict() tracks a stream position that advances with every served
+    # batch (unlike the pure-function-of-step loaders). DevicePrefetcher
+    # keys on this to snapshot per-fetch and report the CONSUMED position.
+    stream_stateful = True
+
     def __init__(
         self,
         data_config: Any,

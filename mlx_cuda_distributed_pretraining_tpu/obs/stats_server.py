@@ -79,6 +79,7 @@ class StatsState:
         max_step = 0
         alive = 0
         queue_depth, occupancy, serve_workers = 0, 0, 0
+        data_waits = []
         now = time.time()
         for w in self.workers.values():
             m = w.get("metrics", {})
@@ -94,6 +95,8 @@ class StatsState:
                 serve_workers += 1
                 occupancy += int(m["batch_occupancy"])
                 queue_depth += int(m.get("queue_depth", 0) or 0)
+            if isinstance(m.get("data_wait_frac"), (int, float)):
+                data_waits.append(float(m["data_wait_frac"]))
         agg = {
             "num_workers": len(self.workers),
             "alive_workers": alive,
@@ -105,6 +108,10 @@ class StatsState:
             agg["serve_engines"] = serve_workers
             agg["serve_occupancy"] = occupancy
             agg["serve_queue_depth"] = queue_depth
+        if data_waits:
+            # Input-pipeline health across trainers: fraction of wall clock
+            # the step loop spent waiting for data (device_prefetch.py).
+            agg["mean_data_wait_frac"] = sum(data_waits) / len(data_waits)
         return agg
 
     def snapshot(self) -> Dict[str, Any]:

@@ -12,13 +12,20 @@ from .adafactor import adafactor
 from .enhanced import adam, adamw, lion, sgd
 from .factory import build_optimizer
 from .muon import muon, newton_schulz5
-from .schedules import build_schedule, cosine_decay, join_schedules, linear_schedule, warmup_cosine
+from .schedules import (
+    build_schedule,
+    cosine_decay,
+    join_schedules,
+    linear_schedule,
+    schedule_value,
+    warmup_cosine,
+)
 from .shampoo import inverse_pth_root, shampoo
 
 __all__ = [
     "Transform", "apply_updates", "chain", "clip_by_global_norm", "ema_params",
     "global_norm", "partition", "with_ema", "adam", "adamw", "lion", "sgd",
     "build_optimizer", "muon", "newton_schulz5", "build_schedule",
-    "cosine_decay", "join_schedules", "linear_schedule", "warmup_cosine",
-    "inverse_pth_root", "shampoo", "adafactor",
+    "cosine_decay", "join_schedules", "linear_schedule", "schedule_value",
+    "warmup_cosine", "inverse_pth_root", "shampoo", "adafactor",
 ]

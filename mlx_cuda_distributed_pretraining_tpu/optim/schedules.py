@@ -4,6 +4,12 @@ Capability parity with the reference schedules (reference:
 mlx_lm_utils.py:5-56 — linear_schedule, cosine_decay, join_schedules) and
 the trainer's builder (core/training.py:770-785 — cosine_with_warmup /
 cosine / linear with min_lr_ratio).
+
+Every schedule takes an ``xp`` array-namespace keyword (default ``jnp``):
+inside the jitted optimizer update the step is a tracer and needs the jnp
+path, but the trainer's log line only needs a float — ``schedule_value``
+evaluates the same closed form through numpy, with no retrace and no
+device-scalar round-trip in the hot loop.
 """
 
 from __future__ import annotations
@@ -11,37 +17,38 @@ from __future__ import annotations
 from typing import Any, Sequence
 
 import jax.numpy as jnp
+import numpy as np
 
 from .base import Schedule
 
 
 def constant(value: float) -> Schedule:
-    return lambda step: jnp.asarray(value, jnp.float32)
+    return lambda step, xp=jnp: xp.asarray(value, xp.float32)
 
 
 def linear_schedule(init_value: float, end_value: float, steps: int) -> Schedule:
-    def fn(step):
-        frac = jnp.clip(step / max(steps, 1), 0.0, 1.0)
+    def fn(step, xp=jnp):
+        frac = xp.clip(step / max(steps, 1), 0.0, 1.0)
         return init_value + (end_value - init_value) * frac
 
     return fn
 
 
 def cosine_decay(init_value: float, decay_steps: int, end_value: float = 0.0) -> Schedule:
-    def fn(step):
-        frac = jnp.clip(step / max(decay_steps, 1), 0.0, 1.0)
-        cos = 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+    def fn(step, xp=jnp):
+        frac = xp.clip(step / max(decay_steps, 1), 0.0, 1.0)
+        cos = 0.5 * (1.0 + xp.cos(xp.pi * frac))
         return end_value + (init_value - end_value) * cos
 
     return fn
 
 
 def join_schedules(schedules: Sequence[Schedule], boundaries: Sequence[int]) -> Schedule:
-    def fn(step):
-        step = jnp.asarray(step)
-        out = schedules[0](step)
+    def fn(step, xp=jnp):
+        step = xp.asarray(step)
+        out = schedules[0](step, xp=xp)
         for i, b in enumerate(boundaries):
-            out = jnp.where(step >= b, schedules[i + 1](step - b), out)
+            out = xp.where(step >= b, schedules[i + 1](step - b, xp=xp), out)
         return out
 
     return fn
@@ -53,6 +60,20 @@ def warmup_cosine(peak: float, total_steps: int, warmup_steps: int, end_value: f
          cosine_decay(peak, max(total_steps - warmup_steps, 1), end_value)],
         [warmup_steps],
     )
+
+
+def schedule_value(schedule: Schedule, step: int) -> float:
+    """Host-side scalar evaluation of a schedule, for logging.
+
+    ``float(schedule(jnp.asarray(step)))`` in the step loop re-traces the
+    closure and blocks on a device scalar every log interval; the numpy
+    path costs a few host flops instead. Schedules that don't take ``xp``
+    (externally supplied callables) fall back to the device path.
+    """
+    try:
+        return float(schedule(step, xp=np))
+    except TypeError:
+        return float(schedule(jnp.asarray(step)))
 
 
 def build_schedule(training_cfg: Any, total_steps: int) -> Schedule:

@@ -30,12 +30,13 @@ from ..checkpoint import CheckpointIntegrityError, CheckpointManager
 from ..checkpoint.manager import _atomic_json
 from ..config import Config, apply_overrides
 from ..data import DataManager
+from ..data.device_prefetch import DevicePrefetcher
 from ..data.streaming import build_data_manager
 from ..models.llama import LlamaArgs
 from ..models import llama as llama_mod
 from ..models.registry import resolve_architecture
 from ..obs import Logger
-from ..optim import build_optimizer, build_schedule
+from ..optim import build_optimizer, build_schedule, schedule_value
 from ..parallel import build_mesh
 from ..tokenizer import TokenizerManager
 from .early_stopping import EarlyStoppingMonitor
@@ -91,6 +92,13 @@ class Trainer:
         self.checkpoints.notify = self.logger.log
         if for_training and not resume and is_chief:
             cfg.to_yaml(os.path.join(run_dir, "config.yaml"))
+
+        # Persistent XLA compilation cache: enabled BEFORE the first jit
+        # compile (model init below) so crash-restarts under the auto-resume
+        # supervisor reload executables instead of recompiling everything.
+        if for_training and getattr(cfg.system, "compilation_cache_dir", None):
+            self.logger.log(
+                _enable_compilation_cache(cfg.system.compilation_cache_dir))
 
         # -- tokenizer -------------------------------------------------------
         self.tokenizer = TokenizerManager(cfg.data, run_dir=run_dir if for_training else None)
@@ -292,6 +300,9 @@ class Trainer:
         self.total_tokens = 0
         self.start_step = 0
         self.val_history: Dict[str, list] = {"steps": [], "losses": []}
+        # Created by train() right before the step loop; checkpoints read
+        # the consumed loader position through it (see _data_state).
+        self.prefetcher: Optional[DevicePrefetcher] = None
 
         if resume and for_training:
             self._resume()
@@ -316,6 +327,15 @@ class Trainer:
         return self.state["opt_state"]
 
     # -- checkpointing ------------------------------------------------------
+    def _data_state(self) -> Dict[str, Any]:
+        """Loader position as consumed by the trainer. When the device
+        prefetcher is active its snapshot wins: batches sitting in the
+        device queue have NOT been trained on, so saving the raw loader's
+        position would skip them on resume."""
+        if self.prefetcher is not None:
+            return self.prefetcher.state_dict()
+        return self.data.state_dict() if self.data else {"val_ptr": 0}
+
     def save_checkpoint(self, step, blocking: bool = True) -> None:
         # The host gather is a COLLECTIVE when state is sharded across
         # processes (multi-host FSDP/ZeRO), so every process runs it; only
@@ -335,13 +355,13 @@ class Trainer:
             # Temp+rename (not a plain json.dump): a crash mid-write must
             # not leave a torn sidecar that corrupts this host's resume
             # position. The chief folds the sidecars into the step manifest.
-            _atomic_json(sidecar, self.data.state_dict())
+            _atomic_json(sidecar, self._data_state())
         if jax.process_index() != 0:
             return
         training_state = {
             "step": int(self.state["step"]),
             "total_tokens": int(self.total_tokens),
-            **(self.data.state_dict() if self.data else {"val_ptr": 0}),
+            **self._data_state(),
             "validation": self.val_history,
             "early_stopping": self.early_stopping.state_dict(),
         }
@@ -612,9 +632,33 @@ class Trainer:
                 self.val_history["losses"].append(v)
 
         window_tokens = 0
+        window_data_wait = 0.0
+        window_h2d = 0.0
+        window_dispatch = 0.0
         window_start = time.perf_counter()
         last_loss = float("nan")
         stopped_early = False
+
+        # Device-side input pipeline: a background worker keeps
+        # data.prefetch_depth batches resident on device, pre-sharded to the
+        # jitted step's expected layout, so the loop below never blocks on a
+        # host->device copy (data/device_prefetch.py). In group mode the
+        # worker computes dispatch-group boundaries with the same
+        # _dispatch_group_len the loop uses, so group/interval semantics
+        # are unchanged.
+        group_len_fn = None
+        if self.steps_per_dispatch > 1:
+            def group_len_fn(s):
+                return self._dispatch_group_len(
+                    s, val_int, ckpt_int, prof_start, prof_stop)
+        self.prefetcher = DevicePrefetcher(
+            self.data,
+            mesh=self.mesh,
+            depth=int(getattr(cfg.data, "prefetch_depth", 2)),
+            start_step=self.start_step,
+            total_steps=self.total_steps,
+            group_len_fn=group_len_fn,
+        )
 
         # Preemption-aware checkpointing (SURVEY.md §5 failure-detection
         # plan; the reference's only recovery story is checkpoint-resume):
@@ -671,44 +715,45 @@ class Trainer:
                         self.logger.log(f"profiler: trace started at step {step}")
                 if self.steps_per_dispatch > 1:
                     if not pending:
-                        glen = self._dispatch_group_len(
-                            step, val_int, ckpt_int, prof_start, prof_stop)
-                        batches = []
-                        for i in range(glen):
-                            try:
-                                batches.append(self.data.generate_batch(step - 1 + i))
-                            except StopIteration:
-                                break  # dispatch the fetched prefix; the
-                                # next group attempt gets 0 and stops
-                        if not batches:
+                        try:
+                            # Stacked [K, B, L], already device-resident and
+                            # sharded; StopIteration mid-group served the
+                            # fetched prefix on the previous get().
+                            stacked, group_tokens, waits = self.prefetcher.get()
+                        except StopIteration:
                             self.logger.log(
                                 f"Data stream exhausted before step {step}; stopping")
                             break
-                        stacked = {k: np.stack([b[k] for b in batches])
-                                   for k in batches[0]}
-                        self.state, mm = self.train_multi_step(
-                            self.state, _device_batch(stacked))
+                        window_data_wait += waits["data_wait_s"]
+                        window_h2d += waits["h2d_wait_s"]
+                        t_dispatch = time.perf_counter()
+                        self.state, mm = self.train_multi_step(self.state, stacked)
+                        window_dispatch += time.perf_counter() - t_dispatch
                         pending = [
                             (jax.tree_util.tree_map(lambda a, i=i: a[i], mm),
-                             int(b["mask"].sum()) * jax.process_count())
-                            for i, b in enumerate(batches)
+                             t * jax.process_count())
+                            for i, t in enumerate(group_tokens)
                         ]
                     metrics, step_tokens = pending.pop(0)
                     window_tokens += step_tokens
                     self.total_tokens += step_tokens
                 else:
                     try:
-                        batch = self.data.generate_batch(step - 1)
+                        batch, local_tokens, waits = self.prefetcher.get()
                     except StopIteration:  # finite stream ran dry (streaming sources)
                         self.logger.log(f"Data stream exhausted before step {step}; stopping")
                         break
-                    # Host-side token count (non-pad targets) so tok/s stays
-                    # correct even when device metrics are only read every
-                    # log_int steps.
-                    step_tokens = int(batch["mask"].sum()) * jax.process_count()
+                    # Token counts (non-pad targets) come host-counted from
+                    # the prefetch worker, so tok/s stays correct even when
+                    # device metrics are only read every log_int steps.
+                    step_tokens = local_tokens * jax.process_count()
                     window_tokens += step_tokens
                     self.total_tokens += step_tokens
-                    self.state, metrics = self.train_step(self.state, _device_batch(batch))
+                    window_data_wait += waits["data_wait_s"]
+                    window_h2d += waits["h2d_wait_s"]
+                    t_dispatch = time.perf_counter()
+                    self.state, metrics = self.train_step(self.state, batch)
+                    window_dispatch += time.perf_counter() - t_dispatch
 
                 if step % log_int == 0 or step == self.total_steps:
                     loss = float(metrics["loss"])  # device sync point
@@ -717,9 +762,20 @@ class Trainer:
                     line = {
                         "loss": loss,
                         "ppl": float(math.exp(min(loss, 30.0))),
-                        "lr": float(self.schedule(jnp.asarray(step))),
+                        # Host-side numpy evaluation: the jnp path re-traces
+                        # the schedule closure and syncs a device scalar on
+                        # every log line (see tests/lint_fixtures).
+                        "lr": schedule_value(self.schedule, step),
                         "tok/s": window_tokens / elapsed,
                         "toks": int(window_tokens),
+                        # Step-time breakdown for this window: data_wait is
+                        # the only true input stall (queue get); h2d is the
+                        # transfer time (overlapped unless prefetch_depth=0);
+                        # dispatch is time inside the jitted-step calls.
+                        "data_wait_s": window_data_wait,
+                        "h2d_wait_s": window_h2d,
+                        "dispatch_s": window_dispatch,
+                        "data_wait_frac": min(window_data_wait / elapsed, 1.0),
                     }
                     if "grad_norm" in metrics:
                         line["grad_norm"] = float(metrics["grad_norm"])
@@ -729,6 +785,7 @@ class Trainer:
                     if self.stats_client is not None:
                         self.stats_client.log_metrics(step, line)
                     window_tokens = 0
+                    window_data_wait = window_h2d = window_dispatch = 0.0
                     window_start = time.perf_counter()
 
                 if val_int and step % val_int == 0:
@@ -778,6 +835,11 @@ class Trainer:
                     break
 
         finally:
+            # Stop the device-prefetch worker first (fast; discards queued
+            # not-yet-consumed batches — the consumed-position snapshot the
+            # final checkpoint needs is retained on the prefetcher object).
+            if self.prefetcher is not None:
+                self.prefetcher.stop()
             # Drain pending async checkpoint writes even when an exception
             # escapes the loop — the interpreter would otherwise kill the
             # daemon writer mid-file (temp+rename makes that safe for the
@@ -817,7 +879,46 @@ class Trainer:
 
 
 def _device_batch(batch: Dict[str, np.ndarray]) -> Dict[str, jnp.ndarray]:
+    """Synchronous H2D for the cold paths (validation, LR finder). The
+    train step loop never calls this — it consumes pre-sharded batches
+    from DevicePrefetcher (data/device_prefetch.py)."""
     return {k: jnp.asarray(v) for k, v in batch.items()}
+
+
+def _enable_compilation_cache(cache_dir: str) -> str:
+    """Point XLA's persistent compilation cache at ``cache_dir`` and return
+    a one-line status for log.txt. The entry count before this run is the
+    startup hit/miss signal: a warm cache means the big train-step compile
+    will be a disk load instead of a recompile."""
+    try:
+        entries = len(os.listdir(cache_dir)) if os.path.isdir(cache_dir) else 0
+    except OSError:
+        entries = 0
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        try:
+            # Cache everything: the supervisor's crash-restart recompiles
+            # are exactly the programs worth persisting, however fast or
+            # small (the default entry-size floor silently skips CPU-sized
+            # executables, which is also what the parity tests exercise).
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        except Exception:
+            pass  # knob names vary across jax versions; dir alone suffices
+        try:
+            # The cache object binds its directory when the backend first
+            # initializes; by the time the trainer reads its run config the
+            # PRNG/mesh setup has already done that, so a late dir update is
+            # silently ignored unless the cache is re-initialized.
+            from jax._src.compilation_cache import reset_cache
+            reset_cache()
+        except Exception:
+            pass
+    except Exception as e:
+        return f"compilation cache unavailable ({e}); continuing without it"
+    state = "warm (cache hits expected)" if entries else "cold (will populate)"
+    return f"compilation cache: {cache_dir} — {entries} entries, {state}"
 
 
 def load_trained(run_name_or_dir: str, runs_root: str = "runs"):

@@ -22,3 +22,15 @@ def materialize(s, batches):
         s, m = step(s, b)
         rows.append(np.asarray(m))  # line 23: device->host copy per step
     return rows
+
+
+def log_lr_per_step(s, batches, schedule):
+    import jax.numpy as jnp
+
+    lr = 0.0
+    for i, b in enumerate(batches):
+        s, m = step(s, b)
+        lr = float(schedule(jnp.asarray(i)))  # line 33: retrace + device
+        # scalar sync per step — evaluate schedules host-side instead
+        # (optim.schedules.schedule_value)
+    return s, lr

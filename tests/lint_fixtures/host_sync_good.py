@@ -29,3 +29,12 @@ def train_accumulated(s, batches):
 def data_loop(batches):
     # np.asarray in a loop with NO jit dispatch is host-side data prep
     return [np.asarray(b) for b in batches] + [np.asarray(b + 1) for b in batches]
+
+
+def log_lr_host_side(s, batches, schedule_value, schedule):
+    lr = 0.0
+    for i, b in enumerate(batches):
+        s, m = step(s, b)
+        lr = schedule_value(schedule, i)  # host-side numpy evaluation:
+        # no retrace, no device scalar round-trip
+    return s, lr

@@ -87,7 +87,7 @@ def test_baseline_entries_all_still_match():
 @pytest.mark.parametrize("fixture,rule,lines", [
     ("recompile_hazard_bad.py", "recompile-hazard", [8, 15, 25]),
     ("rng_reuse_bad.py", "rng-reuse", [7, 14]),
-    ("host_sync_bad.py", "host-sync-in-hot-loop", [15, 23]),
+    ("host_sync_bad.py", "host-sync-in-hot-loop", [15, 23, 33]),
     ("use_after_donate_bad.py", "use-after-donate", [14, 21]),
     ("tracer_leak_bad.py", "tracer-leak", [10, 17]),
     ("jit_in_loop_bad.py", "jit-in-loop", [7]),
@@ -176,7 +176,7 @@ def test_cli_exit_one_on_bad_fixture_and_json_shape():
     doc = json.loads(proc.stdout)
     assert doc["tool"] == "graftlint"
     assert {f["rule"] for f in doc["new"]} == {"host-sync-in-hot-loop"}
-    assert sorted(f["line"] for f in doc["new"]) == [15, 23]
+    assert sorted(f["line"] for f in doc["new"]) == [15, 23, 33]
     for key in ("baselined", "suppressed", "stale_baseline"):
         assert key in doc
 
