@@ -72,7 +72,26 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import mlx_cuda_distributed_pretraining_tpu  # noqa: F401
 
 BASELINE_TOKS_PER_SEC = 27500.0  # reference README.md:60 implied
-V5E_PEAK_FLOPS = 197e12  # TPU v5e bf16 peak per chip
+
+
+def peak_flops():
+    """Per-chip peak FLOPs from the shared detection table (obs/flops.py:
+    GRAFT_PEAK_FLOPS env override, then device_kind lookup). None when the
+    chip is unknown (e.g. CPU CI) — rows then stamp ``mfu: "unknown"``
+    instead of publishing a number computed against the wrong peak."""
+    from mlx_cuda_distributed_pretraining_tpu.obs.flops import peak_flops_per_chip
+
+    try:
+        return peak_flops_per_chip()
+    except Exception:  # noqa: BLE001 - tunnel-dependent introspection
+        return None
+
+
+def mfu_or_unknown(ft, tok_s):
+    peak = peak_flops()
+    if not peak or not tok_s:
+        return "unknown"
+    return round(ft * tok_s / peak, 4)
 
 # BASELINE.md scale points; per-chip batch/seq chosen to fill HBM (fused CE
 # frees the 4.3GB logits tensor, so 100m runs bs32 and 400m bs16 + remat).
@@ -168,7 +187,10 @@ def build_doc(matrix, device, vocab, reason, elapsed_s=None):
 
     flash_2m = _clean("2m_flash")
     mega_2m = _clean("2m_mega")
-    best_mfu = max((r.get("mfu", 0.0) or 0.0 for r in matrix), default=0.0)
+    # Harvester/legacy rows may carry mfu as the "unknown" stamp or None;
+    # only numeric values compete for the headline.
+    best_mfu = max((r["mfu"] for r in matrix
+                    if isinstance(r.get("mfu"), (int, float))), default=0.0)
     # Headline prefers the megastep (chip-rate) 2m row when captured: the
     # per-step 2m row's wall clock is dominated by tunnel dispatch RTT
     # (~11ms compute inside a ~195ms step, TUNNEL_NOTE_r4), so it measures
@@ -503,7 +525,8 @@ def bench_train_case(name, scale_key, attn, vocab, steps, fused_ce=True,
         "fused_ce": ce_chunk > 0, "ce_chunk": ce_chunk,
         "tok_s": round(tok_s, 0),
         "step_ms": round(1000 * dt / steps, 1),
-        "mfu": round(ft * tok_s / V5E_PEAK_FLOPS, 4),
+        "flops_per_token": round(ft, 0),
+        "mfu": mfu_or_unknown(ft, tok_s),
         "final_loss": round(final_loss, 3),
         "hbm_peak_gb": hbm_peak_gb,
         "hbm_src": hbm_src,
@@ -786,14 +809,17 @@ def bench_trainer_case(vocab, workdir="/tmp/bench_trainer", spd=1):
             if "tok/s=" in line:
                 tok_s = float(line.split("tok/s=")[1].split()[0].rstrip("|"))
                 for key in ("data_wait_s", "h2d_wait_s", "dispatch_s",
-                            "data_wait_frac"):
+                            "ckpt_save_s", "other_s", "data_wait_frac"):
                     if f"{key}=" in line:
                         breakdown[key] = float(
                             line.split(f"{key}=")[1].split()[0].rstrip("|"))
+    ft = t.flops_per_token  # analytic 6N + attention (obs/flops.py)
     return {
         "case": "trainer_40m_flash_e2e" + (f"_spd{spd}" if spd > 1 else ""),
         "batch": batch, "seq": seq,
         "vocab": vocab, "tok_s": tok_s, "wall_s": round(dt, 1),
+        "flops_per_token": round(ft, 0),
+        "mfu": mfu_or_unknown(ft, tok_s),
         **breakdown,
         **({"steps_per_dispatch": spd} if spd > 1 else {}),
         # The Trainer's own SIGTERM handler consumed a kill signal (it

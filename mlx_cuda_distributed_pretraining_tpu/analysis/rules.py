@@ -21,6 +21,8 @@ Rule IDs (stable — used in suppressions and the baseline):
 - ``tracer-leak``         assigning traced values to self.*/globals
                           inside a jitted function.
 - ``jit-in-loop``         jax.jit called inside a loop body.
+- ``time-in-jit``         wall-clock reads / sleep / print / open inside
+                          a jitted function body (trace-time constants).
 """
 
 from __future__ import annotations
@@ -645,3 +647,49 @@ class JitInLoop(Rule):
                         "iteration creates a new wrapper and misses the "
                         "compile cache; hoist the jit (or a cached factory) "
                         "out of the loop"))
+
+
+# -- time-in-jit ------------------------------------------------------------
+
+# Wall-clock reads and sleep: inside a trace they run ONCE, at trace time,
+# so the "measured" interval is a compile-time constant baked into the
+# program (telemetry built on it silently reports the compile, not the
+# step — the exact bug obs/flops.py's goodput ledger exists to avoid).
+_TRACE_TIME_CALLS = {"time.time", "time.perf_counter", "time.monotonic",
+                     "time.process_time", "time.sleep"}
+# Blocking host I/O: same trace-once semantics (plus a file handle or
+# stdout write the compiled program will never repeat). jax.debug.print /
+# jax.debug.callback are the supported in-trace alternatives and do not
+# match these bare names.
+_TRACE_IO_CALLS = {"open", "print"}
+
+
+@register
+class TimeInJit(Rule):
+    id = "time-in-jit"
+    description = (
+        "time.time()/perf_counter()/sleep(), print() or open() inside a "
+        "jitted function runs once at TRACE time, not per call: timings "
+        "become compile-time constants and I/O never re-executes. Measure "
+        "around the dispatch (after block_until_ready) or use "
+        "jax.debug.print/jax.debug.callback for in-trace output."
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for fn, _spec in ctx.jit_index.functions.items():
+            for node in _walk_skip_defs(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if name in _TRACE_TIME_CALLS:
+                    yield self.finding(ctx, node, (
+                        f"`{name}(...)` inside jitted `{fn.name}` runs once "
+                        "at trace time — the value is a compile-time "
+                        "constant, not a per-step measurement; time around "
+                        "the dispatch (after block_until_ready) instead"))
+                elif name in _TRACE_IO_CALLS:
+                    yield self.finding(ctx, node, (
+                        f"`{name}(...)` inside jitted `{fn.name}` executes "
+                        "only at trace time — the compiled program never "
+                        "repeats the I/O; use jax.debug.print/"
+                        "jax.debug.callback for per-call output"))

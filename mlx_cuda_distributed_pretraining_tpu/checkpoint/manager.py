@@ -117,7 +117,8 @@ def _step_sort_key(tag: str) -> Tuple[int, int]:
 
 class CheckpointManager:
     def __init__(self, run_dir: str, keep_last: int = 0, keep_every: int = 0,
-                 notify: Optional[Callable[[str], None]] = None):
+                 notify: Optional[Callable[[str], None]] = None,
+                 metrics: Any = None):
         self.run_dir = run_dir
         self.checkpoint_dir = os.path.join(run_dir, "checkpoints")
         # Retention: keep_last=0 disables GC entirely; keep_every=M always
@@ -129,6 +130,15 @@ class CheckpointManager:
         # Integrity events (quarantine, ledger rebuild, GC) must be LOUD;
         # the trainer points this at its run logger.
         self.notify = notify
+        # Optional obs.MetricsRegistry: integrity outcomes as counters.
+        self._m_writes = self._m_verify = self._m_quarantined = None
+        if metrics is not None:
+            self._m_writes = metrics.counter(
+                "checkpoint_writes_total", "checkpoint write requests by mode")
+            self._m_verify = metrics.counter(
+                "checkpoint_verify_total", "manifest verifications by outcome")
+            self._m_quarantined = metrics.counter(
+                "checkpoint_quarantined_total", "steps moved to quarantine/")
         self._writer = None          # lazy background writer thread
         self._write_error: Optional[Exception] = None
         import threading
@@ -206,6 +216,8 @@ class CheckpointManager:
         training_state.setdefault("step", int(step) if str(step).isdigit() else step)
         payload = (step, model_path, opt_path, state_path, flat_params,
                    arrays, scalars, training_state, metadata_extra)
+        if self._m_writes is not None:
+            self._m_writes.inc(mode="blocking" if blocking else "async")
 
         if blocking:
             # Drain pending async writes (FIFO order), but do NOT let a
@@ -502,6 +514,12 @@ class CheckpointManager:
     def verify(self, step) -> Tuple[bool, str]:
         """Re-read every artifact the step's manifest lists and check
         existence, byte size, and CRC32. Returns ``(ok, reason)``."""
+        ok, reason = self._verify_inner(step)
+        if self._m_verify is not None:
+            self._m_verify.inc(ok=str(ok).lower())
+        return ok, reason
+
+    def _verify_inner(self, step) -> Tuple[bool, str]:
         mpath = self.manifest_path(step)
         if not os.path.isfile(mpath):
             return False, "no manifest"
@@ -549,6 +567,8 @@ class CheckpointManager:
             f.write(f"{time.time():.0f} {reason}; moved: {', '.join(moved) or 'nothing'}\n")
         self._notify(f"WARNING: quarantined checkpoint step {step} ({reason}) "
                      f"-> {qdir}")
+        if self._m_quarantined is not None:
+            self._m_quarantined.inc()
         return moved
 
     def latest_complete_step(self, quarantine: bool = True) -> Optional[str]:

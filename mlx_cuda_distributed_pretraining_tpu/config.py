@@ -185,6 +185,9 @@ class LoggingConfig:
     # divisible by keep_every, the resume-source step, and "final".
     # keep_last: 0 disables GC (keep everything).
     retention: Dict[str, Any] = field(default_factory=dict)
+    # Prometheus text exposition of the in-process metrics registry
+    # (obs/prometheus.py) on this port; 0 disables. Chief process only.
+    metrics_port: int = 0
 
     @property
     def logging_interval(self) -> int:
@@ -295,6 +298,22 @@ class SystemConfig:
 
 
 @dataclass
+class SupervisorConfig:
+    """Section ``supervisor`` (TPU addition, no reference counterpart).
+
+    Knobs for the auto-resume supervisor (train/supervisor.py). The hang
+    watchdog fires when the trainer's heartbeat file (written every step
+    window) goes stale for ``hang_timeout_s`` seconds: the child is
+    SIGTERMed (then SIGKILLed after ``hang_kill_grace_s``) and restarted
+    from the newest verified checkpoint, with the lost wall clock booked
+    into the goodput ledger via a ``restart`` event. 0 disables the
+    watchdog."""
+
+    hang_timeout_s: float = 0.0
+    hang_kill_grace_s: float = 20.0
+
+
+@dataclass
 class ResumeConfig:
     """Section ``resume`` (reference: core/training.py:124-127).
 
@@ -315,6 +334,7 @@ _SECTION_TYPES = {
     "training": TrainingConfig,
     "logging": LoggingConfig,
     "system": SystemConfig,
+    "supervisor": SupervisorConfig,
 }
 
 
@@ -342,6 +362,7 @@ class Config:
     training: TrainingConfig = field(default_factory=TrainingConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     system: SystemConfig = field(default_factory=SystemConfig)
+    supervisor: SupervisorConfig = field(default_factory=SupervisorConfig)
     resume: Optional[ResumeConfig] = None
     overwrite: bool = False
 

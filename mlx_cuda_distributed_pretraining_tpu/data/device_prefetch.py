@@ -70,12 +70,25 @@ class DevicePrefetcher:
         start_step: int = 0,
         total_steps: Optional[int] = None,
         group_len_fn: Optional[Callable[[int], int]] = None,
+        metrics: Any = None,
     ):
         self.loader = loader
         self.mesh = mesh
         self.depth = int(depth)
         self.total_steps = total_steps  # None: run until StopIteration
         self.group_len_fn = group_len_fn
+        # Optional obs.MetricsRegistry: input-pipeline health lands in the
+        # same registry the trainer exports (counters/histograms, no dicts).
+        self._m_batches = self._m_queue = self._m_data_wait = self._m_h2d = None
+        if metrics is not None:
+            self._m_batches = metrics.counter(
+                "input_batches_total", "batches served to the step loop")
+            self._m_queue = metrics.gauge(
+                "input_queue_depth", "device-resident batches ready to consume")
+            self._m_data_wait = metrics.histogram(
+                "input_data_wait_seconds", "step-loop stall waiting for input")
+            self._m_h2d = metrics.histogram(
+                "input_h2d_seconds", "host-to-device transfer time per item")
 
         self._stateful = bool(getattr(loader, "stream_stateful", False))
         # Captured before the worker starts fetching: a checkpoint taken
@@ -108,6 +121,12 @@ class DevicePrefetcher:
         self._queue: Optional[queue.Queue] = None
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # In synchronous mode (depth=0) the H2D transfer blocks the step
+        # loop, so h2d_wait_s is real wall time; with a worker thread the
+        # transfer overlaps compute and any residual stall already shows
+        # up in data_wait_s (items reach the queue post-transfer). Goodput
+        # accounting keys off this to avoid double-booking wall time.
+        self.h2d_blocks_consumer = self.depth <= 0
         if self.depth > 0:
             self._queue = queue.Queue(maxsize=self.depth)
             self._thread = threading.Thread(
@@ -240,6 +259,12 @@ class DevicePrefetcher:
             raise StopIteration("stream exhausted")
         if item["snapshot"] is not None:
             self._consumed_state = item["snapshot"]
+        if self._m_batches is not None:
+            self._m_batches.inc()
+            self._m_data_wait.observe(data_wait)
+            self._m_h2d.observe(item["h2d_s"])
+            if self._queue is not None:
+                self._m_queue.set(self._queue.qsize())
         return item["batch"], item["tokens"], {
             "data_wait_s": data_wait, "h2d_wait_s": item["h2d_s"]}
 

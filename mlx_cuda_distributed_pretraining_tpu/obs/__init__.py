@@ -3,6 +3,16 @@ from .plotting import ema, parse_log, plot_run, write_csv
 from .monitor import LogTailer, find_latest_run, monitor
 from .stats_client import StatsClient
 from .stats_server import StatsServer, StatsState
+from .metrics import MetricsRegistry
+from .flops import (
+    GoodputLedger,
+    flops_per_token,
+    mfu,
+    model_flops_per_token,
+    peak_flops_per_chip,
+)
+from .events import EventLog, append_event, iter_events, replay_into
+from .prometheus import render_prometheus, start_metrics_server
 
 __all__ = [
     "Logger",
@@ -16,4 +26,16 @@ __all__ = [
     "StatsClient",
     "StatsServer",
     "StatsState",
+    "MetricsRegistry",
+    "GoodputLedger",
+    "flops_per_token",
+    "model_flops_per_token",
+    "peak_flops_per_chip",
+    "mfu",
+    "EventLog",
+    "append_event",
+    "iter_events",
+    "replay_into",
+    "render_prometheus",
+    "start_metrics_server",
 ]

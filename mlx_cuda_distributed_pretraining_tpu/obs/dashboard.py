@@ -86,6 +86,7 @@ DASHBOARD_HTML = """<!DOCTYPE html>
     <div class="tile"><div class="v" id="t-step">–</div><div class="l">max step</div></div>
     <div class="tile"><div class="v" id="t-loss">–</div><div class="l">mean loss</div></div>
     <div class="tile"><div class="v" id="t-toks">–</div><div class="l">total tok/s</div></div>
+    <div class="tile"><div class="v" id="t-mfu">–</div><div class="l">MFU</div></div>
     <div class="tile"><div class="v" id="t-workers">–</div><div class="l">workers alive</div></div>
   </div>
   <div class="panel">
@@ -98,9 +99,14 @@ DASHBOARD_HTML = """<!DOCTYPE html>
     <canvas id="tput"></canvas>
   </div>
   <div class="panel">
+    <h2>Goodput (last window, mean across workers)</h2>
+    <canvas id="goodput" style="height: 46px"></canvas>
+    <div class="legend" id="goodput-legend"></div>
+  </div>
+  <div class="panel">
     <h2>Workers</h2>
     <table id="workers"><thead><tr>
-      <th></th><th>worker</th><th>step</th><th>loss</th><th>tok/s</th><th>last seen</th>
+      <th></th><th>worker</th><th>step</th><th>loss</th><th>tok/s</th><th>mfu</th><th>last seen</th>
     </tr></thead><tbody></tbody></table>
   </div>
 </div>
@@ -244,12 +250,52 @@ attachHover(tputCv, (mx, my) => {
          Math.round(best.t) + "s";
 });
 
+// ---- goodput breakdown: stacked bar of the latest window's components -----
+const GP_KEYS = ["dispatch_s", "compile_s", "data_wait_s", "h2d_wait_s",
+                 "ckpt_save_s", "eval_s", "other_s"];
+const gpCv = document.getElementById("goodput");
+function drawGoodput(workers) {
+  const [g, W, H] = sizeCanvas(gpCv);
+  g.clearRect(0, 0, W, H);
+  const legend = document.getElementById("goodput-legend");
+  const sums = {}, counts = {};
+  for (const w of Object.values(workers)) {
+    const m = w.metrics || {};
+    for (const k of GP_KEYS) {
+      if (typeof m[k] === "number") {
+        sums[k] = (sums[k] || 0) + m[k];
+        counts[k] = (counts[k] || 0) + 1;
+      }
+    }
+  }
+  const means = GP_KEYS.map(k => counts[k] ? sums[k] / counts[k] : 0);
+  const total = means.reduce((a, b) => a + b, 0);
+  if (!total) { legend.textContent = "(no goodput data yet)"; return; }
+  legend.innerHTML = "";
+  let x = 0;
+  GP_KEYS.forEach((k, i) => {
+    const frac = means[i] / total;
+    if (frac <= 0) return;
+    const color = css(SERIES[i % SERIES.length]);
+    g.fillStyle = color;
+    g.fillRect(x, 8, W * frac, H - 16);
+    x += W * frac;
+    const span = document.createElement("span");
+    span.className = "key";
+    span.innerHTML = '<span class="sw" style="background:' + color + '"></span>' +
+      k.replace(/_s$/, "") + " " + (100 * frac).toFixed(1) + "%";
+    legend.appendChild(span);
+  });
+}
+
 // ---- worker table + tiles -------------------------------------------------
 function renderWorkers(workers, agg) {
   document.getElementById("t-step").textContent = fmt(agg.max_step, 0);
   document.getElementById("t-loss").textContent = fmt(agg.mean_loss, 4);
   document.getElementById("t-toks").textContent =
     agg.total_tok_s ? Math.round(agg.total_tok_s).toLocaleString() : "–";
+  document.getElementById("t-mfu").textContent =
+    (typeof agg.mean_mfu === "number") ? (100 * agg.mean_mfu).toFixed(1) + "%" : "–";
   document.getElementById("t-workers").textContent =
     fmt(agg.alive_workers, 0) + "/" + fmt(agg.num_workers, 0);
   const tb = document.querySelector("#workers tbody");
@@ -265,10 +311,12 @@ function renderWorkers(workers, agg) {
       "<td>" + wid + "</td><td>" + fmt(w.step, 0) + "</td>" +
       "<td>" + fmt(m.loss, 4) + "</td>" +
       "<td>" + (m["tok/s"] ? Math.round(m["tok/s"]).toLocaleString() : "–") + "</td>" +
+      "<td>" + (typeof m.mfu === "number" ? (100 * m.mfu).toFixed(1) + "%" : "–") + "</td>" +
       '<td style="color:var(' + (alive ? "--status-good" : "--status-critical") +
       ')">' + (alive ? "\\u25cf " + Math.round(ago) + "s ago" : "\\u25cb stale") + "</td>";
     tb.appendChild(tr);
   }
+  drawGoodput(workers);
 }
 
 // ---- WS wiring ------------------------------------------------------------

@@ -1,0 +1,194 @@
+"""Structured run event log: schema-versioned, append-only ``events.jsonl``.
+
+One file per run dir, one JSON object per line::
+
+    {"v": 1, "type": "step_window", "t": 1722890000.1, ...payload}
+
+Event types written by the trainer / supervisor:
+
+  run_start        fresh run began (config name, total_steps, n_params)
+  resume           run resumed from a checkpoint (tag, step)
+  compile          first dispatch finished compiling (seconds)
+  step_window      one logging window (step, steps, toks, loss, tok_s,
+                   mfu, goodput breakdown)
+  checkpoint_save  a checkpoint landed (step, seconds, blocking)
+  verify           checkpoint verification outcome (tag, ok, reason)
+  eval             validation ran (step, loss, seconds)
+  profiler         trace started/stopped (action, step)
+  fault            something went wrong (kind: hang/crash/..., detail)
+  restart          supervisor relaunched the child (lost_s booked into
+                   the goodput ledger as restart_lost_s)
+  postmortem       supervisor's view of a dead child (rc, crashes)
+  run_end          training finished (final_loss, steps)
+
+The log is the DURABLE source: on resume the in-process metrics registry
+is rebuilt by replaying it (:func:`replay_into`), so Prometheus counters
+survive process death without any side database. Appends are a single
+``write()`` of one line + flush; readers tolerate a torn final line
+(crash mid-append) by skipping lines that fail to parse.
+
+The heartbeat file lives here too: a tiny atomically-replaced JSON the
+trainer touches every step window and the supervisor's hang watchdog
+polls (train/supervisor.py) — same durability ethos, different cadence.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, Iterator, Optional
+
+SCHEMA_VERSION = 1
+EVENTS_FILENAME = "events.jsonl"
+HEARTBEAT_FILENAME = "heartbeat.json"
+
+
+def events_path(run_dir: str) -> str:
+    return os.path.join(run_dir, EVENTS_FILENAME)
+
+
+def heartbeat_path(run_dir: str) -> str:
+    return os.path.join(run_dir, HEARTBEAT_FILENAME)
+
+
+class EventLog:
+    """Append-only writer. Keeps the fd open; one flushed write per event
+    so a crash loses at most the in-flight line (which readers skip)."""
+
+    def __init__(self, path: str, now: Callable[[], float] = time.time):
+        self.path = path
+        self._now = now
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a", encoding="utf-8")
+
+    def append(self, type: str, **fields: Any) -> Dict[str, Any]:
+        ev = {"v": SCHEMA_VERSION, "type": str(type),
+              "t": float(self._now()), **fields}
+        self._f.write(json.dumps(ev, separators=(",", ":")) + "\n")
+        self._f.flush()
+        return ev
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except Exception:
+            pass
+
+
+def append_event(path: str, type: str, **fields: Any) -> None:
+    """One-shot append for writers without a long-lived EventLog (the
+    supervisor). Open-append-close keeps it safe across the child's own
+    EventLog appends: O_APPEND line writes don't interleave at this size."""
+    ev = {"v": SCHEMA_VERSION, "type": str(type), "t": time.time(), **fields}
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(json.dumps(ev, separators=(",", ":")) + "\n")
+
+
+def iter_events(path: str) -> Iterator[Dict[str, Any]]:
+    """Yield parsed events; torn/garbage lines are skipped, unknown future
+    schema versions are yielded as-is (readers filter on what they know)."""
+    if not os.path.isfile(path):
+        return
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn final line from a crash mid-append
+            if isinstance(ev, dict) and "type" in ev:
+                yield ev
+
+
+def replay_into(registry, path: str) -> int:
+    """Rebuild the durable counters of a metrics registry from the event
+    log; returns the number of events replayed.
+
+    Only monotonic run-lifetime counters are rebuilt (steps, tokens,
+    checkpoint saves, goodput seconds, faults, restarts) — gauges like
+    loss/MFU are live-window quantities the next step window overwrites.
+    """
+    steps = registry.counter("train_steps_total",
+                             "optimizer steps completed over the run lifetime")
+    toks = registry.counter("train_tokens_total",
+                            "non-pad target tokens trained on")
+    saves = registry.counter("checkpoint_saves_total", "checkpoints written")
+    evals = registry.counter("eval_runs_total", "validation passes")
+    faults = registry.counter("faults_total", "faults by kind")
+    restarts = registry.counter("restarts_total", "supervisor child relaunches")
+    goodput = registry.counter("goodput_seconds_total",
+                               "wall-clock seconds by goodput component")
+    n = 0
+    for ev in iter_events(path):
+        n += 1
+        et = ev.get("type")
+        if et == "step_window":
+            steps.inc(float(ev.get("steps", 0) or 0))
+            toks.inc(float(ev.get("toks", 0) or 0))
+            for comp, secs in (ev.get("goodput") or {}).items():
+                if isinstance(secs, (int, float)) and secs > 0:
+                    goodput.inc(float(secs), component=comp)
+        elif et == "checkpoint_save":
+            saves.inc()
+        elif et == "eval":
+            evals.inc()
+        elif et == "fault":
+            faults.inc(kind=str(ev.get("kind", "unknown")))
+        elif et == "restart":
+            restarts.inc()
+            lost = ev.get("lost_s")
+            if isinstance(lost, (int, float)) and lost > 0:
+                goodput.inc(float(lost), component="restart_lost_s")
+    return n
+
+
+def tally(path: str) -> Dict[str, float]:
+    """Grand totals straight from the log (no registry) — what tests and
+    postmortems compare Prometheus counters against."""
+    out = {"steps": 0.0, "toks": 0.0, "checkpoint_saves": 0.0,
+           "evals": 0.0, "faults": 0.0, "restarts": 0.0, "events": 0.0}
+    for ev in iter_events(path):
+        out["events"] += 1
+        et = ev.get("type")
+        if et == "step_window":
+            out["steps"] += float(ev.get("steps", 0) or 0)
+            out["toks"] += float(ev.get("toks", 0) or 0)
+        elif et == "checkpoint_save":
+            out["checkpoint_saves"] += 1
+        elif et == "eval":
+            out["evals"] += 1
+        elif et == "fault":
+            out["faults"] += 1
+        elif et == "restart":
+            out["restarts"] += 1
+    return out
+
+
+# -- heartbeat ------------------------------------------------------------
+
+
+def write_heartbeat(path: str, step: int, pid: Optional[int] = None) -> None:
+    """Atomically replace the heartbeat file: {t, step, pid}. The watchdog
+    must never read a torn heartbeat, hence temp + os.replace (same
+    pattern as checkpoint/manager._atomic_json)."""
+    tmp = path + ".tmp"
+    payload = {"t": time.time(), "step": int(step),
+               "pid": int(pid if pid is not None else os.getpid())}
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_heartbeat(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            hb = json.load(f)
+        return hb if isinstance(hb, dict) and "t" in hb else None
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None

@@ -1,0 +1,142 @@
+"""Model FLOPs accounting: FLOPs/token, chip peak detection, MFU, goodput.
+
+MFU follows the PaLM appendix-B convention: the model needs
+``6*N`` FLOPs per token for the matmuls (fwd + bwd) plus the attention
+term ``6 * num_layers * seq * d_attn`` for the [S, S] score/value
+matmuls, and utilization is that analytic cost divided by what the chips
+could theoretically sustain:
+
+    MFU = flops_per_token * tokens_per_second / (peak_flops_per_chip * n_chips)
+
+Peak FLOPs are detected from ``jax.devices()[0].device_kind`` for known
+TPU/GPU generations (bf16 dense peak, matching how the matmuls actually
+run) and can be forced with ``GRAFT_PEAK_FLOPS`` for unlisted hardware.
+On CPU or unknown chips detection returns None and callers report
+``mfu=unknown`` — same convention as bench.py's vocab-less rows.
+
+The goodput ledger answers "where did the wall clock go": every logging
+window books seconds into named components (compile, data wait, H2D
+wait, dispatch, checkpoint save, eval, restart-lost time fed in by the
+supervisor) and the residual ``other_s`` absorbs whatever was not
+attributed, so the components ALWAYS sum to window wall time.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+# bf16 dense peak FLOPs per chip, keyed by device_kind substring
+# (checked in order — first match wins, so more specific kinds first).
+_PEAK_BY_KIND = (
+    ("v6e", 918e12), ("v6 lite", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12), ("v5 lite", 197e12), ("v5lite", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+    ("h100", 989e12),
+    ("a100", 312e12),
+    ("v100", 125e12),
+)
+
+PEAK_FLOPS_ENV = "GRAFT_PEAK_FLOPS"
+
+
+def flops_per_token(n_params: int, num_layers: int, seq_len: int,
+                    d_attn: int) -> float:
+    """Analytic train-step FLOPs per token: 6N matmul + attention term.
+
+    ``d_attn`` is the total attention width ``num_heads * head_dim``.
+    Identical to the bench.py accounting so BENCH rows and log-line MFU
+    agree by construction.
+    """
+    return 6.0 * float(n_params) + 6.0 * float(num_layers) * float(seq_len) * float(d_attn)
+
+
+def model_flops_per_token(model_cfg: Any, n_params: int, seq_len: int) -> float:
+    """FLOPs/token from a ModelConfig (config.py) plus the exact param
+    count (llama.num_params — analytic dim products would drift from
+    tied-embedding / MoE variants)."""
+    d_attn = int(model_cfg.num_heads) * int(model_cfg.head_dim)
+    return flops_per_token(n_params, int(model_cfg.num_layers), int(seq_len), d_attn)
+
+
+def peak_flops_per_chip(device_kind: Optional[str] = None) -> Optional[float]:
+    """bf16 peak FLOPs for one chip, or None when undetectable.
+
+    ``GRAFT_PEAK_FLOPS`` (float, FLOPs) overrides detection — the escape
+    hatch for hardware missing from the table.
+    """
+    env = os.environ.get(PEAK_FLOPS_ENV)
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    if device_kind is None:
+        try:
+            import jax
+
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            return None
+    kind = str(device_kind).lower()
+    for needle, peak in _PEAK_BY_KIND:
+        if needle in kind:
+            return peak
+    return None
+
+
+def mfu(tok_s: float, flops_per_tok: float,
+        peak_per_chip: Optional[float], n_chips: int) -> Optional[float]:
+    """Model FLOPs utilization in [0, 1]-ish, or None when peak unknown."""
+    if peak_per_chip is None or peak_per_chip <= 0 or n_chips <= 0:
+        return None
+    return float(flops_per_tok) * float(tok_s) / (peak_per_chip * n_chips)
+
+
+# Goodput components in reporting order. ``other_s`` is the residual and
+# is appended by close_window — never booked directly.
+GOODPUT_COMPONENTS = (
+    "compile_s", "data_wait_s", "h2d_wait_s", "dispatch_s",
+    "ckpt_save_s", "eval_s", "restart_lost_s",
+)
+
+
+class GoodputLedger:
+    """Window + cumulative attribution of wall-clock seconds.
+
+    ``add(component, seconds)`` books time into the current window;
+    ``close_window(elapsed_s)`` returns the window breakdown with the
+    residual ``other_s = max(0, elapsed - sum(booked))`` appended, folds
+    it into the cumulative totals, and resets the window. Components
+    therefore sum to window wall time by construction (up to clamping
+    when booked time exceeds elapsed — overlapping attributions).
+    """
+
+    def __init__(self):
+        self._window: Dict[str, float] = {c: 0.0 for c in GOODPUT_COMPONENTS}
+        self._total: Dict[str, float] = {c: 0.0 for c in GOODPUT_COMPONENTS}
+        self._total["other_s"] = 0.0
+
+    def add(self, component: str, seconds: float) -> None:
+        if component not in self._window:
+            raise KeyError(f"unknown goodput component: {component!r} "
+                           f"(one of {GOODPUT_COMPONENTS})")
+        self._window[component] += max(0.0, float(seconds))
+
+    def window_view(self) -> Dict[str, float]:
+        return dict(self._window)
+
+    def close_window(self, elapsed_s: float) -> Dict[str, float]:
+        booked = sum(self._window.values())
+        out = {c: v for c, v in self._window.items()}
+        out["other_s"] = max(0.0, float(elapsed_s) - booked)
+        for c, v in out.items():
+            self._total[c] += v
+        self._window = {c: 0.0 for c in GOODPUT_COMPONENTS}
+        return out
+
+    def totals(self) -> Dict[str, float]:
+        return dict(self._total)

@@ -14,7 +14,7 @@ import os
 import time
 from typing import Callable, Dict, List, Optional
 
-from .plotting import STEP_RE, VAL_RE, KV_RE, plot_run
+from .plotting import STEP_RE, VAL_RE, KV_RE, parse_value, plot_run
 
 
 def find_latest_run(runs_root: str = "runs") -> Optional[str]:
@@ -65,7 +65,12 @@ class LogTailer:
                 if m:
                     self.steps.append(int(m.group(1)))
                     kvs = dict(KV_RE.findall(m.group(2)))
-                    self.latest = {k: float(v) for k, v in kvs.items()}
+                    # parse_value maps the literal ``unknown`` (mfu on
+                    # hosts with no detectable chip peak) to None; drop
+                    # those so ``latest`` stays all-float.
+                    self.latest = {
+                        k: pv for k, v in kvs.items()
+                        if (pv := parse_value(v)) is not None}
                     self.latest["step"] = self.steps[-1]
                     n += 1
                 elif line:
@@ -76,9 +81,10 @@ class LogTailer:
         if not self.latest:
             return "(no metric lines yet)"
         parts = [f"step {int(self.latest['step'])}"]
-        for k in ("loss", "ppl", "lr", "tok/s"):
+        for k in ("loss", "ppl", "lr", "tok/s", "mfu"):
             if k in self.latest:
-                fmt = ".3e" if k == "lr" else ".4f" if k != "tok/s" else ".0f"
+                fmt = (".3e" if k == "lr" else ".0f" if k == "tok/s"
+                       else ".3f" if k == "mfu" else ".4f")
                 parts.append(f"{k}={self.latest[k]:{fmt}}")
         if self.val_losses:
             parts.append(f"val_loss={self.val_losses[-1]:.4f}@{self.val_steps[-1]}")

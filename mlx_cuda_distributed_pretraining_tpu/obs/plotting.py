@@ -17,7 +17,14 @@ from typing import Dict, List, Optional, Tuple
 
 STEP_RE = re.compile(r"^Step (\d+): (.+)$")
 VAL_RE = re.compile(r"^Step (\d+) validation: val_loss=([0-9.eE+-]+)")
-KV_RE = re.compile(r"([\w/]+)=([0-9.eE+-]+|nan|inf)")
+# Values are numeric, nan/inf, or the literal ``unknown`` (emitted for
+# ``mfu`` when the chip peak FLOPs are undetectable, e.g. CPU smoke runs).
+KV_RE = re.compile(r"([\w/]+)=([0-9.eE+-]+|nan|inf|unknown)")
+
+
+def parse_value(v: str) -> Optional[float]:
+    """A KV_RE value as a float, or None for the non-numeric ``unknown``."""
+    return None if v == "unknown" else float(v)
 
 
 def parse_log(path: str) -> Tuple[List[int], Dict[str, List[Optional[float]]]]:
@@ -44,7 +51,7 @@ def parse_log(path: str) -> Tuple[List[int], Dict[str, List[Optional[float]]]]:
             steps.append(step)
             for k in set(metrics) | set(kvs):
                 metrics.setdefault(k, [None] * (len(steps) - 1))
-                metrics[k].append(float(kvs[k]) if k in kvs else None)
+                metrics[k].append(parse_value(kvs[k]) if k in kvs else None)
     if val_points:
         by_step = dict(val_points)
         metrics["val_loss"] = [by_step.get(s) for s in steps]

@@ -70,7 +70,15 @@ class StatsClient:
         try:
             import websockets  # deferred: optional dependency
         except ImportError:
-            return
+            # No transport available: keep draining the outbox into the
+            # bounded ring so callers' messages are retained (and memory
+            # stays capped) exactly as in the server-down case.
+            while True:
+                item = await asyncio.get_running_loop().run_in_executor(
+                    None, self._outbox.get)
+                if item is None:
+                    return
+                self._buffer.append(item)
         while not self._stop.is_set():
             try:
                 async with websockets.connect(self.url, open_timeout=5) as ws:

@@ -20,20 +20,39 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import time
 from collections import deque
 from typing import Any, Dict, Optional, Set
 
 HISTORY_LIMIT = 1000  # reference: stats_server.py:274-280 ring size
+# Drop a worker entirely once it has been silent this long (vs the 60s
+# "alive" window used for alive_workers): long multi-restart runs rotate
+# worker ids, and without eviction num_workers grows forever.
+WORKER_TTL_S = 600.0
 
 
 class StatsState:
     """Pure state container so aggregation logic is testable without IO."""
 
-    def __init__(self, history_limit: int = HISTORY_LIMIT):
+    def __init__(self, history_limit: int = HISTORY_LIMIT,
+                 worker_ttl_s: float = WORKER_TTL_S):
         self.started = time.time()
         self.workers: Dict[str, Dict[str, Any]] = {}
         self.history: deque = deque(maxlen=history_limit)
+        self.worker_ttl_s = float(worker_ttl_s)
+
+    def evict_stale(self, now: Optional[float] = None) -> int:
+        """Forget workers silent past the TTL; returns how many were
+        evicted. TTL <= 0 disables eviction."""
+        if self.worker_ttl_s <= 0:
+            return 0
+        now = time.time() if now is None else now
+        stale = [wid for wid, w in self.workers.items()
+                 if now - w.get("last_seen", 0) > self.worker_ttl_s]
+        for wid in stale:
+            del self.workers[wid]
+        return len(stale)
 
     def handle(self, msg: Dict[str, Any]) -> bool:
         """Apply one worker message; returns True when state changed in a
@@ -80,7 +99,9 @@ class StatsState:
         alive = 0
         queue_depth, occupancy, serve_workers = 0, 0, 0
         data_waits = []
+        mfus = []
         now = time.time()
+        self.evict_stale(now)
         for w in self.workers.values():
             m = w.get("metrics", {})
             if now - w.get("last_seen", 0) < 60:
@@ -97,6 +118,8 @@ class StatsState:
                 queue_depth += int(m.get("queue_depth", 0) or 0)
             if isinstance(m.get("data_wait_frac"), (int, float)):
                 data_waits.append(float(m["data_wait_frac"]))
+            if isinstance(m.get("mfu"), (int, float)):
+                mfus.append(float(m["mfu"]))
         agg = {
             "num_workers": len(self.workers),
             "alive_workers": alive,
@@ -112,6 +135,10 @@ class StatsState:
             # Input-pipeline health across trainers: fraction of wall clock
             # the step loop spent waiting for data (device_prefetch.py).
             agg["mean_data_wait_frac"] = sum(data_waits) / len(data_waits)
+        if mfus:
+            # Hardware efficiency across trainers (obs/flops.py); workers on
+            # undetectable chips report mfu=unknown and are excluded.
+            agg["mean_mfu"] = sum(mfus) / len(mfus)
         return agg
 
     def snapshot(self) -> Dict[str, Any]:
@@ -130,10 +157,11 @@ class StatsState:
 
 class StatsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 8765,
-                 persist_path: Optional[str] = None, persist_interval: float = 30.0):
+                 persist_path: Optional[str] = None, persist_interval: float = 30.0,
+                 worker_ttl_s: float = WORKER_TTL_S):
         self.host = host
         self.port = port
-        self.state = StatsState()
+        self.state = StatsState(worker_ttl_s=worker_ttl_s)
         self.persist_path = persist_path
         self.persist_interval = persist_interval
         self._clients: Set[Any] = set()
@@ -179,12 +207,16 @@ class StatsServer:
                 self.persist()
 
     def persist(self) -> None:
+        # Temp + rename: a crash mid-dump must never truncate the previous
+        # good snapshot (same atomic-write ethos as checkpoint manifests).
         if not self.persist_path:
             return
-        with open(self.persist_path, "w") as f:
+        tmp = self.persist_path + ".tmp"
+        with open(tmp, "w") as f:
             json.dump({"workers": self.state.workers,
                        "aggregated": self.state.aggregated(),
                        "history": list(self.state.history)}, f, indent=2)
+        os.replace(tmp, self.persist_path)
 
     async def serve(self) -> None:
         import websockets  # deferred: optional dependency
@@ -208,8 +240,11 @@ def main(argv=None):
     parser.add_argument("--persist", default=None, help="JSON persistence path")
     parser.add_argument("--http-port", type=int, default=0,
                         help="also serve the live dashboard page on this port")
+    parser.add_argument("--worker-ttl", type=float, default=WORKER_TTL_S,
+                        help="forget workers silent this many seconds "
+                             "(0 disables eviction)")
     a = parser.parse_args(argv)
-    server = StatsServer(a.host, a.port, a.persist)
+    server = StatsServer(a.host, a.port, a.persist, worker_ttl_s=a.worker_ttl)
     httpd = None
     if a.http_port:
         from .dashboard import serve_dashboard

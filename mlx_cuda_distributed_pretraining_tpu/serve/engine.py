@@ -54,6 +54,7 @@ class EngineConfig:
     stats_url: Optional[str] = None  # ws://host:port of obs stats server
     stats_interval_s: float = 1.0
     worker_id: str = "serve-engine"
+    metrics_port: int = 0       # Prometheus exposition (obs/prometheus.py); 0 off
 
     @classmethod
     def from_yaml(cls, path: str) -> "EngineConfig":
@@ -92,6 +93,23 @@ class BatchEngine:
         self._last_publish = 0.0
         self._last_ttft_ms: Optional[float] = None
         self._metrics: Dict[str, Any] = {}
+        # Shared metrics substrate (obs/metrics.py): same registry shape as
+        # the trainer, so one Prometheus scrape config covers both roles.
+        from ..obs.metrics import MetricsRegistry
+
+        self.metrics_registry = MetricsRegistry()
+        reg = self.metrics_registry
+        self._mg_occupancy = reg.gauge(
+            "serve_batch_occupancy", "occupied decode slots")
+        self._mg_queue = reg.gauge("serve_queue_depth", "admission queue depth")
+        self._mg_tok_s = reg.gauge("serve_tok_s", "decode tokens/second (window)")
+        self._mc_requests = reg.counter(
+            "serve_requests_total", "requests by outcome")
+        self._mc_iterations = reg.counter(
+            "serve_iterations_total", "engine loop iterations")
+        self._m_last = {"admitted": 0, "rejected": 0, "evicted": 0,
+                        "completed": 0, "iterations": 0}
+        self._metrics_server = None
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "BatchEngine":
@@ -104,6 +122,11 @@ class BatchEngine:
                 self._stats.register({"role": "serve",
                                       "num_slots": self.cfg.num_slots,
                                       "max_len": self.cfg.max_len})
+            if self.cfg.metrics_port and self._metrics_server is None:
+                from ..obs.prometheus import start_metrics_server
+
+                self._metrics_server = start_metrics_server(
+                    self.metrics_registry, self.cfg.metrics_port)
             self._stop.clear()
             self._thread = threading.Thread(target=self._loop, daemon=True,
                                             name="batch-engine")
@@ -120,6 +143,9 @@ class BatchEngine:
         if self._stats is not None:
             self._stats.close()
             self._stats = None
+        if self._metrics_server is not None:
+            self._metrics_server.shutdown()
+            self._metrics_server = None
 
     def warmup(self, prompt_ids: Optional[List[int]] = None) -> None:
         """Pay the prefill/decode jit compiles before traffic arrives."""
@@ -204,6 +230,25 @@ class BatchEngine:
         self._metrics = {"tok/s": round(tok_s, 2)}
         if self._last_ttft_ms is not None:
             self._metrics["ttft_ms"] = round(self._last_ttft_ms, 1)
+        # Registry mirror: gauges live, scheduler totals as counter deltas
+        # (the scheduler keeps monotonic ints; Prometheus counters must
+        # only ever be incremented).
+        self._mg_occupancy.set(self.pool.num_used)
+        self._mg_queue.set(self.scheduler.queue_depth())
+        self._mg_tok_s.set(tok_s)
+        cur = {"admitted": self.scheduler.admitted,
+               "rejected": self.scheduler.rejected,
+               "evicted": self.scheduler.evicted,
+               "completed": self.scheduler.completed,
+               "iterations": self.iterations}
+        for k in ("admitted", "rejected", "evicted", "completed"):
+            d = cur[k] - self._m_last[k]
+            if d > 0:
+                self._mc_requests.inc(d, outcome=k)
+        d = cur["iterations"] - self._m_last["iterations"]
+        if d > 0:
+            self._mc_iterations.inc(d)
+        self._m_last = cur
         if self._stats is not None:
             # "tok/s" is the key the stats server's aggregate sums, so a
             # serving fleet's total decode throughput lands on the

@@ -19,6 +19,19 @@ counter and restarts indefinitely.
 SIGTERM/SIGINT to the supervisor forward to the child (which saves a
 preemption checkpoint and exits cleanly — train loop signal handling);
 the supervisor then exits without restarting.
+
+Hang watchdog (``supervisor.hang_timeout_s``): the trainer writes
+``<run_dir>/heartbeat.json`` every step window (obs/events.py); if the
+heartbeat goes stale past the timeout while the child is still alive,
+the child is hung — stuck collective, deadlocked host thread, wedged
+data source — and no exit code will ever arrive. The watchdog SIGTERMs
+it (escalating to SIGKILL after ``hang_kill_grace_s``), records a
+``fault``/``restart`` event pair in ``events.jsonl`` with the lost wall
+clock (booked into the goodput ledger as ``restart_lost_s`` on replay),
+and the normal restart loop resumes from the newest verified
+checkpoint. A hang is treated as a crash even when the SIGTERM lets the
+child save-and-exit-0: returning "completed cleanly" for a run that
+stalled mid-training would end supervision with the job unfinished.
 """
 
 from __future__ import annotations
@@ -27,10 +40,12 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
 from ..checkpoint.manager import CheckpointManager
+from ..obs.events import append_event, events_path, heartbeat_path, read_heartbeat
 
 
 class CrashLoopError(RuntimeError):
@@ -56,6 +71,8 @@ class Supervisor:
         on_spawn: Optional[Callable[[subprocess.Popen], None]] = None,
         log: Callable[[str], None] = lambda m: print(m, file=sys.stderr),
         env: Optional[Dict[str, str]] = None,
+        hang_timeout_s: float = 0.0,
+        hang_kill_grace_s: float = 20.0,
     ):
         self.build_cmd = build_cmd
         self.run_dir = run_dir
@@ -65,9 +82,65 @@ class Supervisor:
         self.on_spawn = on_spawn
         self.log = log
         self.env = env
+        self.hang_timeout_s = float(hang_timeout_s or 0.0)
+        self.hang_kill_grace_s = float(hang_kill_grace_s)
+        self.heartbeat_file = heartbeat_path(run_dir)
+        self.events_file = events_path(run_dir)
         self.restarts = 0
+        self.hangs = 0
         self._child: Optional[subprocess.Popen] = None
         self._shutdown_signal: Optional[int] = None
+        self._hang_fired = False
+        # Wall clock of the last known step progress of a dead child —
+        # the anchor for the restart-lost goodput booked at relaunch.
+        self._restart_anchor: Optional[float] = None
+
+    def _append_event(self, type: str, **fields) -> None:
+        """Event-log appends must never take the supervisor down."""
+        try:
+            append_event(self.events_file, type, **fields)
+        except OSError as e:
+            self.log(f"supervisor: could not append {type} event ({e})")
+
+    def _last_progress(self, floor: float) -> float:
+        """Wall clock of the child's newest heartbeat, floored at ``floor``
+        (the child's spawn time — a stale heartbeat left by a PREVIOUS
+        child must not count against a freshly launched one)."""
+        hb = read_heartbeat(self.heartbeat_file)
+        if hb and isinstance(hb.get("t"), (int, float)):
+            return max(float(floor), float(hb["t"]))
+        return float(floor)
+
+    def _watch_child(self, child: subprocess.Popen, spawned_at: float,
+                     stop_evt: threading.Event) -> None:
+        """Poll the heartbeat; SIGTERM-then-SIGKILL the child once it has
+        made no step progress for ``hang_timeout_s``."""
+        poll = max(0.2, min(self.hang_timeout_s / 4.0, 10.0))
+        while not stop_evt.wait(poll):
+            if child.poll() is not None:
+                return
+            stalled = time.time() - self._last_progress(spawned_at)
+            if stalled <= self.hang_timeout_s:
+                continue
+            self._hang_fired = True
+            self.hangs += 1
+            hb = read_heartbeat(self.heartbeat_file)
+            self.log(f"supervisor: watchdog — no step progress for "
+                     f"{stalled:.1f}s (hang_timeout_s={self.hang_timeout_s:g}); "
+                     f"terminating hung child pid {child.pid}")
+            self._append_event(
+                "fault", kind="hang", stalled_s=round(stalled, 3),
+                step=(hb or {}).get("step"), pid=child.pid)
+            try:
+                child.terminate()
+                try:
+                    child.wait(timeout=self.hang_kill_grace_s)
+                except subprocess.TimeoutExpired:
+                    self.log("supervisor: hung child ignored SIGTERM; killing")
+                    child.kill()
+            except OSError:
+                pass
+            return
 
     def latest_resumable(self) -> Optional[str]:
         """Newest verified step tag, or None. Runs the same quarantining
@@ -120,25 +193,58 @@ class Supervisor:
                 cmd = self.build_cmd(tag)
                 self.log(f"supervisor: launching child "
                          f"(resume={tag if tag is not None else 'fresh'})")
+                if self._restart_anchor is not None:
+                    # Restart-lost wall clock: everything between the dead
+                    # child's last step progress and this relaunch. Replay
+                    # books it into goodput as restart_lost_s.
+                    lost = max(0.0, time.time() - self._restart_anchor)
+                    self._append_event(
+                        "restart", lost_s=round(lost, 3),
+                        resume=tag, restarts=self.restarts)
+                    self._restart_anchor = None
+                self._hang_fired = False
                 self._child = subprocess.Popen(cmd, env=self.env)
+                spawned_at = time.time()
                 if self.on_spawn is not None:
                     self.on_spawn(self._child)
+                watchdog = None
+                stop_evt = threading.Event()
+                if self.hang_timeout_s > 0:
+                    watchdog = threading.Thread(
+                        target=self._watch_child,
+                        args=(self._child, spawned_at, stop_evt),
+                        name="hang-watchdog", daemon=True)
+                    watchdog.start()
                 rc = self._child.wait()
-                if rc == 0:
+                stop_evt.set()
+                if watchdog is not None:
+                    # Settle _hang_fired: wait() may return while the
+                    # watchdog is mid-termination.
+                    watchdog.join(timeout=self.hang_kill_grace_s + 10.0)
+                hang = self._hang_fired
+                if rc == 0 and not hang:
                     self.log("supervisor: child completed cleanly")
                     return 0
-                if self._shutdown_signal is not None:
+                if self._shutdown_signal is not None and not hang:
                     # Forwarded preemption: the child saved and exited; a
                     # restart would defeat the point of the signal.
                     self.log(f"supervisor: shutdown signal "
                              f"{self._shutdown_signal} forwarded; not restarting")
                     return rc
+                # Crash path (a watchdog hang counts as a crash even on
+                # rc==0 — the SIGTERM let the child save-and-exit cleanly,
+                # but the run is NOT done). Anchor the lost-time clock at
+                # the child's last step progress before backoff eats more.
+                self._restart_anchor = self._last_progress(spawned_at)
                 new_tag = self.latest_resumable()
                 if new_tag is not None and new_tag != tag_after_last_crash:
                     crashes = 1  # progress since the last crash — reset
                 else:
                     crashes += 1
                 tag_after_last_crash = new_tag
+                self._append_event(
+                    "postmortem", rc=rc, hang=hang, crashes=crashes,
+                    checkpoint=new_tag)
                 if crashes >= self.max_crashes_per_step:
                     raise CrashLoopError(
                         f"giving up after {crashes} consecutive crashes with "
@@ -148,7 +254,8 @@ class Supervisor:
                 delay = min(self.backoff_base * (2 ** (crashes - 1)),
                             self.backoff_max)
                 self.restarts += 1
-                self.log(f"supervisor: child exited rc={rc} "
+                self.log(f"supervisor: child exited rc={rc}"
+                         f"{' [hang]' if hang else ''} "
                          f"(crash {crashes}/{self.max_crashes_per_step} at "
                          f"checkpoint {new_tag}); restarting in {delay:.1f}s")
                 time.sleep(delay)
@@ -227,16 +334,25 @@ def supervise_from_args(args) -> Dict[str, Any]:
     merged = apply_overrides(raw, collect_overrides(args))
     run_dir = os.path.join(args.runs_root, merged["name"])
 
+    # Watchdog knobs: config section first, CLI flag wins when given.
+    sup_cfg = merged.get("supervisor") or {}
+    hang_timeout = float(sup_cfg.get("hang_timeout_s") or 0.0)
+    cli_timeout = getattr(args, "hang_timeout_s", None)
+    if cli_timeout is not None:
+        hang_timeout = float(cli_timeout)
+
     sup = Supervisor(
         _trainer_cmd_builder(args, run_dir),
         run_dir,
         max_crashes_per_step=args.max_crashes,
         backoff_base=args.backoff_base,
         backoff_max=args.backoff_max,
+        hang_timeout_s=hang_timeout,
+        hang_kill_grace_s=float(sup_cfg.get("hang_kill_grace_s") or 20.0),
     )
     rc = sup.run()
     return {"supervised": True, "exit_code": rc, "restarts": sup.restarts,
-            "run_dir": run_dir}
+            "hangs": sup.hangs, "run_dir": run_dir}
 
 
 def main(argv=None) -> Dict[str, Any]:

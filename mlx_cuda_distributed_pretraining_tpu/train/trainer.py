@@ -24,6 +24,7 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import jax.profiler
 import numpy as np
 
 from ..checkpoint import CheckpointIntegrityError, CheckpointManager
@@ -36,6 +37,16 @@ from ..models.llama import LlamaArgs
 from ..models import llama as llama_mod
 from ..models.registry import resolve_architecture
 from ..obs import Logger
+from ..obs.events import (
+    EventLog,
+    events_path,
+    heartbeat_path,
+    replay_into,
+    write_heartbeat,
+)
+from ..obs.flops import GoodputLedger, model_flops_per_token, peak_flops_per_chip
+from ..obs.flops import mfu as compute_mfu
+from ..obs.metrics import MetricsRegistry
 from ..optim import build_optimizer, build_schedule, schedule_value
 from ..parallel import build_mesh
 from ..tokenizer import TokenizerManager
@@ -82,9 +93,12 @@ class Trainer:
             run_dir = CheckpointManager.setup_run_directory(runs_root, cfg.name, cfg.overwrite)
         self.run_dir = run_dir
         os.makedirs(run_dir, exist_ok=True)
+        # Telemetry substrate (obs/metrics.py): one registry per Trainer —
+        # subsystems record into it, Prometheus/stats export read from it.
+        self.metrics = MetricsRegistry()
         self.checkpoints = CheckpointManager(
             run_dir, keep_last=cfg.logging.keep_last,
-            keep_every=cfg.logging.keep_every)
+            keep_every=cfg.logging.keep_every, metrics=self.metrics)
         is_chief = jax.process_index() == 0
         self.logger = Logger(run_dir, cfg, quiet=quiet or not is_chief, write_files=is_chief)
         # Integrity events (quarantine, GC, ledger rebuild, degraded
@@ -123,7 +137,8 @@ class Trainer:
         self.model_args = args
         self.rng, init_key = jax.random.split(self.rng)
         self.params = arch.init_params(init_key, args)
-        self.logger.log_model_summary(llama_mod.num_params(self.params), args)
+        self.n_params = llama_mod.num_params(self.params)
+        self.logger.log_model_summary(self.n_params, args)
 
         self.compute_dtype = jnp.bfloat16 if cfg.system.compute_dtype == "bfloat16" else jnp.float32
         remat = cfg.system.remat
@@ -304,6 +319,48 @@ class Trainer:
         # the consumed loader position through it (see _data_state).
         self.prefetcher: Optional[DevicePrefetcher] = None
 
+        # -- telemetry (obs/): FLOPs model, goodput ledger, event log -------
+        # MFU accounting: analytic FLOPs/token from the model config + exact
+        # param count, peak from the detected chip (None on CPU/unknown —
+        # log lines then report mfu=unknown).
+        self.flops_per_token = model_flops_per_token(
+            cfg.model, self.n_params, cfg.data.max_context_size)
+        self.peak_flops = peak_flops_per_chip()
+        self.goodput = GoodputLedger()
+        self._compiled = False  # first dispatch books into compile_s
+        self._metrics_server = None
+        # events.jsonl is the durable telemetry source: replay it FIRST so
+        # counters survive crash-restarts, then open for append. Chief only
+        # (one file per run; non-chief processes keep a local registry).
+        self.events: Optional[EventLog] = None
+        self._hb_path: Optional[str] = None
+        if for_training and is_chief:
+            replayed = replay_into(self.metrics, events_path(run_dir))
+            if replayed:
+                self.logger.log(
+                    f"telemetry: registry rebuilt from {replayed} events "
+                    f"in {events_path(run_dir)}")
+            self.events = EventLog(events_path(run_dir))
+            self._hb_path = heartbeat_path(run_dir)
+        # Handles for the hot-path counters (idempotent re-declaration —
+        # replay_into already registered them).
+        self._m_steps = self.metrics.counter(
+            "train_steps_total", "optimizer steps completed over the run lifetime")
+        self._m_toks = self.metrics.counter(
+            "train_tokens_total", "non-pad target tokens trained on")
+        self._m_saves = self.metrics.counter(
+            "checkpoint_saves_total", "checkpoints written")
+        self._m_evals = self.metrics.counter(
+            "eval_runs_total", "validation passes")
+        self._m_goodput = self.metrics.counter(
+            "goodput_seconds_total", "wall-clock seconds by goodput component")
+        self._g_step = self.metrics.gauge("train_step", "current optimizer step")
+        self._g_loss = self.metrics.gauge("train_loss", "last logged train loss")
+        self._g_tok_s = self.metrics.gauge(
+            "train_tok_s", "global tokens/second over the last window")
+        self._g_mfu = self.metrics.gauge(
+            "train_mfu", "model FLOPs utilization over the last window")
+
         if resume and for_training:
             self._resume()
 
@@ -337,6 +394,33 @@ class Trainer:
         return self.data.state_dict() if self.data else {"val_ptr": 0}
 
     def save_checkpoint(self, step, blocking: bool = True) -> None:
+        """Timed + profiler-annotated wrapper: the save's train-loop cost
+        (gather + serialize enqueue; the disk write itself overlaps when
+        async) books into the goodput ledger as ``ckpt_save_s`` and lands
+        in events.jsonl, and the heartbeat is refreshed afterwards so a
+        long blocking save never trips the hang watchdog."""
+        t0 = time.perf_counter()
+        with jax.profiler.TraceAnnotation("checkpoint_save"):
+            self._save_checkpoint_inner(step, blocking)
+        dt = time.perf_counter() - t0
+        self.goodput.add("ckpt_save_s", dt)
+        self._m_saves.inc()
+        if self.events is not None:
+            self.events.append("checkpoint_save", step=step,
+                               seconds=round(dt, 4), blocking=bool(blocking))
+        self._touch_heartbeat()
+
+    def _touch_heartbeat(self, step: Optional[int] = None) -> None:
+        if self._hb_path is None:
+            return
+        if step is not None:
+            self._hb_step = int(step)
+        try:
+            write_heartbeat(self._hb_path, getattr(self, "_hb_step", self.start_step))
+        except OSError:
+            pass  # heartbeat is advisory; never kill training over it
+
+    def _save_checkpoint_inner(self, step, blocking: bool = True) -> None:
         # The host gather is a COLLECTIVE when state is sharded across
         # processes (multi-host FSDP/ZeRO), so every process runs it; only
         # process 0 touches the filesystem afterwards.
@@ -492,9 +576,27 @@ class Trainer:
                 self.data.load_state_dict(data_state)
             self.early_stopping.load_state_dict(tstate.get("early_stopping", {}))
         self.logger.log(f"Resumed from checkpoint {tag} at step {self.start_step}")
+        if self.events is not None:
+            self.events.append("resume", tag=str(tag), step=self.start_step)
 
     # -- validation ---------------------------------------------------------
     def validate(self, cap: int = 50) -> Optional[float]:
+        """Timed + profiler-annotated wrapper (see save_checkpoint): eval
+        wall clock books into goodput as ``eval_s``; each completed pass
+        counts in the registry and events.jsonl."""
+        t0 = time.perf_counter()
+        with jax.profiler.TraceAnnotation("eval"):
+            result = self._validate_inner(cap)
+        dt = time.perf_counter() - t0
+        self.goodput.add("eval_s", dt)
+        if result is not None:
+            self._m_evals.inc()
+            if self.events is not None:
+                self.events.append("eval", loss=result, seconds=round(dt, 4))
+        self._touch_heartbeat()
+        return result
+
+    def _validate_inner(self, cap: int = 50) -> Optional[float]:
         if self.data is None or not self.data.has_validation_data:
             return None
         # Accumulate on device; a single host sync after the loop instead of
@@ -614,6 +716,15 @@ class Trainer:
 
     def train(self) -> Dict[str, Any]:
         cfg = self.config
+        train_t0 = time.perf_counter()
+        # run_start is appended before any other activity (the step-0
+        # validation below emits an eval event) so the stream always
+        # opens with it on a fresh run.
+        if self.events is not None and self.start_step == 0:
+            self.events.append(
+                "run_start", name=cfg.name, total_steps=self.total_steps,
+                n_params=self.n_params, flops_per_token=self.flops_per_token,
+                peak_flops=self.peak_flops, n_chips=jax.device_count())
         log_int = max(1, cfg.logging.logging_interval)
         ckpt_int = cfg.logging.checkpoint_interval
         val_int = cfg.logging.validation_interval
@@ -632,9 +743,11 @@ class Trainer:
                 self.val_history["losses"].append(v)
 
         window_tokens = 0
-        window_data_wait = 0.0
-        window_h2d = 0.0
-        window_dispatch = 0.0
+        window_steps = 0
+        # Anything booked so far (step-0 validation, lr finder) happened
+        # before the first window's clock starts — flush it into the run
+        # totals so every window's components sum to its own wall time.
+        self.goodput.close_window(time.perf_counter() - train_t0)
         window_start = time.perf_counter()
         last_loss = float("nan")
         stopped_early = False
@@ -658,7 +771,29 @@ class Trainer:
             start_step=self.start_step,
             total_steps=self.total_steps,
             group_len_fn=group_len_fn,
+            metrics=self.metrics,
         )
+
+        # Telemetry endpoints for the run: Prometheus exposition behind
+        # logging.metrics_port (chief only; stays up after train() returns
+        # — daemon thread — so late scrapes see the final counters), the
+        # run_start event, and the first heartbeat so the supervisor's
+        # hang watchdog has a baseline that covers the initial compile.
+        if (cfg.logging.metrics_port and self._metrics_server is None
+                and jax.process_index() == 0):
+            from ..obs.prometheus import start_metrics_server
+
+            self._metrics_server = start_metrics_server(
+                self.metrics, cfg.logging.metrics_port)
+            if self._metrics_server is not None:
+                self.logger.log(
+                    f"telemetry: serving Prometheus metrics on "
+                    f":{self._metrics_server.port}/metrics")
+            else:
+                self.logger.log(
+                    f"telemetry: metrics port {cfg.logging.metrics_port} "
+                    f"unavailable; exporter disabled")
+        self._touch_heartbeat(self.start_step)
 
         # Preemption-aware checkpointing (SURVEY.md §5 failure-detection
         # plan; the reference's only recovery story is checkpoint-resume):
@@ -707,12 +842,16 @@ class Trainer:
                         self.logger.log(
                             f"profiler: trace written to {os.path.join(self.run_dir, 'profile')}"
                         )
+                        if self.events is not None:
+                            self.events.append("profiler", action="stop", step=step)
                     elif prof_start <= step < prof_stop and not prof_active:
                         import jax.profiler as _prof
 
                         _prof.start_trace(os.path.join(self.run_dir, "profile"))
                         prof_active = True
                         self.logger.log(f"profiler: trace started at step {step}")
+                        if self.events is not None:
+                            self.events.append("profiler", action="start", step=step)
                 if self.steps_per_dispatch > 1:
                     if not pending:
                         try:
@@ -724,11 +863,27 @@ class Trainer:
                             self.logger.log(
                                 f"Data stream exhausted before step {step}; stopping")
                             break
-                        window_data_wait += waits["data_wait_s"]
-                        window_h2d += waits["h2d_wait_s"]
+                        self.goodput.add("data_wait_s", waits["data_wait_s"])
+                        if self.prefetcher.h2d_blocks_consumer:
+                            self.goodput.add("h2d_wait_s", waits["h2d_wait_s"])
                         t_dispatch = time.perf_counter()
-                        self.state, mm = self.train_multi_step(self.state, stacked)
-                        window_dispatch += time.perf_counter() - t_dispatch
+                        # StepTraceAnnotation: profiler traces carry the
+                        # trainer's step numbering, lining up with
+                        # events.jsonl step_window records.
+                        with jax.profiler.StepTraceAnnotation("train", step_num=step):
+                            self.state, mm = self.train_multi_step(self.state, stacked)
+                        t_d = time.perf_counter() - t_dispatch
+                        if not self._compiled:
+                            # The run's first dispatch is dominated by the
+                            # XLA compile — book it separately so steady-
+                            # state dispatch_s stays meaningful.
+                            self._compiled = True
+                            self.goodput.add("compile_s", t_d)
+                            if self.events is not None:
+                                self.events.append("compile", seconds=round(t_d, 4),
+                                                   step=step)
+                        else:
+                            self.goodput.add("dispatch_s", t_d)
                         pending = [
                             (jax.tree_util.tree_map(lambda a, i=i: a[i], mm),
                              t * jax.process_count())
@@ -749,16 +904,34 @@ class Trainer:
                     step_tokens = local_tokens * jax.process_count()
                     window_tokens += step_tokens
                     self.total_tokens += step_tokens
-                    window_data_wait += waits["data_wait_s"]
-                    window_h2d += waits["h2d_wait_s"]
+                    self.goodput.add("data_wait_s", waits["data_wait_s"])
+                    if self.prefetcher.h2d_blocks_consumer:
+                        self.goodput.add("h2d_wait_s", waits["h2d_wait_s"])
                     t_dispatch = time.perf_counter()
-                    self.state, metrics = self.train_step(self.state, batch)
-                    window_dispatch += time.perf_counter() - t_dispatch
+                    with jax.profiler.StepTraceAnnotation("train", step_num=step):
+                        self.state, metrics = self.train_step(self.state, batch)
+                    t_d = time.perf_counter() - t_dispatch
+                    if not self._compiled:
+                        self._compiled = True
+                        self.goodput.add("compile_s", t_d)
+                        if self.events is not None:
+                            self.events.append("compile", seconds=round(t_d, 4),
+                                               step=step)
+                    else:
+                        self.goodput.add("dispatch_s", t_d)
 
+                window_steps += 1
                 if step % log_int == 0 or step == self.total_steps:
                     loss = float(metrics["loss"])  # device sync point
                     last_loss = loss
                     elapsed = max(time.perf_counter() - window_start, 1e-9)
+                    # Close the goodput window: components (compile, data
+                    # wait, h2d, dispatch, ckpt save, eval) plus the
+                    # other_s residual sum to elapsed by construction.
+                    gp = self.goodput.close_window(elapsed)
+                    tok_s = window_tokens / elapsed
+                    mfu_val = compute_mfu(tok_s, self.flops_per_token,
+                                          self.peak_flops, jax.device_count())
                     line = {
                         "loss": loss,
                         "ppl": float(math.exp(min(loss, 30.0))),
@@ -766,16 +939,26 @@ class Trainer:
                         # the schedule closure and syncs a device scalar on
                         # every log line (see tests/lint_fixtures).
                         "lr": schedule_value(self.schedule, step),
-                        "tok/s": window_tokens / elapsed,
+                        "tok/s": tok_s,
                         "toks": int(window_tokens),
-                        # Step-time breakdown for this window: data_wait is
-                        # the only true input stall (queue get); h2d is the
-                        # transfer time (overlapped unless prefetch_depth=0);
-                        # dispatch is time inside the jitted-step calls.
-                        "data_wait_s": window_data_wait,
-                        "h2d_wait_s": window_h2d,
-                        "dispatch_s": window_dispatch,
-                        "data_wait_frac": min(window_data_wait / elapsed, 1.0),
+                        # Hardware efficiency: analytic FLOPs/token * tok/s
+                        # over chip peak (obs/flops.py); "unknown" when the
+                        # chip peak is undetectable (CPU smoke runs).
+                        "mfu": mfu_val if mfu_val is not None else "unknown",
+                        # Goodput breakdown for this window (sums to wall
+                        # time): data_wait is the only true input stall
+                        # (queue get); h2d is booked only when the transfer
+                        # blocks the step loop (prefetch_depth=0); dispatch
+                        # is time inside the jitted-step calls; other_s is
+                        # the residual.
+                        "data_wait_s": gp["data_wait_s"],
+                        "h2d_wait_s": gp["h2d_wait_s"],
+                        "dispatch_s": gp["dispatch_s"],
+                        "compile_s": gp["compile_s"],
+                        "ckpt_save_s": gp["ckpt_save_s"],
+                        "eval_s": gp["eval_s"],
+                        "other_s": gp["other_s"],
+                        "data_wait_frac": min(gp["data_wait_s"] / elapsed, 1.0),
                     }
                     if "grad_norm" in metrics:
                         line["grad_norm"] = float(metrics["grad_norm"])
@@ -784,8 +967,28 @@ class Trainer:
                     self.logger.log_metrics(step, line)
                     if self.stats_client is not None:
                         self.stats_client.log_metrics(step, line)
+                    # Registry + event log: the durable counters Prometheus
+                    # exports and replay_into rebuilds must move in lockstep
+                    # with the step_window events.
+                    self._m_steps.inc(window_steps)
+                    self._m_toks.inc(window_tokens)
+                    self._g_step.set(step)
+                    self._g_loss.set(loss)
+                    self._g_tok_s.set(tok_s)
+                    if mfu_val is not None:
+                        self._g_mfu.set(mfu_val)
+                    for comp, secs in gp.items():
+                        if secs > 0:
+                            self._m_goodput.inc(secs, component=comp)
+                    if self.events is not None:
+                        self.events.append(
+                            "step_window", step=step, steps=window_steps,
+                            toks=int(window_tokens), loss=round(loss, 6),
+                            tok_s=round(tok_s, 2), mfu=mfu_val,
+                            goodput={k: round(v, 6) for k, v in gp.items()})
+                    self._touch_heartbeat(step)
                     window_tokens = 0
-                    window_data_wait = window_h2d = window_dispatch = 0.0
+                    window_steps = 0
                     window_start = time.perf_counter()
 
                 if val_int and step % val_int == 0:
@@ -873,6 +1076,15 @@ class Trainer:
             self.data.stop()  # streaming sources run a prefetch thread
         if self.stats_client is not None:
             self.stats_client.close()
+        if self.events is not None:
+            self.events.append(
+                "run_end", step=step, total_tokens=int(self.total_tokens),
+                final_loss=last_loss, goodput_totals={
+                    k: round(v, 4) for k, v in self.goodput.totals().items()})
+            self.events.close()
+            self.events = None
+        # The metrics server (if any) intentionally stays up: a daemon
+        # thread serving the final counter snapshot for late scrapes.
         self.logger.log("Training complete")
         self.logger.close()
         return {"final_loss": last_loss, "final_val_loss": final_val, "steps": step}
@@ -1005,6 +1217,11 @@ def build_parser() -> argparse.ArgumentParser:
                              "no-progress crash; with --auto-resume)")
     parser.add_argument("--backoff-max", type=float, default=60.0,
                         help="restart delay ceiling in seconds (with --auto-resume)")
+    parser.add_argument("--hang-timeout-s", type=float, default=None,
+                        help="with --auto-resume: SIGTERM-and-restart the "
+                             "trainer when its heartbeat makes no progress "
+                             "for this many seconds (overrides "
+                             "supervisor.hang_timeout_s; 0 disables)")
     return parser
 
 

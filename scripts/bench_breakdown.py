@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bench import SCALES, V5E_PEAK_FLOPS, flops_per_token
+from bench import SCALES, flops_per_token, mfu_or_unknown
 
 
 def chain_time(fn, state, steps, donate=False):
@@ -203,7 +203,7 @@ def main():
         "scale": a.scale, "batch": B, "seq": S, "vocab": a.vocab,
         "params_m": round(n_params / 1e6, 1),
         "tok_s": round(tok_s, 0),
-        "mfu": round(ft * tok_s / V5E_PEAK_FLOPS, 4),
+        "mfu": mfu_or_unknown(ft, tok_s),
         "breakdown_ms": {k: round(v, 2) for k, v in results.items()},
     }), flush=True)
 

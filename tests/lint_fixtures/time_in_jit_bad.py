@@ -1,0 +1,12 @@
+"""BAD fixture: time-in-jit."""
+import time
+
+import jax
+
+
+@jax.jit
+def step(x):
+    t0 = time.perf_counter()  # line 9: trace-time constant, not a timing
+    y = x * 2
+    print("value:", y)  # line 11: runs once at trace time, never again
+    return y, time.time() - t0  # line 12: another trace-time read

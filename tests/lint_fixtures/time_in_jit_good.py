@@ -1,0 +1,18 @@
+"""GOOD fixture: time-in-jit — timing wraps the dispatch; in-trace
+output goes through jax.debug.print."""
+import time
+
+import jax
+
+
+@jax.jit
+def step(x):
+    jax.debug.print("x = {}", x)  # supported in-trace output
+    return x * 2
+
+
+def timed_step(x):
+    t0 = time.perf_counter()
+    y = step(x)
+    jax.block_until_ready(y)
+    return y, time.perf_counter() - t0

@@ -1,0 +1,9 @@
+"""SUPPRESSED fixture: time-in-jit acknowledged inline (a trace-time
+banner the author wants exactly once per compile)."""
+import jax
+
+
+@jax.jit
+def step(x):
+    print("tracing step")  # graftlint: disable=time-in-jit
+    return x * 2

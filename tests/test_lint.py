@@ -37,6 +37,7 @@ EXPECTED_RULE_IDS = {
     "use-after-donate",
     "tracer-leak",
     "jit-in-loop",
+    "time-in-jit",
 }
 
 
@@ -91,6 +92,7 @@ def test_baseline_entries_all_still_match():
     ("use_after_donate_bad.py", "use-after-donate", [14, 21]),
     ("tracer_leak_bad.py", "tracer-leak", [10, 17]),
     ("jit_in_loop_bad.py", "jit-in-loop", [7]),
+    ("time_in_jit_bad.py", "time-in-jit", [9, 11, 12]),
 ])
 def test_bad_fixture_fires_at_exact_lines(fixture, rule, lines):
     active, _ = _hits(fixture)
@@ -106,6 +108,7 @@ def test_bad_fixture_fires_at_exact_lines(fixture, rule, lines):
     "use_after_donate_good.py",
     "tracer_leak_good.py",
     "jit_in_loop_good.py",
+    "time_in_jit_good.py",
 ])
 def test_good_fixture_is_clean(fixture):
     active, suppressed = _hits(fixture)
@@ -120,6 +123,7 @@ def test_good_fixture_is_clean(fixture):
     ("use_after_donate_suppressed.py", "use-after-donate", 15),
     ("tracer_leak_suppressed.py", "tracer-leak", 9),
     ("jit_in_loop_suppressed.py", "jit-in-loop", 8),
+    ("time_in_jit_suppressed.py", "time-in-jit", 8),
 ])
 def test_suppression_silences_but_counts(fixture, rule, line):
     active, suppressed = _hits(fixture)

@@ -3,6 +3,7 @@ server/client round trip (reference capabilities: utils/plotting.py,
 monitor_training.py, stats_server.py, stats_client.py)."""
 
 import asyncio
+import importlib.util
 import json
 import os
 import threading
@@ -114,9 +115,8 @@ def test_stats_state_history_ring():
     assert st.history[-1]["step"] == 24
 
 
-@pytest.mark.skipif(
-    not pytest.importorskip("websockets", reason="websockets unavailable"),
-    reason="websockets unavailable")
+@pytest.mark.skipif(importlib.util.find_spec("websockets") is None,
+                    reason="websockets unavailable")
 def test_stats_server_client_roundtrip(tmp_path):
     """Full wire test: server hub + background client, metrics land in
     state and persistence file."""
@@ -200,3 +200,112 @@ def test_dashboard_write(tmp_path):
 
     p = write_dashboard(str(tmp_path / "sub" / "dashboard.html"))
     assert open(p).read().startswith("<!DOCTYPE html>")
+
+
+# -- PR 5 telemetry satellites ----------------------------------------------
+
+# A window line in the extended (telemetry) format: mfu + full goodput
+# breakdown. CPU runs report mfu=unknown — parsers must treat it as None,
+# never crash.
+LOG_EXTENDED = LOG + (
+    "Step 15: loss=2.5000 | ppl=12.1825 | lr=8.000e-03 | tok/s=1500.0 | "
+    "toks=320 | mfu=unknown | data_wait_s=0.0100 | h2d_wait_s=0.0000 | "
+    "dispatch_s=0.1000 | compile_s=0.0000 | ckpt_save_s=0.0500 | "
+    "eval_s=0.0000 | other_s=0.0500 | data_wait_frac=0.0476\n"
+    "Step 20: loss=2.0000 | ppl=7.3891 | lr=7.000e-03 | tok/s=1600.0 | "
+    "toks=320 | mfu=0.4210 | data_wait_s=0.0000 | h2d_wait_s=0.0000 | "
+    "dispatch_s=0.1500 | compile_s=0.0000 | ckpt_save_s=0.0000 | "
+    "eval_s=0.0000 | other_s=0.0500 | data_wait_frac=0.0000\n"
+)
+
+
+def test_parse_log_extended_keys_and_unknown(tmp_path):
+    """New telemetry keys parse; mfu=unknown maps to None; pre-telemetry
+    lines (no mfu/goodput keys) in the same file stay parseable."""
+    run = _write_log(tmp_path, text=LOG_EXTENDED)
+    steps, metrics = parse_log(os.path.join(run, "log.txt"))
+    assert steps == [5, 10, 15, 20]
+    assert metrics["loss"] == [4.0, 3.0, 2.5, 2.0]
+    assert metrics["mfu"] == [None, None, None, 0.421]
+    assert metrics["ckpt_save_s"] == [None, None, 0.05, 0.0]
+    assert metrics["dispatch_s"] == [None, None, 0.1, 0.15]
+
+
+def test_parse_value_unknown():
+    from mlx_cuda_distributed_pretraining_tpu.obs.plotting import parse_value
+
+    assert parse_value("unknown") is None
+    assert parse_value("0.5") == 0.5
+
+
+def test_log_tailer_handles_unknown_mfu(tmp_path):
+    run = _write_log(tmp_path, text="")
+    tailer = LogTailer(os.path.join(run, "log.txt"))
+    with open(os.path.join(run, "log.txt"), "a") as f:
+        f.write(LOG_EXTENDED.splitlines()[-2] + "\n")  # the mfu=unknown line
+    assert tailer.poll() == 1
+    assert "mfu" not in tailer.latest  # unknown dropped, not a crash
+    assert tailer.latest["ckpt_save_s"] == 0.05
+    with open(os.path.join(run, "log.txt"), "a") as f:
+        f.write(LOG_EXTENDED.splitlines()[-1] + "\n")  # numeric mfu
+    tailer.poll()
+    assert tailer.latest["mfu"] == 0.421
+    assert "mfu=0.421" in tailer.status_line()
+
+
+def test_stats_state_mean_mfu_aggregation():
+    st = StatsState()
+    st.handle({"type": "metrics", "worker_id": "w0", "step": 5,
+               "data": {"loss": 2.0, "tok/s": 100.0, "mfu": 0.4}})
+    st.handle({"type": "metrics", "worker_id": "w1", "step": 5,
+               "data": {"loss": 2.0, "tok/s": 100.0, "mfu": 0.6}})
+    # CPU worker reports mfu=unknown (a string) — excluded from the mean
+    st.handle({"type": "metrics", "worker_id": "w2", "step": 5,
+               "data": {"loss": 2.0, "tok/s": 10.0, "mfu": "unknown"}})
+    agg = st.aggregated()
+    assert agg["mean_mfu"] == pytest.approx(0.5)
+
+
+def test_stats_state_evicts_dead_workers():
+    st = StatsState(worker_ttl_s=100.0)
+    st.handle({"type": "register", "worker_id": "alive"})
+    st.handle({"type": "register", "worker_id": "dead"})
+    st.workers["dead"]["last_seen"] = time.time() - 500
+    assert st.evict_stale() == 1
+    assert set(st.workers) == {"alive"}
+    agg = st.aggregated()  # aggregation evicts too
+    assert agg["num_workers"] == 1
+
+
+def test_stats_state_ttl_zero_disables_eviction():
+    st = StatsState(worker_ttl_s=0)
+    st.handle({"type": "register", "worker_id": "ancient"})
+    st.workers["ancient"]["last_seen"] = 0
+    assert st.evict_stale() == 0
+    assert "ancient" in st.workers
+
+
+def test_stats_persist_atomic_on_failure(tmp_path):
+    """An interrupted persist (crash mid-json.dump) must leave the
+    previous good snapshot untouched — tmp+rename, never in-place."""
+    persist = str(tmp_path / "stats.json")
+    server = StatsServer(persist_path=persist)
+    server.state.handle({"type": "metrics", "worker_id": "w0", "step": 1,
+                         "data": {"loss": 2.0}})
+    server.persist()
+    good = open(persist).read()
+    assert json.loads(good)["workers"]["w0"]["metrics"]["loss"] == 2.0
+
+    # Poison the state: json.dump raises AFTER the tmp file is opened,
+    # exactly the mid-write crash window.
+    server.state.workers["w0"]["metrics"]["bad"] = object()
+    with pytest.raises(TypeError):
+        server.persist()
+    assert open(persist).read() == good
+
+
+def test_dashboard_has_mfu_and_goodput_panels():
+    from mlx_cuda_distributed_pretraining_tpu.obs.dashboard import DASHBOARD_HTML
+
+    for needle in ('id="t-mfu"', 'id="goodput"', "drawGoodput", "mean_mfu"):
+        assert needle in DASHBOARD_HTML, needle
