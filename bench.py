@@ -540,7 +540,7 @@ def bench_train_case(name, scale_key, attn, vocab, steps, fused_ce=True,
 
 
 def bench_decode_case(scale_key, vocab, prompt=512, max_len=2048,
-                      attend=2048, quantize=False, name=None):
+                      attend=2048, quantize=False, paged=False, name=None):
     """Device decode throughput (chained greedy steps, two-point timing)
     and bucketed prefill throughput. ``quantize`` exercises the int8 KV
     cache; a (prompt=8192, max_len=16384) call is the long-context point
@@ -548,7 +548,10 @@ def bench_decode_case(scale_key, vocab, prompt=512, max_len=2048,
     max_len. ``attend`` must cover prompt + the 544-step timing chain —
     production decode grows the bucket with position (generate.py
     ``_attend_bucket``), and benching past the bucket would time a
-    configuration real decode never runs (ADVICE r3)."""
+    configuration real decode never runs (ADVICE r3). ``paged`` adds a
+    second chain through the block-table decode step (serve/batch_step
+    ``paged_decode_step``) over an arena of the same total KV footprint,
+    so the gather/scatter indirection cost is a reported delta."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -644,7 +647,7 @@ def bench_decode_case(scale_key, vocab, prompt=512, max_len=2048,
         ts[n] = best
     per_step = (ts[DECODE_CHAIN] - ts[32]) / (DECODE_CHAIN - 32)
     ok = per_step > 1e-6
-    return {
+    row = {
         "case": name or f"decode_{scale_key}", "batch": B, "prompt": P,
         "vocab": vocab,
         "max_len": max_len, "attend_bucket": attend, "kv_int8": quantize,
@@ -652,6 +655,65 @@ def bench_decode_case(scale_key, vocab, prompt=512, max_len=2048,
         "decode_step_ms": round(per_step * 1e3, 2) if ok else None,
         "prefill_tok_s": prefill_tok_s,
     }
+    if not paged:
+        return row
+
+    # Paged chain: same total KV footprint laid out as B*W exclusive
+    # blocks (+ the junk block 0), block tables mapping row r's logical
+    # block j to physical 1 + r*W + j. Timing is shape-only — the arena
+    # holds zeros and the chain feeds argmax back — so skipping prefill
+    # changes nothing about per-step cost.
+    from mlx_cuda_distributed_pretraining_tpu.serve import batch_step
+
+    BLOCK = 64
+    assert max_len % BLOCK == 0 and attend % BLOCK == 0
+    W = max_len // BLOCK
+    tables = (jnp.arange(B * W, dtype=jnp.int32) + 1).reshape(B, W)
+    paged_cache = llama.init_paged_cache(args, B * W + 1, BLOCK,
+                                         dtype=jnp.bfloat16,
+                                         quantize=quantize)
+    step = batch_step.paged_decode_step(args, 0, attend, W, BLOCK, raw=True)
+    temps = jnp.zeros((B,), jnp.float32)
+    keys = jnp.zeros((B, 2), jnp.uint32)
+
+    @partial(jax.jit, static_argnums=(2,))
+    def paged_chain(params, cache, n):
+        def body(i, carry):
+            cache, tok, pos = carry
+            out = step(params, cache, tok, pos, tables, temps, keys)
+            return out[0], out[1].astype(jnp.int32), pos + 1
+
+        tok0 = jnp.ones((B, 1), jnp.int32)
+        pos0 = jnp.full((B,), P, jnp.int32)
+        return lax.fori_loop(0, n, body, (cache, tok0, pos0))
+
+    ts = {}
+    for n in (32, DECODE_CHAIN):
+        sync(paged_chain(params, paged_cache, n))  # compile
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            sync(paged_chain(params, paged_cache, n))
+            best = min(best, time.perf_counter() - t0)
+        ts[n] = best
+    per_step = (ts[DECODE_CHAIN] - ts[32]) / (DECODE_CHAIN - 32)
+    ok = per_step > 1e-6
+    row["paged_block_size"] = BLOCK
+    row["decode_tok_s_paged"] = round(B / per_step, 1) if ok else None
+    row["decode_step_ms_paged"] = round(per_step * 1e3, 2) if ok else None
+    return row
+
+
+class _IdTok:
+    """Token-id passthrough: the serve benches feed raw ids (no text),
+    and eos -1 never matches so every request runs its full budget."""
+    bos_id, eos_id = 1, -1
+
+    def tokenize(self, s):
+        return []
+
+    def detokenize(self, ids):
+        return ""
 
 
 def bench_serve_case(vocab, name="serve_batch"):
@@ -691,20 +753,12 @@ def bench_serve_case(vocab, name="serve_batch"):
         generate_lite(params, args, ids, max_tokens=NEW)
     locked_tok_s = len(prompts) * NEW / (time.perf_counter() - t0)
 
-    class _IdTok:
-        """Token-id passthrough: the bench feeds raw ids (no text), and
-        eos -1 never matches so every request runs its full budget."""
-        bos_id, eos_id = 1, -1
-
-        def tokenize(self, s):
-            return []
-
-        def detokenize(self, ids):
-            return ""
-
+    # Pinned to the slotted backend: this case is the PR-1 baseline the
+    # serve_paged case compares against.
     eng = BatchEngine(params, args, _IdTok(),
                       EngineConfig(num_slots=8, max_len=MAX_LEN,
-                                   prefill_chunk=64)).start()
+                                   prefill_chunk=64,
+                                   kv_backend="slotted")).start()
     try:
         eng._submit_ids(prompts[0], NEW, 0.0, 0).wait(600)  # compile
         row = {"case": name, "vocab": vocab, "prompt": P, "new_tokens": NEW,
@@ -720,6 +774,127 @@ def bench_serve_case(vocab, name="serve_batch"):
         row["speedup_8"] = round(row["batch_tok_s_occ8"] / locked_tok_s, 2)
     finally:
         eng.stop()
+    return row
+
+
+def bench_serve_paged_case(vocab, name="serve_paged"):
+    """Paged vs slotted KV pool at a FIXED KV-memory budget (2048 cache
+    positions = what serve_batch's 8 x 256 slotted pool allocates).
+
+    Two measurements:
+
+    - uniform occ-8 decode throughput, identical to serve_batch's
+      ``batch_tok_s_occ8`` protocol, paged-vs-slotted at the SAME 8-lane
+      batch width — the no-regression check isolates the block
+      gather/scatter indirection (lane count dominates per-iteration
+      cost on CPU, so comparing different widths would measure the
+      scheduler config, not the backend);
+    - a flood of 24 mixed-length requests: the slotted pool can hold at
+      most 8 concurrent sequences (rows are worst-case sized), while a
+      24-lane paged pool admits sequences until the BLOCK arena is full,
+      so peak concurrency is bounded by actual lengths. The acceptance
+      bar is ``peak_seqs_paged >= 2 * peak_seqs_slotted``.
+    """
+    import threading
+
+    import jax
+    import numpy as np
+
+    from mlx_cuda_distributed_pretraining_tpu.models import llama
+    from mlx_cuda_distributed_pretraining_tpu.serve import (
+        BatchEngine,
+        EngineConfig,
+    )
+
+    sc = SCALES["2m"]
+    P, NEW, MAX_LEN = 64, 32, 256
+    BUDGET = 8 * MAX_LEN  # KV positions — shared by both configurations
+    BLOCK = 32
+    args = llama.LlamaArgs(
+        vocab_size=vocab, max_position_embeddings=MAX_LEN, **sc["shape"])
+    params = llama.init_params(jax.random.PRNGKey(0), args)
+    rng = np.random.default_rng(0)
+    uniform = [rng.integers(2, vocab, size=P).tolist() for _ in range(8)]
+    # Mixed-length traffic: short-skewed, the regime PagedAttention wins.
+    mixed_lens = [16, 24, 32, 48, 16, 80, 24, 32] * 3  # 24 requests
+    mixed = [rng.integers(2, vocab, size=n).tolist() for n in mixed_lens]
+
+    def flood(eng, prompts, new_tokens):
+        """Submit everything at once; track wall time and peak concurrent
+        sequences (sampled between iterations — CPU iterations are ~ms,
+        far coarser than the 0.2 ms poll)."""
+        reqs = [eng._submit_ids(ids, new_tokens, 0.0, 0) for ids in prompts]
+        peak = 0
+        done = threading.Event()
+
+        def watch():
+            nonlocal peak
+            while not done.is_set():
+                peak = max(peak, eng.pool.num_used)
+                time.sleep(2e-4)
+
+        w = threading.Thread(target=watch, daemon=True)
+        t0 = time.perf_counter()
+        w.start()
+        for r in reqs:
+            r.wait(600)
+        dt = time.perf_counter() - t0
+        done.set()
+        w.join(timeout=5)
+        return dt, peak
+
+    row = {"case": name, "vocab": vocab, "prompt": P, "new_tokens": NEW,
+           "kv_budget_tokens": BUDGET, "block_size": BLOCK,
+           "mixed_requests": len(mixed)}
+    # slotted at the budget: 8 worst-case rows
+    eng = BatchEngine(params, args, _IdTok(),
+                      EngineConfig(num_slots=8, max_len=MAX_LEN,
+                                   prefill_chunk=64, max_queue=64,
+                                   kv_backend="slotted")).start()
+    try:
+        eng._submit_ids(uniform[0], NEW, 0.0, 0).wait(600)  # compile
+        dt, _ = flood(eng, uniform, NEW)
+        row["slotted_tok_s_occ8"] = round(8 * NEW / dt, 1)
+        dt, peak = flood(eng, mixed, NEW)
+        row["slotted_mixed_tok_s"] = round(len(mixed) * NEW / dt, 1)
+        row["peak_seqs_slotted"] = peak
+    finally:
+        eng.stop()
+    # paged, like-for-like: same 8 lanes, same budget, backend flipped.
+    eng = BatchEngine(params, args, _IdTok(),
+                      EngineConfig(num_slots=8, max_len=MAX_LEN,
+                                   prefill_chunk=64, max_queue=64,
+                                   kv_backend="paged", block_size=BLOCK,
+                                   num_blocks=BUDGET // BLOCK)).start()
+    try:
+        eng._submit_ids(uniform[0], NEW, 0.0, 0).wait(600)  # compile
+        dt, _ = flood(eng, uniform, NEW)
+        row["paged_tok_s_occ8"] = round(8 * NEW / dt, 1)
+    finally:
+        eng.stop()
+    # paged at the SAME budget with lanes to spare: rows are cheap (host
+    # state + one batch lane), blocks are the real memory — more lanes
+    # than the budget could ever hold worst-case sequences in.
+    eng = BatchEngine(params, args, _IdTok(),
+                      EngineConfig(num_slots=24, max_len=MAX_LEN,
+                                   prefill_chunk=64, max_queue=64,
+                                   kv_backend="paged", block_size=BLOCK,
+                                   num_blocks=BUDGET // BLOCK)).start()
+    try:
+        eng._submit_ids(uniform[0], NEW, 0.0, 0).wait(600)  # compile
+        dt, peak = flood(eng, mixed, NEW)
+        row["paged_mixed_tok_s"] = round(len(mixed) * NEW / dt, 1)
+        row["peak_seqs_paged"] = peak
+        m = eng.metrics()
+        row["kv_fragmentation"] = m.get("kv_fragmentation")
+        row["preempted"] = m.get("preempted", 0)
+    finally:
+        eng.stop()
+    row["peak_seqs_ratio"] = (
+        round(row["peak_seqs_paged"] / max(row["peak_seqs_slotted"], 1), 2))
+    row["decode_regression"] = (
+        round(row["paged_tok_s_occ8"] / max(row["slotted_tok_s_occ8"], 1e-9),
+              2))
     return row
 
 
@@ -854,6 +1029,10 @@ def build_plan(vocab, steps):
         # is a scheduling win, not a chip win) and cheap: keep it with the
         # early diverse families.
         ("serve_batch", "serve", lambda: bench_serve_case(vocab), 180),
+        # serve_paged is the PagedAttention acceptance case: same KV byte
+        # budget, >= 2x peak concurrent sequences under mixed lengths, no
+        # decode-throughput regression at uniform occupancy 8.
+        ("serve_paged", "serve", lambda: bench_serve_paged_case(vocab), 240),
         ("100m_flash", "100m",
          lambda: bench_train_case("100m_flash", "100m", "flash", vocab, steps), 150),
         ("40m_flash", "40m",
@@ -868,8 +1047,10 @@ def build_plan(vocab, steps):
          # attend=16384: the bucket production decode actually runs at
          # these positions (generate.py _attend_bucket is power-of-two, so
          # positions 8193..8736 attend over 16384 keys).
+         # paged=True: the int8 block arena rides along, so the row also
+         # reports the block-gather indirection cost at 16k positions.
          lambda: bench_decode_case("100m", vocab, prompt=8192, max_len=16384,
-                                   attend=16384, quantize=True,
+                                   attend=16384, quantize=True, paged=True,
                                    name="decode_100m_16k_int8"), 200),
         # 650m/1b before the comparison variants: the VERDICT matrix wants
         # one row per scale family more than it wants redundant variants —

@@ -361,16 +361,34 @@ def main(argv=None) -> int:
     p.add_argument("--engine", choices=("locked", "batch"), default="locked",
                    help="locked = one request at a time behind a lock "
                         "(default, byte-compatible); batch = continuous-"
-                        "batching engine over a slotted KV pool")
+                        "batching engine over a paged (or slotted) KV pool")
     p.add_argument("--slots", type=int, default=8,
                    help="batch engine: concurrent decode slots")
     p.add_argument("--kv-len", type=int, default=2048,
-                   help="batch engine: per-slot KV length (clamped to the "
-                        "model's max_position_embeddings)")
+                   help="batch engine: per-request KV length bound (clamped "
+                        "to the model's max_position_embeddings)")
     p.add_argument("--max-queue", type=int, default=32,
                    help="batch engine: admission queue depth before 429")
     p.add_argument("--prefill-chunk", type=int, default=256,
                    help="batch engine: prompt tokens prefilled per iteration")
+    p.add_argument("--kv-backend", choices=("paged", "slotted"),
+                   default="paged",
+                   help="batch engine: paged = block-table KV arena "
+                        "(admission by free blocks); slotted = one fixed "
+                        "max-len row per request")
+    p.add_argument("--block-size", type=int, default=32,
+                   help="paged backend: tokens per KV block (power of two; "
+                        "kv-len must be a multiple)")
+    p.add_argument("--num-blocks", type=int, default=0,
+                   help="paged backend: KV arena size in blocks "
+                        "(0 = slotted-equivalent budget slots*kv_len/block)")
+    p.add_argument("--spec-draft-len", type=int, default=0,
+                   help="paged backend: in-batch speculative decoding — "
+                        "prompt-lookup drafts verified per decode step "
+                        "(0 = off)")
+    p.add_argument("--spec-max-ngram", type=int, default=3,
+                   help="paged backend: longest suffix n-gram for prompt-"
+                        "lookup drafting")
     p.add_argument("--deadline-s", type=float, default=None,
                    help="batch engine: default per-request deadline")
     p.add_argument("--stats-url", default=None,
@@ -389,6 +407,9 @@ def main(argv=None) -> int:
         service.attach_engine(EngineConfig(
             num_slots=a.slots, max_len=a.kv_len, max_queue=a.max_queue,
             prefill_chunk=a.prefill_chunk, kv_quant=a.kv_quant,
+            kv_backend=a.kv_backend, block_size=a.block_size,
+            num_blocks=a.num_blocks, spec_draft_len=a.spec_draft_len,
+            spec_max_ngram=a.spec_max_ngram,
             default_deadline_s=a.deadline_s, stats_url=a.stats_url))
     httpd = ThreadingHTTPServer((a.host, a.port), make_handler(service))
     print(f"serving {a.run} ({service.n_params / 1e6:.1f}M params, "

@@ -589,6 +589,40 @@ def init_cache(
     ]
 
 
+def init_paged_cache(
+    args: LlamaArgs,
+    num_blocks: int,
+    block_size: int,
+    dtype=jnp.float32,
+    quantize: bool = False,
+) -> list:
+    """Paged KV arena (vLLM-style): per layer a global pool of fixed-size
+    blocks ``[num_blocks, block_size, Hkv, Dh]`` addressed through per-
+    sequence block tables instead of a per-sequence row. Same value layout
+    as :func:`init_cache` (fp buffers, or the int8 quartet with per-
+    (position, head) scales) — only the leading dims change, so the
+    quantize/dequantize path is shared. No ``pos``: positions are
+    per-sequence host state in the serving pool."""
+    N, T, H, D = num_blocks, block_size, args.num_kv_heads, args.head_dim
+    if quantize:
+        return [
+            {
+                "k_q": jnp.zeros((N, T, H, D), jnp.int8),
+                "k_s": jnp.zeros((N, T, H, 1), jnp.float32),
+                "v_q": jnp.zeros((N, T, H, D), jnp.int8),
+                "v_s": jnp.zeros((N, T, H, 1), jnp.float32),
+            }
+            for _ in range(args.num_layers)
+        ]
+    return [
+        {
+            "k": jnp.zeros((N, T, H, D), dtype),
+            "v": jnp.zeros((N, T, H, D), dtype),
+        }
+        for _ in range(args.num_layers)
+    ]
+
+
 def loss_fn(
     params: Params,
     batch: Dict[str, jnp.ndarray],

@@ -163,8 +163,10 @@ def fused_cross_entropy_sp(
                                      with_z=True)
         return jax.lax.psum((nll, z), tuple(mesh.axis_names))
 
-    fn = jax.shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
-                       out_specs=(P(), P()), check_vma=False)
+    from ..parallel.compat import shard_map
+
+    fn = shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
+                   out_specs=(P(), P()), check_vma=False)
     nll_sum, z_sum = fn(*args)
     if with_z:
         return nll_sum, z_sum

@@ -1,0 +1,57 @@
+"""Version-tolerant ``shard_map`` resolver.
+
+The call sites in this package are written against the current
+``jax.shard_map`` API (``check_vma``, ``axis_names``). Older jax
+releases (<= 0.4.x, the pinned toolchain here) only ship the
+deprecated ``jax.experimental.shard_map.shard_map`` whose equivalent
+knobs are ``check_rep`` and ``auto`` (the complement of
+``axis_names``). This module presents the NEW surface on either
+version so every caller is already migrated when the toolchain moves
+and nothing references the experimental path outside this file.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Set
+
+import jax
+
+__all__ = ["axis_size", "shard_map"]
+
+
+def axis_size(axis_name) -> jax.Array:
+    """``jax.lax.axis_size`` with fallback for jax versions that predate
+    it (the size of a manual mesh axis is the psum of 1 over it)."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True,
+              axis_names: Optional[Set[str]] = None):
+    """``jax.shard_map`` with graceful fallback to the experimental API.
+
+    ``axis_names`` — axes the body is manual over (all mesh axes when
+    None), matching the current API; on legacy jax it is translated to
+    ``auto`` = the complement. ``check_vma`` maps to the legacy
+    ``check_rep``.
+    """
+    current = getattr(jax, "shard_map", None)
+    if current is not None:
+        kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=check_vma)
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        return current(f, **kwargs)
+    from jax.experimental.shard_map import shard_map as legacy
+
+    # axis_names is deliberately NOT translated to legacy ``auto``:
+    # partial-auto shard_map on 0.4.x emits a PartitionId instruction the
+    # CPU SPMD partitioner rejects. Running fully manual instead is
+    # correct for every caller here — bodies only use collectives over
+    # the axes their in_specs shard, and P() entries are replicated over
+    # the remaining axes (XLA reshards at the boundary if the caller
+    # passed them sharded).
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .compat import shard_map
 from .sharding_rules import _axis, batch_pspec, param_pspec
 from ..utils.tree import flatten_dict, unflatten_dict
 
@@ -334,7 +335,7 @@ def make_pipeline_loss(
             ce_rows = fused_ce.auto_chunk(B // M, S, args.vocab_size)
         layer_in_specs = jax.tree_util.tree_map(lambda _: P("pp"), layers)
         bspec = P()  # batch enters replicated w.r.t. pp (auto axes may shard)
-        sm = jax.shard_map(
+        sm = shard_map(
             partial(inner, ce_rows),
             mesh=mesh,
             in_specs=(layer_in_specs, P(), P(), P(), bspec, bspec, bspec),

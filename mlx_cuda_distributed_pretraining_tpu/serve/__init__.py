@@ -4,27 +4,33 @@ The locked HTTP server (infer/server.py) serializes every request behind
 one lock — throughput is one sequence at a time. This subsystem serves
 many requests concurrently from ONE compiled decode step:
 
-- ``kv_pool``   — slotted KV-cache pool: one preallocated
-  ``[num_slots, max_len, heads, dim]`` buffer per layer with per-slot
-  position state and allocate/free/reset (optional int8 slots via the
-  existing KV-quant path);
+- ``kv_pool``   — KV pools: the default PAGED pool (PagedAttention-style
+  global block arena + per-sequence block tables, admission by free
+  blocks, on-demand growth) and the original slotted pool (one
+  ``[num_slots, max_len, heads, dim]`` row per request); both support
+  int8 buffers via the existing KV-quant path;
 - ``batch_step`` — the jitted batched decode step (every occupied slot
   advances one token per iteration; free slots are padded/masked so the
   compiled shape never changes) plus chunked prefill that writes a new
-  request into its slot without stalling in-flight decodes;
+  request into its slot without stalling in-flight decodes. The paged
+  variants route every KV read/write through fixed-shape block tables
+  and fold prompt-lookup speculative decoding into the decode dispatch
+  (``draft_len`` drafts per row verified in ONE forward);
 - ``scheduler`` — admission queue with max-depth rejection (429),
-  per-request deadlines/max-token limits, iteration-level join/evict;
+  per-request deadlines/max-token limits, iteration-level join/evict,
+  and recompute-on-resume preemption for arena exhaustion;
 - ``engine``    — the background engine thread tying it together, with
   per-iteration metrics published through the obs stats protocol.
 """
 
 from .engine import BatchEngine, EngineConfig, QueueFullError
-from .kv_pool import SlotKVPool
+from .kv_pool import PagedKVPool, SlotKVPool
 from .scheduler import Request, Scheduler
 
 __all__ = [
     "BatchEngine",
     "EngineConfig",
+    "PagedKVPool",
     "QueueFullError",
     "Request",
     "Scheduler",

@@ -1,6 +1,6 @@
 """Jitted steps for the continuous-batching engine.
 
-Two compiled functions drive the whole engine:
+Slotted backend — two compiled functions drive the whole engine:
 
 - ``decode_step`` advances EVERY pool slot one token in one dispatch.
   Each row carries its own position (requests join mid-flight at
@@ -25,6 +25,18 @@ mask), so batch-1 greedy output is token-identical to ``generate_text``
 same per-request rng chain (split-then-sample per token) as
 ``generate_step``, vmapped over rows.
 
+Paged backend — the same engine driven through block tables
+(``paged_prefill_step`` / ``paged_decode_step``): every KV read/write is
+routed through a fixed-shape ``[num_seqs, max_blocks]`` table, so the
+compiled step is identical regardless of which physical blocks a
+sequence holds. ``paged_decode_step`` additionally folds in-batch
+speculative decoding into the decode dispatch: with ``draft_len = k``
+every row carries ``[last_token, d1..dk]``, ONE forward verifies all
+drafts for all rows, and the host commits only accepted prefixes by
+advancing row lengths — rejected tail positions are never referenced
+by any block table, so there is no rollback copy. ``draft_len = 0`` is
+plain paged decode.
+
 Like infer/generate.py, compiled steps are cached per (args, shape
 bucket); attend lengths are power-of-two buckets so a long-serving
 engine compiles O(log max_len) variants, not one per position.
@@ -38,7 +50,7 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..infer.generate import _attend_bucket, _round_up
+from ..infer.generate import _attend_bucket, _round_up, _spec_accept_one
 from ..models import llama
 from ..ops.attention import reference_attention
 
@@ -280,6 +292,230 @@ def prefill_step(args: llama.LlamaArgs, chunk: int, attend_len: int,
             new_cache.append(new_layer)
             out = reference_attention(q, ck[:, :attend_len],
                                       cv[:, :attend_len], explicit_mask=mask)
+            x = x + llama._linear(out.reshape(1, chunk, Hq * Dh), pa["wo"])
+            x = x + _ffn(p, llama.rms_norm(x, p["ffn_norm"]["weight"],
+                                           args.rms_norm_eps), args)
+        if not with_logits:
+            return new_cache, None
+        x = llama.rms_norm(x, params["norm"]["weight"], args.rms_norm_eps)
+        logits = _project_logits(params, x, args)  # [1, C, V]
+        last = jax.lax.dynamic_slice_in_dim(logits, last_idx, 1, axis=1)
+        return new_cache, last[:, 0, :]  # [1, V]
+
+    _STEP_CACHE[key_] = step
+    return step
+
+
+def _paged_write(layer_cache, k, v, blocks, offs):
+    """Scatter K/V ``[B, S, H, D]`` into the paged arena at per-position
+    block/offset coordinates ``[B, S]``. Real rows own their blocks, so
+    their destinations are unique; masked/padded positions all target the
+    shared junk block 0 (collisions there are harmless by construction).
+    Returns the new layer cache."""
+    B, S, H, D = k.shape
+    bi = blocks.reshape(-1)
+    oi = offs.reshape(-1)
+    if "k_q" in layer_cache:
+        kq, ks = llama._quantize_kv(k)
+        vq, vs = llama._quantize_kv(v)
+        return {
+            "k_q": layer_cache["k_q"].at[bi, oi].set(kq.reshape(B * S, H, D)),
+            "k_s": layer_cache["k_s"].at[bi, oi].set(ks.reshape(B * S, H, 1)),
+            "v_q": layer_cache["v_q"].at[bi, oi].set(vq.reshape(B * S, H, D)),
+            "v_s": layer_cache["v_s"].at[bi, oi].set(vs.reshape(B * S, H, 1)),
+        }
+    dt = layer_cache["k"].dtype
+    return {
+        "k": layer_cache["k"].at[bi, oi].set(k.reshape(B * S, H, D).astype(dt)),
+        "v": layer_cache["v"].at[bi, oi].set(v.reshape(B * S, H, D).astype(dt)),
+    }
+
+
+def _paged_gather(layer_cache, tables, nb):
+    """Gather each sequence's first ``nb`` blocks as contiguous K/V
+    ``[B, nb * block_size, H, D]``. int8 arenas dequantize AFTER the
+    gather, so only the attended window is ever expanded to fp — the
+    paged analogue of the slotted path's ``[:, :attend_len]`` slice."""
+    idx = tables[:, :nb]  # [B, nb]
+    if "k_q" in layer_cache:
+        keys = layer_cache["k_q"][idx].astype(jnp.float32) \
+            * layer_cache["k_s"][idx]
+        values = layer_cache["v_q"][idx].astype(jnp.float32) \
+            * layer_cache["v_s"][idx]
+    else:
+        keys = layer_cache["k"][idx]
+        values = layer_cache["v"][idx]
+    B, _, T, H, D = keys.shape
+    return keys.reshape(B, nb * T, H, D), values.reshape(B, nb * T, H, D)
+
+
+def paged_decode_step(args: llama.LlamaArgs, draft_len: int, attend_len: int,
+                      table_width: int, block_size: int, raw: bool = False):
+    """Compiled once per (args, draft_len, attend bucket, table shape).
+
+    One dispatch advances every pool row AND verifies its drafts:
+    ``step(params, cache, tokens, pos, tables, temps, keys)`` where
+
+    - ``tokens [B, S] int32``, S = draft_len + 1 — per row the last
+      emitted (not yet written) token followed by its prompt-lookup
+      drafts; masked rows carry zeros.
+    - ``pos [B] int32`` — first write position per row (its written
+      length); 0 for masked rows, whose table rows map every entry to
+      the junk block.
+    - ``tables [B, W] int32`` — block tables (W static = table_width).
+    - ``temps [B] f32``, ``keys [B, 2] u32`` — as decode_step.
+
+    Returns ``(cache, preds, lp_preds, accept, alts, lp_draft, lp_alt,
+    bonus, lp_bonus, new_keys)``: the greedy verify outputs (``preds
+    [B, S]`` = argmax at every position, with raw-logits logprobs, the
+    same contract as infer/generate._verify_step) plus the point-mass
+    sampled-acceptance outputs (the contract of _verify_step_sampled,
+    vmapped over rows with per-row temperature). The host picks per row:
+    greedy rows use preds, sampled rows use accept/alts/bonus. With
+    ``draft_len == 0`` the S axis is 1 and this is plain paged decode.
+
+    ``raw=True`` returns the un-jitted function (for embedding in a
+    caller's own jit, e.g. the bench decode chain).
+    """
+    key_ = ("paged_decode", args, draft_len, attend_len, table_width,
+            block_size, raw)
+    if key_ in _STEP_CACHE:
+        return _STEP_CACHE[key_]
+
+    if attend_len % block_size:
+        raise ValueError(f"attend_len {attend_len} not a multiple of "
+                         f"block_size {block_size}")
+    Hq, Hkv, Dh = args.num_heads, args.num_kv_heads, args.head_dim
+    S = draft_len + 1
+    nb = attend_len // block_size
+
+    def step(params, cache, tokens, pos, tables, temps, keys):
+        B = tokens.shape[0]
+        positions = pos[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+        # Write coordinates. Positions past the table extent are redirected
+        # to the junk block — the engine clamps token budgets so real rows
+        # never overflow; this guard keeps an off-by-one from silently
+        # corrupting a clamped-index neighbour block.
+        safe = positions < table_width * block_size
+        pc = jnp.where(safe, positions, 0)
+        blocks = jnp.take_along_axis(tables, pc // block_size, axis=1)
+        blocks = jnp.where(safe, blocks, 0)
+        offs = pc % block_size
+        x = params["tok_embeddings"]["weight"][tokens]  # [B, S, D]
+        k_idx = jnp.arange(attend_len, dtype=jnp.int32)
+        # verify position s attends everything at or before pos + s — its
+        # own KV is written first, so drafts see their accepted prefix
+        mask = (k_idx[None, None, :] <= positions[:, :, None])  # [B, S, L]
+        new_cache = []
+        for p, layer_cache in zip(params["layers"], cache):
+            h = llama.rms_norm(x, p["attention_norm"]["weight"],
+                               args.rms_norm_eps)
+            pa = p["attention"]
+            q = llama._linear(h, pa["wq"]).reshape(B, S, Hq, Dh)
+            k = llama._linear(h, pa["wk"]).reshape(B, S, Hkv, Dh)
+            v = llama._linear(h, pa["wv"]).reshape(B, S, Hkv, Dh)
+            q = _rope_rows(q, positions, args)
+            k = _rope_rows(k, positions, args)
+            new_layer = _paged_write(layer_cache, k, v, blocks, offs)
+            new_cache.append(new_layer)
+            ck, cv = _paged_gather(new_layer, tables, nb)
+            out = reference_attention(
+                q, ck, cv, explicit_mask=mask[:, None, None, :, :])
+            x = x + llama._linear(out.reshape(B, S, Hq * Dh), pa["wo"])
+            x = x + _ffn(p, llama.rms_norm(x, p["ffn_norm"]["weight"],
+                                           args.rms_norm_eps), args)
+        x = llama.rms_norm(x, params["norm"]["weight"], args.rms_norm_eps)
+        logits = _project_logits(params, x, args)  # [B, S, V]
+        lp_all = jax.nn.log_softmax(logits, axis=-1)
+        preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, S]
+        lp_preds = jnp.take_along_axis(lp_all, preds[..., None],
+                                       axis=-1)[..., 0]
+        split = jax.vmap(lambda kk: jax.random.split(kk, 2))(keys)
+        new_keys, subs = split[:, 0], split[:, 1]
+
+        def row(sub, lg, t, drafts):
+            # Point-mass speculative sampling per row (the vmapped analogue
+            # of infer/generate._verify_step_sampled, with the row's own
+            # temperature). Greedy (t == 0) rows still trace this — their
+            # outputs are simply never read host-side.
+            probs = jax.nn.softmax(lg / jnp.maximum(t, 1e-6), axis=-1)
+            lp = jnp.log(probs + 1e-30)
+            ks_ = jax.random.split(sub, S)
+            if draft_len:
+                accept, alts = jax.vmap(_spec_accept_one)(
+                    ks_[:draft_len], probs[:draft_len], drafts)
+                gather = lambda rows, i: jnp.take_along_axis(
+                    rows, i[:, None], axis=-1)[:, 0]
+                lp_draft = gather(lp[:draft_len], drafts)
+                lp_alt = gather(lp[:draft_len], alts)
+            else:
+                accept = jnp.zeros((0,), bool)
+                alts = jnp.zeros((0,), jnp.int32)
+                lp_draft = jnp.zeros((0,), jnp.float32)
+                lp_alt = jnp.zeros((0,), jnp.float32)
+            bonus = jax.random.categorical(ks_[draft_len], lp[draft_len])
+            return (accept, alts.astype(jnp.int32), lp_draft, lp_alt,
+                    bonus.astype(jnp.int32), lp[draft_len, bonus])
+
+        accept, alts, lp_draft, lp_alt, bonus, lp_bonus = jax.vmap(row)(
+            subs, logits, temps, tokens[:, 1:])
+        return (new_cache, preds, lp_preds, accept, alts, lp_draft, lp_alt,
+                bonus, lp_bonus, new_keys)
+
+    fn = step if raw else partial(jax.jit, donate_argnums=_donate_cache())(step)
+    _STEP_CACHE[key_] = fn
+    return fn
+
+
+def paged_prefill_step(args: llama.LlamaArgs, chunk: int, attend_len: int,
+                       table_width: int, block_size: int, with_logits: bool):
+    """Paged analogue of ``prefill_step``: writes one ``chunk`` of one
+    request's prompt through its block table.
+
+    Returns ``step(params, cache, tokens, table, pos, last_idx)`` →
+    ``(cache, last_logits [1, V] | None)``. ``table [W] int32`` is the
+    sequence's block-table row; pad junk past the true prompt length
+    lands either in the request's own tail blocks (overwritten by decode
+    before it is attendable) or, past the mapped extent, in the shared
+    junk block."""
+    key_ = ("paged_prefill", args, chunk, attend_len, table_width,
+            block_size, with_logits)
+    if key_ in _STEP_CACHE:
+        return _STEP_CACHE[key_]
+
+    if attend_len % block_size:
+        raise ValueError(f"attend_len {attend_len} not a multiple of "
+                         f"block_size {block_size}")
+    Hq, Hkv, Dh = args.num_heads, args.num_kv_heads, args.head_dim
+    nb = attend_len // block_size
+
+    @partial(jax.jit, donate_argnums=_donate_cache())
+    def step(params, cache, tokens, table, pos, last_idx):
+        x = params["tok_embeddings"]["weight"][tokens][None]  # [1, C, D]
+        positions = jnp.arange(chunk, dtype=jnp.int32) + pos  # [C]
+        cos, sin = llama.rope_cos_sin(positions, Dh, args.rope_theta,
+                                      args.rope_scaling_factor)
+        safe = positions < table_width * block_size
+        pc = jnp.where(safe, positions, 0)
+        blocks = jnp.where(safe, table[pc // block_size], 0)[None]  # [1, C]
+        offs = (pc % block_size)[None]
+        k_idx = jnp.arange(attend_len, dtype=jnp.int32)
+        mask = (k_idx[None, :] <= positions[:, None]) \
+            & (k_idx[None, :] < pos + chunk)  # [C, L]
+        new_cache = []
+        for p, layer_cache in zip(params["layers"], cache):
+            h = llama.rms_norm(x, p["attention_norm"]["weight"],
+                               args.rms_norm_eps)
+            pa = p["attention"]
+            q = llama._linear(h, pa["wq"]).reshape(1, chunk, Hq, Dh)
+            k = llama._linear(h, pa["wk"]).reshape(1, chunk, Hkv, Dh)
+            v = llama._linear(h, pa["wv"]).reshape(1, chunk, Hkv, Dh)
+            q = llama.apply_rope(q, cos, sin, args.rope_traditional)
+            k = llama.apply_rope(k, cos, sin, args.rope_traditional)
+            new_layer = _paged_write(layer_cache, k, v, blocks, offs)
+            new_cache.append(new_layer)
+            ck, cv = _paged_gather(new_layer, table[None], nb)
+            out = reference_attention(q, ck, cv, explicit_mask=mask)
             x = x + llama._linear(out.reshape(1, chunk, Hq * Dh), pa["wo"])
             x = x + _ffn(p, llama.rms_norm(x, p["ffn_norm"]["weight"],
                                            args.rms_norm_eps), args)

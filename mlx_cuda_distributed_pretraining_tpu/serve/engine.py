@@ -29,9 +29,10 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from . import batch_step
-from .kv_pool import SlotKVPool
+from .kv_pool import PagedKVPool, SlotKVPool
 from .scheduler import (
     DECODE,
+    DONE,
     PREFILL,
     QueueFullError,
     Request,
@@ -43,13 +44,18 @@ __all__ = ["BatchEngine", "EngineConfig", "QueueFullError"]
 
 @dataclasses.dataclass
 class EngineConfig:
-    """Slot/queue knobs (configs/serve-sample.yaml documents each)."""
+    """Pool/queue knobs (configs/serve-sample.yaml documents each)."""
 
     num_slots: int = 8          # decode batch width = max concurrent requests
-    max_len: int = 2048         # per-slot KV length (last position reserved)
+    max_len: int = 2048         # per-request KV length bound
     max_queue: int = 32         # admission queue depth; beyond -> 429
     prefill_chunk: int = 256    # prompt tokens written per iteration
-    kv_quant: bool = False      # int8 pool slots (same path as --kv-quant)
+    kv_quant: bool = False      # int8 pool buffers (same path as --kv-quant)
+    kv_backend: str = "paged"   # "paged" (block tables) | "slotted" (PR 1)
+    block_size: int = 32        # paged: tokens per KV block (power of two)
+    num_blocks: int = 0         # paged: KV arena size; 0 = slotted-equivalent
+    spec_draft_len: int = 0     # paged: drafts verified per decode step; 0 off
+    spec_max_ngram: int = 3     # paged: prompt-lookup suffix n-gram bound
     default_deadline_s: Optional[float] = None  # per-request unless overridden
     stats_url: Optional[str] = None  # ws://host:port of obs stats server
     stats_interval_s: float = 1.0
@@ -78,8 +84,24 @@ class BatchEngine:
             raise ValueError(
                 f"max_len {self.cfg.max_len} exceeds the model's "
                 f"max_position_embeddings {args.max_position_embeddings}")
-        self.pool = SlotKVPool(args, self.cfg.num_slots, self.cfg.max_len,
-                               quantize=self.cfg.kv_quant)
+        if self.cfg.kv_backend == "paged":
+            self.pool = PagedKVPool(
+                args, self.cfg.num_slots, self.cfg.max_len,
+                block_size=self.cfg.block_size,
+                num_blocks=self.cfg.num_blocks,
+                quantize=self.cfg.kv_quant)
+        elif self.cfg.kv_backend == "slotted":
+            if self.cfg.spec_draft_len:
+                raise ValueError(
+                    "spec_draft_len requires kv_backend='paged' (in-batch "
+                    "speculation commits through block tables)")
+            self.pool = SlotKVPool(args, self.cfg.num_slots, self.cfg.max_len,
+                                   quantize=self.cfg.kv_quant)
+        else:
+            raise ValueError(f"unknown kv_backend {self.cfg.kv_backend!r} "
+                             "(expected 'paged' or 'slotted')")
+        self.draft_len = (max(0, int(self.cfg.spec_draft_len))
+                          if self.cfg.kv_backend == "paged" else 0)
         self.scheduler = Scheduler(max_queue=self.cfg.max_queue)
         self.chunk = max(1, min(self.cfg.prefill_chunk, self.cfg.max_len))
         self._stop = threading.Event()
@@ -107,8 +129,29 @@ class BatchEngine:
             "serve_requests_total", "requests by outcome")
         self._mc_iterations = reg.counter(
             "serve_iterations_total", "engine loop iterations")
+        # Paged-pool + speculative-decode observability (gauges read 0 on
+        # the slotted backend; the /metrics surface is backend-stable).
+        self._mg_blocks_used = reg.gauge(
+            "serve_kv_blocks_used", "paged KV blocks currently mapped")
+        self._mg_blocks_free = reg.gauge(
+            "serve_kv_blocks_free", "paged KV blocks free")
+        self._mg_free_watermark = reg.gauge(
+            "serve_kv_free_block_watermark",
+            "minimum free blocks over the publish window")
+        self._mg_fragmentation = reg.gauge(
+            "serve_kv_fragmentation",
+            "fraction of mapped KV positions holding no live token")
+        self._mc_spec = reg.counter(
+            "serve_spec_tokens_total",
+            "speculative draft tokens by outcome (proposed/accepted)")
+        self._mg_spec_rate = reg.gauge(
+            "serve_spec_acceptance_rate",
+            "accepted/proposed draft tokens over the publish window")
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         self._m_last = {"admitted": 0, "rejected": 0, "evicted": 0,
-                        "completed": 0, "iterations": 0}
+                        "completed": 0, "preempted": 0, "iterations": 0,
+                        "spec_proposed": 0, "spec_accepted": 0}
         self._metrics_server = None
 
     # -- lifecycle -----------------------------------------------------------
@@ -175,11 +218,16 @@ class BatchEngine:
 
         P = len(ids)
         padded = batch_step.round_up(max(P, 1), self.chunk)
-        if padded > self.pool.max_len or P > self.pool.capacity:
+        # Spec headroom: a verify window writes up to draft_len positions
+        # past the last committed token, so the budget clamp reserves them
+        # (mirrors generate_speculative's `+ k` on cache_len).
+        k = self.draft_len
+        if padded > self.pool.max_len or P > self.pool.capacity - k:
             raise ValueError(
                 f"prompt of {P} tokens cannot fit a {self.pool.max_len}-"
-                f"token slot (chunked prefill pads to {padded})")
-        max_tokens = max(1, min(int(max_tokens), self.pool.capacity - P))
+                f"token sequence (chunked prefill pads to {padded}"
+                + (f", spec reserves {k}" if k else "") + ")")
+        max_tokens = max(1, min(int(max_tokens), self.pool.capacity - P - k))
         req = Request(ids, max_tokens, temperature=temperature, seed=seed,
                       deadline_s=(deadline_s if deadline_s is not None
                                   else self.cfg.default_deadline_s),
@@ -215,7 +263,22 @@ class BatchEngine:
             "rejected": s.rejected,
             "evicted": s.evicted,
             "completed": s.completed,
+            "preempted": s.preempted,
+            "kv_backend": self.pool.kind,
         }
+        if self.pool.kind == "paged":
+            snap.update({
+                "kv_blocks_used": self.pool.blocks_in_use,
+                "kv_blocks_free": self.pool.free_blocks,
+                "kv_fragmentation": round(self.pool.fragmentation(), 4),
+            })
+        if self.draft_len:
+            snap.update({
+                "spec_proposed": self._spec_proposed,
+                "spec_accepted": self._spec_accepted,
+                "spec_acceptance_rate": round(
+                    self._spec_accepted / max(self._spec_proposed, 1), 4),
+            })
         snap.update(self._metrics)
         return snap
 
@@ -236,15 +299,33 @@ class BatchEngine:
         self._mg_occupancy.set(self.pool.num_used)
         self._mg_queue.set(self.scheduler.queue_depth())
         self._mg_tok_s.set(tok_s)
+        if self.pool.kind == "paged":
+            self._mg_blocks_used.set(self.pool.blocks_in_use)
+            self._mg_blocks_free.set(self.pool.free_blocks)
+            self._mg_free_watermark.set(self.pool.read_watermark())
+            self._mg_fragmentation.set(self.pool.fragmentation())
         cur = {"admitted": self.scheduler.admitted,
                "rejected": self.scheduler.rejected,
                "evicted": self.scheduler.evicted,
                "completed": self.scheduler.completed,
-               "iterations": self.iterations}
-        for k in ("admitted", "rejected", "evicted", "completed"):
+               "preempted": self.scheduler.preempted,
+               "iterations": self.iterations,
+               "spec_proposed": self._spec_proposed,
+               "spec_accepted": self._spec_accepted}
+        for k in ("admitted", "rejected", "evicted", "completed",
+                  "preempted"):
             d = cur[k] - self._m_last[k]
             if d > 0:
                 self._mc_requests.inc(d, outcome=k)
+        for k, kind in (("spec_proposed", "proposed"),
+                        ("spec_accepted", "accepted")):
+            d = cur[k] - self._m_last[k]
+            if d > 0:
+                self._mc_spec.inc(d, kind=kind)
+        dp = cur["spec_proposed"] - self._m_last["spec_proposed"]
+        if dp > 0:
+            self._mg_spec_rate.set(
+                (cur["spec_accepted"] - self._m_last["spec_accepted"]) / dp)
         d = cur["iterations"] - self._m_last["iterations"]
         if d > 0:
             self._mc_iterations.inc(d)
@@ -293,20 +374,38 @@ class BatchEngine:
         # (stale slot contents are unattendable once the slot is reused).
         pass
 
+    def _attend(self, n: int) -> int:
+        """Attend bucket for ``n`` positions, aligned to block bounds on
+        the paged backend (gather reads whole blocks)."""
+        pool = self.pool
+        b = batch_step.attend_bucket(n, pool.max_len)
+        if pool.kind == "paged":
+            b = min(batch_step.round_up(b, pool.block_size), pool.max_len)
+        return b
+
     def _prefill_chunk(self, req: Request) -> None:
         pool, C = self.pool, self.chunk
-        P = len(req.prompt_ids)
+        source = req.prefill_source()
+        P = len(source)
         start = req.prefilled
         n = min(C, P - start)
         final = start + n >= P
         toks = np.zeros(C, np.int32)
-        toks[:n] = req.prompt_ids[start:start + n]
-        attend = batch_step.attend_bucket(start + C, pool.max_len)
-        step = batch_step.prefill_step(self.args, C, attend,
-                                       with_logits=final)
-        cache, last_logits = step(self.params, pool.cache, toks,
-                                  np.int32(req.slot), np.int32(start),
-                                  np.int32(max(n - 1, 0)))
+        toks[:n] = source[start:start + n]
+        attend = self._attend(start + C)
+        if pool.kind == "paged":
+            step = batch_step.paged_prefill_step(
+                self.args, C, attend, pool.max_blocks, pool.block_size,
+                with_logits=final)
+            cache, last_logits = step(self.params, pool.cache, toks,
+                                      pool.tables[req.slot], np.int32(start),
+                                      np.int32(max(n - 1, 0)))
+        else:
+            step = batch_step.prefill_step(self.args, C, attend,
+                                           with_logits=final)
+            cache, last_logits = step(self.params, pool.cache, toks,
+                                      np.int32(req.slot), np.int32(start),
+                                      np.int32(max(n - 1, 0)))
         pool.cache = cache
         req.prefilled = start + n
         pool.lengths[req.slot] = min(start + n, P)
@@ -316,11 +415,15 @@ class BatchEngine:
         tok, lp, key = batch_step.sample_token(last_logits, req.temperature,
                                                req.rng_key)
         req.rng_key = np.asarray(key)
-        req.first_token_at = time.monotonic()
-        self._last_ttft_ms = (req.first_token_at - req.submitted_at) * 1e3
+        if req.first_token_at is None:  # unset on preemption re-prefill
+            req.first_token_at = time.monotonic()
+            self._last_ttft_ms = (req.first_token_at - req.submitted_at) * 1e3
         self._emit(req, tok, lp)
 
     def _decode(self, dec: List[Request]) -> None:
+        if self.pool.kind == "paged":
+            self._decode_paged(dec)
+            return
         pool = self.pool
         B = pool.num_slots
         tokens = np.zeros(B, np.int32)
@@ -346,6 +449,98 @@ class BatchEngine:
             pool.lengths[r.slot] += 1
             r.rng_key = keys_h[r.slot]
             self._emit(r, int(tok_h[r.slot]), float(lp_h[r.slot]))
+
+    def _grow_or_preempt(self, dec: List[Request], S: int) -> List[Request]:
+        """Map the blocks each decoding row's next verify window needs.
+        On arena exhaustion, preempt the YOUNGEST decoding request
+        (recompute-on-resume) and retry — oldest requests always make
+        progress, so the engine cannot livelock on a full arena."""
+        pool, sched = self.pool, self.scheduler
+        active = sorted(dec, key=lambda r: r.id)  # oldest first
+        i = 0
+        while i < len(active):
+            r = active[i]
+            if pool.ensure_capacity(r.slot, pool.lengths[r.slot] + S):
+                i += 1
+                continue
+            victim = active.pop()
+            sched.preempt(pool, victim)
+            # victim == r: it was the youngest itself; it re-queues.
+        return active
+
+    def _decode_paged(self, dec: List[Request]) -> None:
+        import jax
+
+        from ..infer.generate import _prompt_lookup_draft
+
+        pool, cfg = self.pool, self.cfg
+        k = self.draft_len
+        S = k + 1
+        dec = self._grow_or_preempt(dec, S)
+        if not dec:
+            return
+        B = pool.num_slots
+        # Masked rows: token 0 at position 0 — their (freed) table rows map
+        # every entry to the shared junk block, so their writes land there.
+        tokens = np.zeros((B, S), np.int32)
+        pos = np.zeros(B, np.int32)
+        temps = np.zeros(B, np.float32)
+        keys = np.zeros((B, 2), np.uint32)
+        drafts: Dict[int, List[int]] = {}
+        for r in dec:
+            d = (_prompt_lookup_draft(r.prompt_ids + r.tokens, k,
+                                      cfg.spec_max_ngram) if k else [])
+            drafts[r.slot] = d
+            tokens[r.slot] = [r.last_token] + d
+            pos[r.slot] = pool.lengths[r.slot]
+            temps[r.slot] = r.temperature
+            keys[r.slot] = r.rng_key
+        bucket = self._attend(
+            int(pos[[r.slot for r in dec]].max()) + S)
+        step = batch_step.paged_decode_step(self.args, k, bucket,
+                                            pool.max_blocks, pool.block_size)
+        out = step(self.params, pool.cache, tokens, pos, pool.tables,
+                   temps, keys)
+        pool.cache = out[0]
+        # ONE blocking transfer for every small output.
+        (preds, lp_preds, accept, alts, lp_draft, lp_alt,
+         bonus, lp_bonus, new_keys) = jax.device_get(out[1:])
+        for r in dec:
+            s = r.slot
+            p0 = pool.lengths[s]
+            d = drafts[s]
+            r.rng_key = np.asarray(new_keys[s])
+            if r.temperature > 0.0:
+                m = 0
+                while m < k and accept[s][m]:
+                    m += 1
+                if m < k:
+                    emitted = d[:m] + [int(alts[s][m])]
+                    lps = [float(x) for x in lp_draft[s][:m]] \
+                        + [float(lp_alt[s][m])]
+                else:
+                    emitted = d + [int(bonus[s])]
+                    lps = [float(x) for x in lp_draft[s][:k]] \
+                        + [float(lp_bonus[s])]
+            else:
+                m = 0
+                while m < k and d[m] == int(preds[s][m]):
+                    m += 1
+                # m accepted drafts + the model's own next token at m
+                emitted = d[:m] + [int(preds[s][m])]
+                lps = [float(x) for x in lp_preds[s][:m + 1]]
+            self._spec_proposed += k
+            self._spec_accepted += m
+            for t, lpv in zip(emitted, lps):
+                self._emit(r, t, lpv)
+                if r.state == DONE:
+                    break
+            if r.state != DONE:
+                # Committed prefix only: the verify wrote S positions, but
+                # lengths advance past just the accepted ones — rejected
+                # tail KV is never referenced and the next window
+                # overwrites it (no rollback copies).
+                pool.lengths[s] = p0 + len(emitted)
 
     def _emit(self, req: Request, tok: int, lp: float) -> None:
         """Account one sampled token: stop/length bookkeeping mirrors

@@ -1,4 +1,5 @@
-"""Slotted KV-cache pool for continuous batching.
+"""KV-cache pools for continuous batching: slotted (fixed row per
+request) and paged (vLLM-style block tables over a global arena).
 
 One preallocated cache — per layer ``{"k": [num_slots, max_len, Hkv, Dh],
 "v": ...}`` (or the int8 ``k_q/k_s/v_q/v_s`` quartet from the existing
@@ -29,6 +30,8 @@ from ..models import llama
 
 class SlotKVPool:
     """Fixed pool of KV-cache slots with per-slot length state."""
+
+    kind = "slotted"
 
     def __init__(self, args: llama.LlamaArgs, num_slots: int, max_len: int,
                  dtype=None, quantize: bool = False):
@@ -72,13 +75,19 @@ class SlotKVPool:
         return self.num_used / self.num_slots
 
     # -- slot lifecycle ------------------------------------------------------
-    def allocate(self) -> Optional[int]:
-        """Claim a free slot (resets its length); None when the pool is full."""
+    def allocate(self, need_tokens: int = 0) -> Optional[int]:
+        """Claim a free slot (resets its length); None when the pool is full.
+        ``need_tokens`` is part of the shared pool interface — a slot always
+        holds ``capacity`` tokens, so it is ignored here."""
         if not self._free:
             return None
         slot = self._free.pop()
         self.lengths[slot] = 0
         return slot
+
+    def ensure_capacity(self, slot: int, length: int) -> bool:
+        """Shared pool interface: a slot's full extent is preallocated."""
+        return length <= self.max_len
 
     def free(self, slot: int) -> None:
         if not 0 <= slot < self.num_slots:
@@ -96,3 +105,188 @@ class SlotKVPool:
         """Longest written length among ``slots`` — drives the attend bucket
         of the next batched decode step."""
         return max((self.lengths[s] for s in slots), default=0)
+
+
+class PagedKVPool:
+    """Paged KV pool (PagedAttention, Kwon et al. 2023): one global arena of
+    fixed-size blocks per layer shared by every sequence, addressed through
+    per-sequence block tables.
+
+    The slotted pool sizes HBM for ``num_slots x max_len`` worst-case rows;
+    here a sequence only holds the blocks covering its *written* length, so
+    the same KV budget admits as many concurrent sequences as their actual
+    lengths fit. Admission is gated on free *blocks* (plus a free batch
+    row), and blocks are mapped on demand as decode advances.
+
+    Layout and invariants:
+
+    - arena: per layer ``{"k": [num_blocks+1, block_size, Hkv, Dh], "v"}``
+      (or the int8 ``k_q/k_s/v_q/v_s`` quartet) from
+      ``llama.init_paged_cache``. Logical position ``p`` of sequence ``s``
+      lives at ``(tables[s][p // block_size], p % block_size)``.
+    - physical block 0 is a reserved shared junk block, never allocated:
+      unmapped table entries point at it, and freed/masked rows (which the
+      fixed-shape batched step still writes every iteration) scatter their
+      junk there. This replaces the slotted pool's reserved-last-position
+      trick, so usable length is the full table extent minus the one
+      position needed to write the final emitted token's successor.
+    - alloc/free are O(1) list ops on ``_free_blocks``; freeing never zeroes
+      data — the validity mask (k_idx <= row position) makes stale entries
+      unattendable, exactly as in the slotted pool.
+    - ``fragmentation()`` is internal waste: 1 - used_tokens / (blocks_in_use
+      * block_size). ``free_watermark`` tracks the minimum free-block count
+      since the last ``read_watermark()`` — the headroom metric that says
+      how close the arena came to exhaustion.
+    """
+
+    kind = "paged"
+
+    def __init__(self, args: llama.LlamaArgs, num_seqs: int, max_len: int,
+                 block_size: int = 32, num_blocks: int = 0,
+                 dtype=None, quantize: bool = False):
+        import jax.numpy as jnp
+        import numpy as np
+
+        if num_seqs < 1:
+            raise ValueError(f"num_seqs must be >= 1, got {num_seqs}")
+        if max_len < 2:
+            raise ValueError(f"max_len must be >= 2, got {max_len}")
+        if block_size < 1 or (block_size & (block_size - 1)) != 0:
+            raise ValueError(
+                f"block_size must be a power of two, got {block_size}")
+        if max_len % block_size != 0:
+            raise ValueError(
+                f"max_len ({max_len}) must be a multiple of block_size "
+                f"({block_size}) so attend buckets align to block bounds")
+        self.args = args
+        self.num_slots = num_seqs  # batch rows; name shared with SlotKVPool
+        self.max_len = max_len
+        self.block_size = block_size
+        self.max_blocks = max_len // block_size  # table width per sequence
+        if num_blocks <= 0:
+            # Default: same token capacity as the slotted pool would have.
+            num_blocks = num_seqs * self.max_blocks
+        self.num_blocks = num_blocks
+        self.quantize = quantize
+        # +1: physical block 0 is the reserved junk block.
+        self.cache = llama.init_paged_cache(
+            args, num_blocks + 1, block_size,
+            dtype=dtype or jnp.float32, quantize=quantize)
+        self.tables = np.zeros((num_seqs, self.max_blocks), dtype=np.int32)
+        self.lengths: List[int] = [0] * num_seqs
+        self._mapped: List[int] = [0] * num_seqs  # blocks mapped per row
+        self._free_rows: List[int] = list(range(num_seqs - 1, -1, -1))
+        self._free_blocks: List[int] = list(range(num_blocks, 0, -1))
+        self._watermark = num_blocks
+
+    # -- capacity ------------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        """Longest sequence a row's table can address, leaving one position
+        for the successor of the final emitted token (whose KV is written by
+        the decode step that samples the next token)."""
+        return self.max_len - 1
+
+    @property
+    def num_free(self) -> int:
+        """Free batch rows (the admission gate also checks free blocks)."""
+        return len(self._free_rows)
+
+    @property
+    def num_used(self) -> int:
+        return self.num_slots - len(self._free_rows)
+
+    def occupancy(self) -> float:
+        return self.num_used / self.num_slots
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free_blocks)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.num_blocks - len(self._free_blocks)
+
+    def blocks_for(self, tokens: int) -> int:
+        return -(-tokens // self.block_size) if tokens > 0 else 0
+
+    def fragmentation(self) -> float:
+        """Internal fragmentation: fraction of mapped KV positions holding no
+        live token (0.0 = every mapped block full)."""
+        mapped_tokens = self.blocks_in_use * self.block_size
+        if mapped_tokens == 0:
+            return 0.0
+        used = sum(self.lengths[s] for s in range(self.num_slots)
+                   if s not in self._free_rows)
+        return 1.0 - min(used, mapped_tokens) / mapped_tokens
+
+    def read_watermark(self) -> int:
+        """Minimum free-block count since the previous call (then reset)."""
+        w = self._watermark
+        self._watermark = len(self._free_blocks)
+        return w
+
+    def _note_free_level(self) -> None:
+        if len(self._free_blocks) < self._watermark:
+            self._watermark = len(self._free_blocks)
+
+    # -- sequence lifecycle --------------------------------------------------
+    def allocate(self, need_tokens: int = 0) -> Optional[int]:
+        """Claim a batch row and map enough blocks for ``need_tokens``
+        (the prompt). None when no row is free OR the arena cannot cover
+        the request — admission is gated on actual free blocks."""
+        need = self.blocks_for(need_tokens)
+        if not self._free_rows or need > len(self._free_blocks):
+            return None
+        seq = self._free_rows.pop()
+        self.lengths[seq] = 0
+        self.tables[seq, :] = 0
+        for i in range(need):
+            self.tables[seq, i] = self._free_blocks.pop()
+        self._mapped[seq] = need
+        self._note_free_level()
+        return seq
+
+    def ensure_capacity(self, seq: int, length: int) -> bool:
+        """Map blocks on demand so positions ``[0, length)`` are addressable.
+        False (no state change) when the arena is exhausted — the caller
+        decides whether to preempt."""
+        if length > self.max_len:
+            return False
+        need = self.blocks_for(length)
+        grow = need - self._mapped[seq]
+        if grow <= 0:
+            return True
+        if grow > len(self._free_blocks):
+            return False
+        for i in range(self._mapped[seq], need):
+            self.tables[seq, i] = self._free_blocks.pop()
+        self._mapped[seq] = need
+        self._note_free_level()
+        return True
+
+    def free(self, seq: int) -> None:
+        """Return the row and all its mapped blocks; O(mapped) list appends."""
+        if not 0 <= seq < self.num_slots:
+            raise ValueError(f"seq {seq} out of range 0..{self.num_slots - 1}")
+        if seq in self._free_rows:
+            raise ValueError(f"seq {seq} double-freed")
+        for i in range(self._mapped[seq]):
+            self._free_blocks.append(int(self.tables[seq, i]))
+        self.tables[seq, :] = 0  # unmapped rows scatter to the junk block
+        self._mapped[seq] = 0
+        self._free_rows.append(seq)
+
+    def reset(self) -> None:
+        """Free every row and block (buffers are NOT zeroed)."""
+        self.tables[:, :] = 0
+        self.lengths = [0] * self.num_slots
+        self._mapped = [0] * self.num_slots
+        self._free_rows = list(range(self.num_slots - 1, -1, -1))
+        self._free_blocks = list(range(self.num_blocks, 0, -1))
+        self._watermark = self.num_blocks
+
+    def max_active_len(self, seqs) -> int:
+        """Longest written length among ``seqs`` — drives the attend bucket
+        of the next batched decode step."""
+        return max((self.lengths[s] for s in seqs), default=0)

@@ -8,7 +8,10 @@ device. The engine drives it once per iteration:
    iteration-level leave);
 2. ``admit(pool)``    — FIFO: bind queued requests to free slots. A
    request that can NEVER fit the pool (prompt + budget > slot capacity)
-   is rejected at submit time instead of poisoning the queue head;
+   is rejected at submit time instead of poisoning the queue head. With
+   a paged pool, admission additionally requires enough free BLOCKS for
+   the prompt (``pool.allocate(need_tokens)`` returns None otherwise) —
+   the queue head waits rather than being skipped, preserving FIFO;
 3. the engine then runs ONE prefill chunk for the oldest admitted
    still-prefilling request (prefill interleaves with decode instead of
    stalling it) and ONE batched decode step for every decoding slot;
@@ -87,6 +90,15 @@ class Request:
             return self.prefilled
         return len(self.prompt_ids) + max(len(self.tokens) - 1, 0)
 
+    def prefill_source(self) -> List[int]:
+        """Tokens to (re)write during prefill: the prompt, plus — after a
+        paged-pool preemption released this request's blocks mid-decode —
+        everything it had already generated. Recompute-on-resume: the
+        re-prefill replays the full sequence so the next sampled token
+        continues the chain exactly (greedy output is unchanged by
+        preemption)."""
+        return self.prompt_ids + self.tokens
+
 
 class Scheduler:
     def __init__(self, max_queue: int = 32):
@@ -99,6 +111,7 @@ class Scheduler:
         self.rejected = 0
         self.evicted = 0
         self.completed = 0
+        self.preempted = 0
 
     # -- admission -----------------------------------------------------------
     def submit(self, req: Request) -> Request:
@@ -112,12 +125,18 @@ class Scheduler:
 
     def admit(self, pool) -> List[Request]:
         """Bind FIFO-queued requests to free slots; returns the newly
-        admitted requests (now in PREFILL state, nothing written yet)."""
+        admitted requests (now in PREFILL state, nothing written yet).
+        Admission is gated on the pool's ACTUAL capacity: a paged pool
+        may refuse (None) even with a free batch row when the block arena
+        cannot cover the prompt — the head then waits in FIFO order."""
         out: List[Request] = []
         with self.lock:
             while self.queue and pool.num_free > 0:
-                req = self.queue.popleft()
-                slot = pool.allocate()
+                req = self.queue[0]
+                slot = pool.allocate(len(req.prefill_source()))
+                if slot is None:
+                    break
+                self.queue.popleft()
                 req.slot = slot
                 req.state = PREFILL
                 req.prefilled = 0
@@ -167,6 +186,23 @@ class Scheduler:
             r.finish_reason = "deadline"
             r.resolve(error="deadline exceeded")
         return evicted
+
+    def preempt(self, pool, req: Request) -> None:
+        """Release a running request's row/blocks and put it BACK at the
+        head of the queue (recompute-on-resume, vLLM-style): when the
+        block arena is exhausted mid-decode, the youngest request yields
+        its memory so older ones keep advancing. Its generated tokens are
+        kept; re-admission re-prefills ``prefill_source()`` and the
+        sampling chain continues where it left off."""
+        with self.lock:
+            if req.slot is not None and req.slot in self.running:
+                del self.running[req.slot]
+                pool.free(req.slot)
+            req.slot = None
+            req.state = QUEUED
+            req.prefilled = 0
+            self.queue.appendleft(req)
+            self.preempted += 1
 
     def finish(self, pool, req: Request, reason: str) -> None:
         """Normal completion: release the slot and mark the finish reason

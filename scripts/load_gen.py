@@ -3,9 +3,11 @@
 
 Drives N worker threads against ``POST /generate`` (infer/server.py) and
 prints one JSON summary line: request counts by status (200 / 429 / 504 /
-other), latency percentiles, client-side token throughput, and the
-server's /metrics snapshot after the run. Stdlib-only, so it runs
-anywhere the repo does:
+other), end-to-end latency percentiles, TTFT and per-token decode
+latency percentiles (p50/p95/p99 — the numbers that separate a paged
+pool from a slotted one under mixed-length traffic), client-side token
+throughput, and the server's /metrics snapshot after the run.
+Stdlib-only, so it runs anywhere the repo does:
 
     python scripts/load_gen.py --url http://127.0.0.1:8400 \
         --concurrency 8 --requests 64 --max-tokens 32
@@ -33,14 +35,19 @@ def _one_request(url: str, body: dict, timeout: float) -> dict:
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             out = json.loads(resp.read())
+            # The batch engine reports server-side TTFT; per-token decode
+            # latency is the post-first-token time spread over the rest.
+            ttft = out.get("ttft_ms")
             return {"status": resp.status, "latency_s": time.monotonic() - t0,
-                    "tokens": int(out.get("tokens", 0))}
+                    "tokens": int(out.get("tokens", 0)),
+                    "ttft_s": ttft / 1e3 if ttft is not None else None}
     except urllib.error.HTTPError as e:
         return {"status": e.code, "latency_s": time.monotonic() - t0,
-                "tokens": 0}
+                "tokens": 0, "ttft_s": None}
     except Exception as e:  # noqa: BLE001 - count it, keep loading
         return {"status": f"error:{type(e).__name__}",
-                "latency_s": time.monotonic() - t0, "tokens": 0}
+                "latency_s": time.monotonic() - t0, "tokens": 0,
+                "ttft_s": None}
 
 
 def run_load(url: str, concurrency: int, requests: int, prompt: str,
@@ -76,12 +83,23 @@ def run_load(url: str, concurrency: int, requests: int, prompt: str,
     by_status: dict = {}
     for r in results:
         by_status[str(r["status"])] = by_status.get(str(r["status"]), 0) + 1
-    lats = sorted(r["latency_s"] for r in results if r["status"] == 200)
+    ok = [r for r in results if r["status"] == 200]
+    lats = sorted(r["latency_s"] for r in ok)
+    ttfts = sorted(r["ttft_s"] for r in ok if r["ttft_s"] is not None)
+    # Per-token decode latency per request: everything after the first
+    # token, normalized by the tokens it produced. Falls back to
+    # whole-request normalization when the server (locked engine) does
+    # not report TTFT.
+    per_tok = sorted(
+        ((r["latency_s"] - r["ttft_s"]) / max(r["tokens"] - 1, 1)
+         if r["ttft_s"] is not None
+         else r["latency_s"] / max(r["tokens"], 1))
+        for r in ok if r["tokens"] > 0)
 
-    def pct(p: float) -> float | None:
-        if not lats:
+    def pct(vals, p: float, digits: int = 3) -> float | None:
+        if not vals:
             return None
-        return round(lats[min(len(lats) - 1, int(p * len(lats)))], 3)
+        return round(vals[min(len(vals) - 1, int(p * len(vals)))], digits)
 
     toks = sum(r["tokens"] for r in results)
     summary = {
@@ -89,8 +107,14 @@ def run_load(url: str, concurrency: int, requests: int, prompt: str,
         "max_tokens": max_tokens, "wall_s": round(wall, 2),
         "by_status": by_status,
         "ok": by_status.get("200", 0),
-        "latency_p50_s": pct(0.50), "latency_p90_s": pct(0.90),
+        "latency_p50_s": pct(lats, 0.50), "latency_p90_s": pct(lats, 0.90),
+        "latency_p95_s": pct(lats, 0.95), "latency_p99_s": pct(lats, 0.99),
         "latency_max_s": round(lats[-1], 3) if lats else None,
+        "ttft_p50_s": pct(ttfts, 0.50), "ttft_p95_s": pct(ttfts, 0.95),
+        "ttft_p99_s": pct(ttfts, 0.99),
+        "tok_latency_p50_s": pct(per_tok, 0.50, 5),
+        "tok_latency_p95_s": pct(per_tok, 0.95, 5),
+        "tok_latency_p99_s": pct(per_tok, 0.99, 5),
         "client_tok_s": round(toks / wall, 1) if wall > 0 else None,
     }
     try:

@@ -21,6 +21,7 @@ from mlx_cuda_distributed_pretraining_tpu.models.llama import LlamaArgs
 from mlx_cuda_distributed_pretraining_tpu.serve import (
     BatchEngine,
     EngineConfig,
+    PagedKVPool,
     QueueFullError,
     Request,
     Scheduler,
@@ -210,6 +211,186 @@ def test_server_batch_engine_429_past_max_queue_depth():
         httpd.shutdown()
         httpd.server_close()
         service.close()
+
+
+# -- paged pool ---------------------------------------------------------------
+
+def test_paged_pool_block_alloc_free_reuse_invariants():
+    pool = PagedKVPool(ARGS, num_seqs=2, max_len=MAX_LEN, block_size=32,
+                       num_blocks=6)
+    assert pool.max_blocks == 4 and pool.capacity == MAX_LEN - 1
+    # arena holds num_blocks + 1 buffers: block 0 is the reserved junk block
+    assert pool.cache[0]["k"].shape[0] == 7
+    assert pool.blocks_for(0) == 0 and pool.blocks_for(1) == 1
+    assert pool.blocks_for(32) == 1 and pool.blocks_for(33) == 2
+    s0 = pool.allocate(40)  # 2 blocks
+    assert s0 is not None and pool.blocks_in_use == 2
+    assert sorted(set(pool.tables[s0][:2])) != [0]  # mapped, non-junk
+    assert all(b == 0 for b in pool.tables[s0][2:])  # tail unmapped -> junk
+    # on-demand growth maps exactly the missing blocks
+    assert pool.ensure_capacity(s0, 65)  # 3 blocks
+    assert pool.blocks_in_use == 3
+    assert pool.ensure_capacity(s0, 65)  # idempotent
+    assert pool.blocks_in_use == 3
+    s1 = pool.allocate(96)  # 3 blocks -> arena full (6/6)
+    assert s1 is not None and pool.free_blocks == 0
+    # exhaustion: growth refused with NO state change
+    assert not pool.ensure_capacity(s0, 100)
+    assert pool.blocks_in_use == 6
+    # beyond the table extent is always refused
+    assert not pool.ensure_capacity(s0, MAX_LEN + 1)
+    pool.free(s0)
+    assert pool.free_blocks == 3 and all(b == 0 for b in pool.tables[s0])
+    with pytest.raises(ValueError):
+        pool.free(s0)  # double free
+    # freed blocks are reusable; allocation still honours the arena bound
+    assert pool.allocate(MAX_LEN) is None  # 4 blocks > 3 free
+    s2 = pool.allocate(96)
+    assert s2 == s0 and pool.lengths[s2] == 0
+    # watermark saw the full-arena moment; fragmentation counts slack
+    assert pool.read_watermark() == 0
+    assert pool.read_watermark() == 0  # reset to current free level
+    pool.lengths[s1] = 65  # 3 blocks mapped, 96 positions, 65 live
+    pool.lengths[s2] = 96
+    frag = pool.fragmentation()
+    assert 0.0 < frag < 1.0 and abs(frag - (1 - 161 / 192)) < 1e-9
+    pool.reset()
+    assert pool.free_blocks == 6 and pool.num_free == 2
+    # int8 arena builds the quantized quartet per layer
+    qpool = PagedKVPool(ARGS, num_seqs=2, max_len=MAX_LEN, quantize=True)
+    assert "k_q" in qpool.cache[0] and "k" not in qpool.cache[0]
+    with pytest.raises(ValueError):
+        PagedKVPool(ARGS, num_seqs=1, max_len=MAX_LEN, block_size=24)
+    with pytest.raises(ValueError):
+        PagedKVPool(ARGS, num_seqs=1, max_len=100, block_size=32)
+
+
+def test_paged_admission_gated_on_free_blocks():
+    # 3 blocks of 32: two 40-token prompts (2 blocks each) cannot both be
+    # admitted even though batch rows are free.
+    pool = PagedKVPool(ARGS, num_seqs=2, max_len=MAX_LEN, block_size=32,
+                       num_blocks=3)
+    sched = Scheduler(max_queue=4)
+    r0 = Request(list(range(40)), max_tokens=4)
+    r1 = Request(list(range(40)), max_tokens=4)
+    sched.submit(r0)
+    sched.submit(r1)
+    admitted = sched.admit(pool)
+    assert [r.id for r in admitted] == [r0.id]  # head admitted, FIFO kept
+    assert sched.queue_depth() == 1 and pool.num_free == 1
+    # finishing the head releases its blocks; the waiter admits next round
+    sched.finish(pool, r0, "stop")
+    assert [r.id for r in sched.admit(pool)] == [r1.id]
+
+
+def test_engine_429_when_blocks_exhausted_backs_up_queue():
+    # Arena sized so ONE request's prompt occupies every block: the second
+    # waits in the queue and the third submission overflows -> 429 path.
+    eng = _engine(num_blocks=2, block_size=32, max_queue=1)
+    ids = list(range(50))  # 2 blocks
+    eng._submit_ids(ids, max_tokens=4, temperature=0.0, seed=0)
+    eng.scheduler.admit(eng.pool)
+    assert eng.pool.free_blocks == 0
+    eng._submit_ids(ids, max_tokens=4, temperature=0.0, seed=0)
+    assert eng.scheduler.admit(eng.pool) == []  # blocks exhausted: waits
+    with pytest.raises(QueueFullError):
+        eng._submit_ids(ids, max_tokens=4, temperature=0.0, seed=0)
+    assert eng.metrics()["rejected"] == 1
+
+
+# -- paged engine parity ------------------------------------------------------
+
+def _collect(eng, prompts, max_tokens=40, **gen_kw):
+    eng.start()
+    outs = [None] * len(prompts)
+    try:
+        def run(i):
+            outs[i] = eng.generate(prompts[i], max_tokens=max_tokens,
+                                   timeout=300.0, **gen_kw)
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        metrics = eng.metrics()
+    finally:
+        eng.stop()
+    return outs, metrics
+
+
+PARITY_PROMPTS = ["the quick brown fox", "pack my box with", "a b c a b c a",
+                  "hello world hello world hello", "zzz"]
+
+
+def test_paged_vs_slotted_greedy_parity():
+    # Token-for-token identity under concurrency: mixed-length prompts,
+    # generations long enough to cross several block boundaries.
+    slotted, _ = _collect(_engine(kv_backend="slotted", num_slots=3),
+                          PARITY_PROMPTS, temperature=0.0)
+    paged, _ = _collect(_engine(kv_backend="paged", num_slots=3,
+                                block_size=16), PARITY_PROMPTS,
+                        temperature=0.0)
+    for s, p in zip(slotted, paged):
+        assert p["text"] == s["text"]
+        assert p["tokens"] == s["tokens"]
+        assert p["finish_reason"] == s["finish_reason"]
+
+
+def test_paged_int8_roundtrip_parity_with_slotted_int8():
+    slotted, _ = _collect(_engine(kv_backend="slotted", kv_quant=True),
+                          PARITY_PROMPTS[:2], temperature=0.0)
+    eng = _engine(kv_backend="paged", kv_quant=True)
+    assert "k_q" in eng.pool.cache[0]
+    paged, _ = _collect(eng, PARITY_PROMPTS[:2], temperature=0.0)
+    for s, p in zip(slotted, paged):
+        assert p["text"] == s["text"]
+
+
+def test_batched_spec_matches_single_stream_spec_greedy():
+    from mlx_cuda_distributed_pretraining_tpu.infer.generate import (
+        generate_speculative,
+    )
+
+    # Repetitive prompts so prompt-lookup actually lands acceptances.
+    prompts = ["a b c a b c a b", "the cat and the cat and the"]
+    singles = []
+    for p in prompts:
+        ids = [TOK.bos_id] + TOK.tokenize(p)
+        toks, stats = generate_speculative(
+            PARAMS, ARGS, ids, max_tokens=32, draft_len=4, max_ngram=3,
+            stop_tokens=[TOK.eos_id], temperature=0.0)
+        singles.append(TOK.detokenize(toks))
+    outs, m = _collect(_engine(spec_draft_len=4, spec_max_ngram=3),
+                       prompts, max_tokens=32, temperature=0.0)
+    for single, out in zip(singles, outs):
+        assert out["text"] == single
+    assert m["spec_proposed"] > 0
+    assert 0 < m["spec_accepted"] <= m["spec_proposed"]
+    assert m["spec_acceptance_rate"] > 0.0
+
+
+def test_batched_spec_sampled_still_terminates_and_counts():
+    outs, m = _collect(_engine(spec_draft_len=3), PARITY_PROMPTS[:3],
+                       max_tokens=8, temperature=0.7)
+    assert all(o is not None and 0 < o["tokens"] <= 8 for o in outs)
+    assert m["spec_proposed"] >= m["spec_accepted"] >= 0
+
+
+def test_paged_preemption_recompute_keeps_greedy_output():
+    # Arena deliberately too small for both sequences at full length
+    # (2 rows x up to 3 blocks needed, 4 blocks total): the younger
+    # request must be preempted and recomputed, with identical output.
+    reference, _ = _collect(_engine(num_slots=2), PARITY_PROMPTS[:2],
+                            max_tokens=60, temperature=0.0)
+    tight, m = _collect(_engine(num_slots=2, num_blocks=4, block_size=32),
+                        PARITY_PROMPTS[:2], max_tokens=60, temperature=0.0)
+    for ref, out in zip(reference, tight):
+        assert out["text"] == ref["text"]
+        assert out["tokens"] == ref["tokens"]
+    assert m["preempted"] >= 1
+    assert m["kv_blocks_used"] == 0 and m["kv_blocks_free"] == 4
 
 
 def test_server_locked_path_unchanged_and_reshaping_knobs_fall_back():
