@@ -67,6 +67,13 @@ class LlamaArgs:
     moe_aux_weight: float = 0.01
     router_z_weight: float = 0.0
     moe_group_size: int = 256
+    # Dispatch implementation: "grouped" (sort-based dropless, grouped
+    # GEMMs — ops/grouped_matmul.py) or "einsum" (GShard dispatch tensors,
+    # capacity drops — kept as the parity oracle). models/moe.py.
+    moe_impl: str = "grouped"
+    # Static per-destination send slots for the ep all-to-all, as a
+    # fraction of local selections: <= 0 means worst-case (dropless).
+    moe_ep_capacity_factor: float = 0.0
 
     @property
     def is_moe(self) -> bool:
@@ -111,6 +118,8 @@ class LlamaArgs:
             moe_aux_weight=float(moe.get("aux_loss_weight", 0.01) or 0.0),
             router_z_weight=float(moe.get("router_z_weight", 0.0) or 0.0),
             moe_group_size=int(moe.get("group_size", 256) or 256),
+            moe_impl=str(moe.get("impl", "grouped") or "grouped"),
+            moe_ep_capacity_factor=float(moe.get("ep_capacity_factor", 0.0) or 0.0),
         )
 
 
@@ -423,7 +432,11 @@ def transformer_block(
     """Pre-norm residual block (reference: models/llama.py:298-319).
 
     Returns ``(x, new_cache, aux_loss)`` — aux is the MoE load-balancing
-    loss (0 for dense layers)."""
+    loss (0 for dense layers). When a routing-stats tap is active
+    (models/moe.py — training with an MoE model), a fourth element carries
+    this layer's routing stats: the stats are re-emitted as RETURN VALUES
+    here, inside any ``jax.checkpoint`` wrapping this block, so they cross
+    the remat/scan boundary instead of leaking out of its trace."""
     h, new_cache = attention_block(
         p["attention"], rms_norm(x, p["attention_norm"]["weight"], args.rms_norm_eps),
         args, positions, cache, attn_impl, attend_len,
@@ -431,9 +444,15 @@ def transformer_block(
     x = x + h
     normed = rms_norm(x, p["ffn_norm"]["weight"], args.rms_norm_eps)
     if args.is_moe:
-        from .moe import moe_block
+        from . import moe as moe_lib
 
-        ff, aux = moe_block(p["feed_forward"], normed, args)
+        if moe_lib.stats_tap_active():
+            with moe_lib.routing_stats_tap() as tap:
+                ff, aux = moe_lib.moe_block(p["feed_forward"], normed, args)
+            x = x + ff
+            return x, new_cache, aux, moe_lib.merge_stats(
+                tap, args.num_local_experts)
+        ff, aux = moe_lib.moe_block(p["feed_forward"], normed, args)
     else:
         ff = mlp_block(p["feed_forward"], normed)
         aux = jnp.zeros((), jnp.float32)
@@ -497,6 +516,12 @@ def forward(
     new_cache = [] if cache is not None else None
     n_remat = int(round(args.num_layers * remat_ratio))
     aux_total = jnp.zeros((), jnp.float32)
+    if args.is_moe:
+        from . import moe as moe_lib
+        collect_stats = moe_lib.stats_tap_active()
+    else:
+        collect_stats = False
+    stats_total = moe_lib.zero_stats(args.num_local_experts) if collect_stats else None
     if scan_layers and cache is None:
         # Segmented scan: the checkpointed prefix (remat_ratio) and the
         # plain suffix each scan over their own stacked params — at most
@@ -511,21 +536,40 @@ def forward(
             stacked = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *seg)
 
             def body(h, layer, blk=blk):
-                h, _, aux = blk(layer, h, args, positions, None, None,
-                                attend_len)
+                # transformer_block grows a stats element under an active
+                # tap; routing it through the scan ys keeps the traced
+                # stats inside the scan body's trace.
+                out = blk(layer, h, args, positions, None, None, attend_len)
+                if collect_stats:
+                    h, _, aux, stats = out
+                    return h, (aux, stats)
+                h, _, aux = out
                 return h, aux
 
-            x, auxs = jax.lax.scan(body, x, stacked)
+            x, ys = jax.lax.scan(body, x, stacked)
+            if collect_stats:
+                auxs, stats = ys
+                stats_total = {k: stats_total[k] + stats[k].sum(axis=0)
+                               for k in stats_total}
+            else:
+                auxs = ys
             aux_total = aux_total + auxs.sum()
     else:
         for i, layer in enumerate(params["layers"]):
             blk = block if (remat and i < n_remat) else transformer_block
             layer_cache = cache[i] if cache is not None else None
-            x, c, aux = blk(cast(layer), x, args, positions, layer_cache, None,
-                            attend_len)
+            out = blk(cast(layer), x, args, positions, layer_cache, None,
+                      attend_len)
+            if collect_stats:
+                x, c, aux, stats = out
+                stats_total = {k: stats_total[k] + stats[k] for k in stats_total}
+            else:
+                x, c, aux = out
             aux_total = aux_total + aux
             if new_cache is not None:
                 new_cache.append(c)
+    if collect_stats:
+        moe_lib.record_stats(stats_total)
 
     x = rms_norm(x, params["norm"]["weight"], args.rms_norm_eps)
     if return_hidden:
@@ -634,7 +678,8 @@ def loss_fn(
     ce_chunk: int = -1,
     scan_layers: bool = False,
     z_loss_weight: float = 0.0,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    with_moe_stats: bool = False,
+) -> Tuple[jnp.ndarray, Any]:
     """Masked mean cross-entropy in fp32 (reference: core/training.py
     compute_loss :1195-1260). Returns (loss, token_count). MoE models add
     the pre-scaled router aux losses when ``include_aux`` (training); eval
@@ -646,7 +691,24 @@ def loss_fn(
     logits). 0 disables; -1 (default) auto-enables when the logits tensor
     would be HBM-significant. Both paths run the projection with fp32
     accumulation and reduce in fp32, so toggling ce_chunk changes memory
-    behavior only, not the computed loss."""
+    behavior only, not the computed loss.
+
+    ``with_moe_stats=True`` (MoE training step) opens a routing-stats tap
+    around the forward pass and returns ``(loss, (token_count, stats))``
+    where stats is the layer-summed dict from models/moe.py — the shape
+    ``value_and_grad(has_aux=True)`` needs to carry traced routing stats
+    out of the differentiated region."""
+    if with_moe_stats and args.is_moe:
+        from . import moe as moe_lib
+
+        with moe_lib.routing_stats_tap() as tap:
+            loss, count = loss_fn(
+                params, batch, args, compute_dtype=compute_dtype,
+                remat=remat, remat_ratio=remat_ratio, include_aux=include_aux,
+                ce_chunk=ce_chunk, scan_layers=scan_layers,
+                z_loss_weight=z_loss_weight,
+            )
+        return loss, (count, moe_lib.merge_stats(tap, args.num_local_experts))
     targets = batch["targets"]
     mask = batch["mask"].astype(jnp.float32)
     count = jnp.maximum(mask.sum(), 1.0)

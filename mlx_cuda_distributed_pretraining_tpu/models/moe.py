@@ -3,29 +3,50 @@
 The reference *declares* MoE fields (``num_local_experts`` /
 ``num_experts_per_tok``, reference: models/llama.py:40-41 and config plumbing
 core/training.py:1055-1056) but never builds an MoE layer. Here they drive a
-real block, designed for XLA/GSPMD rather than translated from any GPU code:
+real block with two interchangeable dispatch implementations
+(``moe.impl`` in the model config, ``LlamaArgs.moe_impl``):
 
-- **Static shapes everywhere.** Routing uses the GShard/Switch
-  dispatch/combine-tensor formulation: top-k gating, per-sequence expert
-  capacity ``C``, one-hot dispatch ``[B, S, E, C]``. No gather/scatter with
-  data-dependent shapes — everything is einsum, so it tiles onto the MXU and
-  shards cleanly.
-- **Expert parallelism by sharding, not message passing.** Expert weight
-  tensors are stacked ``[E, ...]`` and sharded over the ``ep`` mesh axis
-  (parallel/sharding_rules.py); the dispatch/combine einsums then induce the
-  all-to-alls under GSPMD. No hand-written collectives.
-- **Load-balancing aux loss** (Switch Transformer style) and optional router
-  z-loss, surfaced through ``loss_fn`` so training actually balances experts.
+- ``grouped`` (default) — MegaBlocks-style **dropless** routing: fp32 router
+  → top-k → stable argsort by expert id → gather into a per-expert
+  block-aligned buffer → grouped GEMM SwiGLU (ops/grouped_matmul.py) →
+  scatter-add combine. Every shape is static (sort + gather, no
+  data-dependent shapes) and **no token is ever dropped** — there is no
+  expert capacity. On ``ep`` meshes the sorted dispatch drops below GSPMD
+  via ``parallel/compat.shard_map``: each shard routes its local tokens,
+  exchanges rows with the owning expert shard through a pair of
+  ``all_to_all`` collectives with static per-destination send slots, and
+  scatter-adds the returned rows (mirroring how
+  ``ops/fused_ce.fused_cross_entropy_sp`` handles sp). Send capacity
+  defaults to worst-case (``moe_ep_capacity_factor: 0``) so the exchange
+  is dropless too; a positive factor trades all-to-all volume for
+  (counted) overflow drops.
+- ``einsum`` — the GShard/Switch dispatch/combine-tensor formulation kept
+  as the parity oracle: top-k gating, per-group expert capacity ``C``,
+  one-hot dispatch ``[B, S, E, C]``; tokens beyond capacity are dropped to
+  the residual path. Expert parallelism happens implicitly under GSPMD via
+  the ``ep``-sharded ``[E, ...]`` weight stacking.
 
-Router math runs in fp32 regardless of compute dtype.
+Router math runs in fp32 regardless of compute dtype. The load-balancing
+aux loss (Switch Transformer style) and optional router z-loss are computed
+over **real tokens only** — ``moe_group_size`` padding rows are excluded —
+and returned pre-scaled.
+
+Routing observability rides a trace-time tap (:func:`routing_stats_tap`):
+when a tap is active, ``transformer_block`` converts each layer's recorded
+expert-load / dropped-token stats into return values (so they survive
+``jax.checkpoint`` and ``lax.scan`` boundaries) and ``loss_fn`` surfaces
+them to the train step.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+import contextlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from ..ops import grouped_matmul as gm
 
 Params = Dict[str, Any]
 
@@ -52,8 +73,54 @@ def init_moe_params(keys, args, dtype=jnp.float32) -> Params:
     }
 
 
+# -- routing-stats tap -------------------------------------------------------
+# Stats are traced values; a side list only works when producer and consumer
+# sit in the SAME trace. transformer_block therefore re-emits tap entries as
+# return values across jax.checkpoint / lax.scan boundaries, and loss_fn
+# returns the merged stats through value_and_grad's aux.
+_TAPS: List[list] = []
+
+STAT_KEYS = ("moe_load", "moe_dropped")
+
+
+@contextlib.contextmanager
+def routing_stats_tap():
+    """Collect per-layer routing stats dicts recorded while tracing."""
+    tap: list = []
+    _TAPS.append(tap)
+    try:
+        yield tap
+    finally:
+        _TAPS.pop()
+
+
+def stats_tap_active() -> bool:
+    return bool(_TAPS)
+
+
+def record_stats(stats: Dict[str, jnp.ndarray]) -> None:
+    if _TAPS:
+        _TAPS[-1].append(stats)
+
+
+def zero_stats(num_experts: int) -> Dict[str, jnp.ndarray]:
+    return {
+        "moe_load": jnp.zeros((num_experts,), jnp.float32),
+        "moe_dropped": jnp.zeros((), jnp.float32),
+    }
+
+
+def merge_stats(entries, num_experts: int) -> Dict[str, jnp.ndarray]:
+    """Sum a list of stats dicts (layers) into one."""
+    total = zero_stats(num_experts)
+    for e in entries:
+        total = {k: total[k] + e[k] for k in total}
+    return total
+
+
 def expert_capacity(seq_len: int, num_experts: int, k: int, capacity_factor: float) -> int:
-    """Per-sequence slots each expert can accept (static)."""
+    """Per-sequence slots each expert can accept (static). Einsum impl only —
+    the grouped impl is dropless and has no capacity."""
     c = int(capacity_factor * k * seq_len / num_experts + 0.5)
     return max(1, min(c, seq_len * k))
 
@@ -103,39 +170,39 @@ def router_z_loss(router_logits: jnp.ndarray) -> jnp.ndarray:
     return jnp.mean(z * z)
 
 
-def moe_block(p: Params, x: jnp.ndarray, args) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """x [B, S, D] → (out [B, S, D], aux_loss scalar fp32).
+# -- einsum (GShard/Switch) implementation -----------------------------------
+def _einsum_moe(
+    p: Params, x: jnp.ndarray, probs: jnp.ndarray, args
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dense dispatch/combine einsum pipeline → (out, dropped_selections).
 
-    Dense einsum pipeline: dispatch → per-expert SwiGLU → combine. The expert
-    dim E leads every expert tensor so sharding over ``ep`` partitions both
-    weights and expert compute.
-
-    Tokens are routed in fixed-size groups of ``moe_group_size`` (GShard-style)
-    so capacity — and with it the [G, g*K, E, C] dispatch tensors — stays
-    constant as sequence length grows: memory is O(S), not O(S²).
-
-    The returned aux term is **fully pre-scaled**: ``moe_aux_weight *
-    load_balance + router_z_weight * z_loss``; callers add it to the CE loss
-    unweighted.
+    Tokens are routed in fixed-size groups of ``moe_group_size``
+    (GShard-style) so capacity — and with it the [G, g*K, E, C] dispatch
+    tensors — stays constant as sequence length grows: memory is O(S), not
+    O(S²). Pad rows carry uniform router probs (softmax of a zero row),
+    exactly as if zero-padded activations had been routed; their combine
+    output is sliced off, though they can steal a little tail-group
+    capacity, which is standard.
     """
     B, S, D = x.shape
     E, K = args.num_local_experts, args.num_experts_per_tok
 
     g = min(int(getattr(args, "moe_group_size", 256) or 256), S)
-    # Pad S up to a multiple of g so capacity stays O(group), never O(S).
-    # Pad tokens route like real ones but their combine output is sliced off;
-    # they can steal a little tail-group capacity, which is standard.
     S_pad = ((S + g - 1) // g) * g
     if S_pad != S:
         x_in = jnp.pad(x, ((0, 0), (0, S_pad - S), (0, 0)))
+        probs_in = jnp.pad(probs, ((0, 0), (0, S_pad - S), (0, 0)),
+                           constant_values=1.0 / E)
     else:
-        x_in = x
+        x_in, probs_in = x, probs
     xg = x_in.reshape(B * (S_pad // g), g, D)
+    probs_g = probs_in.reshape(B * (S_pad // g), g, E)
     C = expert_capacity(g, E, K, getattr(args, "moe_capacity_factor", 1.25))
 
-    router_logits = xg.astype(jnp.float32) @ p["router"]["weight"].astype(jnp.float32)
-    probs = jax.nn.softmax(router_logits, axis=-1)  # [G, g, E] fp32
-    dispatch, combine = _dispatch_combine(probs, K, C)
+    dispatch, combine = _dispatch_combine(probs_g, K, C)
+    # Kept selections per token (0..K), real rows only → overflow drops.
+    kept = dispatch.sum((2, 3)).reshape(B, S_pad)[:, :S]
+    dropped = jax.lax.stop_gradient(K * B * S - kept.sum())
     dispatch = dispatch.astype(x.dtype)
 
     # [G,g,E,C] x [G,g,D] -> [E,G,C,D]: the all-to-all under ep sharding.
@@ -148,7 +215,247 @@ def moe_block(p: Params, x: jnp.ndarray, args) -> Tuple[jnp.ndarray, jnp.ndarray
     )
     expert_out = jnp.einsum("ebci,eid->ebcd", h, wd)
     out = jnp.einsum("bsec,ebcd->bsd", combine.astype(x.dtype), expert_out)
-    out = out.reshape(B, S_pad, D)[:, :S]
+    return out.reshape(B, S_pad, D)[:, :S], dropped
+
+
+# -- grouped (sort-based dropless) implementation ----------------------------
+def _grouped_ffn(
+    experts: Params,
+    x_flat: jnp.ndarray,
+    gate_idx: jnp.ndarray,
+    gate_w: jnp.ndarray,
+    num_experts: int,
+    block_t: int,
+) -> jnp.ndarray:
+    """Sorted dropless expert FFN over local tokens.
+
+    x_flat [T, D], gate_idx [T, K] int32, gate_w [T, K] → out [T, D].
+    Selections are stably sorted by expert id and scattered into a
+    per-expert ``block_t``-aligned buffer (static size: every expert's group
+    rounds up to a full tile), the three expert matmuls run as grouped
+    GEMMs, and the gate-weighted rows scatter-add back. No capacity, no
+    drops.
+    """
+    T, D = x_flat.shape
+    K = gate_idx.shape[-1]
+    TK = T * K
+    ids = gate_idx.reshape(TK)
+    tok = jnp.arange(TK, dtype=jnp.int32) // K
+
+    counts = jnp.bincount(ids, length=num_experts)  # [E]
+    padded = ((counts + block_t - 1) // block_t) * block_t
+    p_off = jnp.concatenate([jnp.zeros((1,), padded.dtype), jnp.cumsum(padded)])
+    raw_off = jnp.cumsum(counts) - counts  # group starts in sorted order
+
+    order = jnp.argsort(ids, stable=True)  # token-major within each expert
+    ids_s = ids[order]
+    rank = jnp.arange(TK, dtype=jnp.int32) - raw_off[ids_s].astype(jnp.int32)
+    dest = (p_off[ids_s] + rank).astype(jnp.int32)
+
+    T_buf = gm.round_up(TK + num_experts * (block_t - 1), block_t)
+    x_buf = jnp.zeros((T_buf, D), x_flat.dtype).at[dest].set(x_flat[tok[order]])
+
+    gs = padded
+    wg_ = experts["w_gate"]["weight"]
+    wu = experts["w_up"]["weight"]
+    wd = experts["w_down"]["weight"]
+    h = jax.nn.silu(gm.gmm(x_buf, wg_, gs, block_t=block_t)) * gm.gmm(
+        x_buf, wu, gs, block_t=block_t)
+    y_buf = gm.gmm(h, wd, gs, block_t=block_t)
+
+    w_s = gate_w.reshape(TK)[order].astype(y_buf.dtype)
+    out = jnp.zeros((T, D), x_flat.dtype).at[tok[order]].add(
+        y_buf[dest] * w_s[:, None])
+    return out
+
+
+def _usable_ep_mesh(args, num_experts: int):
+    """The mesh to drop below GSPMD with, or None for the local path.
+
+    Requires a multi-device mesh whose axes are not already bound manual
+    (i.e. we are not inside another shard_map, e.g. the pipeline stage
+    body), and an expert count divisible by the ep axis.
+    """
+    from ..parallel.context import current_mesh
+
+    mesh = current_mesh()
+    if mesh is None or mesh.size == 1:
+        return None
+    ep = mesh.shape.get("ep", 1)
+    if num_experts % max(ep, 1):
+        return None
+    try:
+        from jax._src import core as _core
+
+        active = set(_core.unsafe_get_axis_names())
+    except Exception:  # pragma: no cover - private-API drift
+        active = set()
+    if active & set(mesh.axis_names):
+        return None
+    return mesh
+
+
+def _grouped_moe_ep(
+    p: Params, x: jnp.ndarray, gate_idx: jnp.ndarray, gate_w: jnp.ndarray,
+    args, mesh,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Expert-parallel sorted dispatch under shard_map → (out, dropped).
+
+    Each shard routes its local tokens, posts rows into per-destination
+    send slots ([ep, cap, D], expert id → owning shard = id // E_loc),
+    exchanges them with one ``all_to_all``, runs the local grouped FFN over
+    its E/ep experts, and returns rows with a second ``all_to_all``; gate
+    weighting and the combine scatter-add stay on the source shard, so
+    gradients flow through the exchange untouched.
+
+    ``cap`` (send slots per source→dest pair) is static:
+    ``moe_ep_capacity_factor <= 0`` means worst-case (= local selections,
+    dropless); a positive factor shrinks the exchange to
+    ``factor · TK / ep`` and overflow beyond it is dropped and counted.
+    """
+    from ..parallel.compat import shard_map
+    from ..parallel.sharding_rules import moe_dispatch_specs
+
+    B, S, D = x.shape
+    E, K = args.num_local_experts, args.num_experts_per_tok
+    ep = max(mesh.shape.get("ep", 1), 1)
+    e_loc = E // ep
+
+    specs = moe_dispatch_specs(mesh)
+    # Static per-shard token geometry (shard_map divides batch evenly).
+    b_shards = 1
+    for a in specs["batch_axes"]:
+        b_shards *= mesh.shape.get(a, 1)
+    t_loc = (B // b_shards) * S
+    tk = t_loc * K
+    factor = float(getattr(args, "moe_ep_capacity_factor", 0.0) or 0.0)
+    cap = tk if factor <= 0 else max(1, min(tk, int(factor * tk / ep + 0.5)))
+    block_t = gm.pick_block_t(ep * cap, e_loc)
+
+    def body(x_l, gi_l, gw_l, wg_l, wu_l, wd_l):
+        b_l, s_l, _ = x_l.shape
+        T_l = b_l * s_l
+        TK = T_l * K
+        xf = x_l.reshape(T_l, D)
+        ids = gi_l.reshape(TK)
+        gwf = gw_l.reshape(TK)
+        tok = jnp.arange(TK, dtype=jnp.int32) // K
+
+        dest_shard = ids // e_loc
+        local_eid = ids % e_loc
+
+        # Slot assignment: stable sort by destination shard (token-major
+        # fairness within each destination, like einsum capacity).
+        order = jnp.argsort(dest_shard, stable=True)
+        ds_s = dest_shard[order]
+        cnt = jnp.bincount(dest_shard, length=ep)
+        start = jnp.cumsum(cnt) - cnt
+        rank = jnp.arange(TK, dtype=jnp.int32) - start[ds_s].astype(jnp.int32)
+        keep = rank < cap
+        slot = ds_s * cap + rank
+        slot_put = jnp.where(keep, slot, ep * cap)  # OOB scatter = drop
+
+        send_x = jnp.zeros((ep * cap, D), xf.dtype).at[slot_put].set(xf[tok[order]])
+        send_id = jnp.full((ep * cap,), e_loc, jnp.int32).at[slot_put].set(
+            local_eid[order])
+
+        recv_x = jax.lax.all_to_all(
+            send_x.reshape(ep, cap, D), "ep", split_axis=0, concat_axis=0,
+            tiled=True)
+        recv_id = jax.lax.all_to_all(
+            send_id.reshape(ep, cap), "ep", split_axis=0, concat_axis=0,
+            tiled=True)
+
+        # Local grouped FFN over the E/ep resident experts; sentinel id
+        # e_loc marks empty slots and sorts past every real group.
+        R = ep * cap
+        rx = recv_x.reshape(R, D)
+        rid = recv_id.reshape(R)
+        counts = jnp.bincount(rid, length=e_loc)  # sentinels fall off
+        padded = ((counts + block_t - 1) // block_t) * block_t
+        p_off = jnp.concatenate([jnp.zeros((1,), padded.dtype), jnp.cumsum(padded)])
+        raw_off = jnp.cumsum(counts) - counts
+        order2 = jnp.argsort(rid, stable=True)
+        rid_s = rid[order2]
+        real2 = rid_s < e_loc
+        rid_c = jnp.minimum(rid_s, e_loc - 1)
+        rank2 = jnp.arange(R, dtype=jnp.int32) - raw_off[rid_c].astype(jnp.int32)
+        T_buf = gm.round_up(R + e_loc * (block_t - 1), block_t)
+        dest2 = jnp.where(real2, (p_off[rid_c] + rank2).astype(jnp.int32), T_buf)
+
+        x_buf = jnp.zeros((T_buf, D), rx.dtype).at[dest2].set(rx[order2])
+        h = jax.nn.silu(gm.gmm(x_buf, wg_l, padded, block_t=block_t)) * gm.gmm(
+            x_buf, wu_l, padded, block_t=block_t)
+        y_buf = gm.gmm(h, wd_l, padded, block_t=block_t)
+
+        y_sorted = y_buf[jnp.minimum(dest2, T_buf - 1)] * real2[:, None]
+        y_recv = jnp.zeros((R, D), y_buf.dtype).at[order2].set(y_sorted)
+
+        y_back = jax.lax.all_to_all(
+            y_recv.reshape(ep, cap, D), "ep", split_axis=0, concat_axis=0,
+            tiled=True).reshape(R, D)
+
+        y_sel = y_back[jnp.minimum(slot, R - 1)] * keep[:, None]
+        out = jnp.zeros((T_l, D), x_l.dtype).at[tok[order]].add(
+            y_sel * gwf[order][:, None].astype(y_sel.dtype))
+
+        dropped = jax.lax.psum(
+            (TK - keep.sum()).astype(jnp.float32), tuple(mesh.axis_names))
+        return out.reshape(b_l, s_l, D), dropped
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(specs["activation"], specs["gate"], specs["gate"],
+                  specs["expert_weight"], specs["expert_weight"],
+                  specs["expert_weight"]),
+        out_specs=(specs["activation"], specs["replicated"]),
+        check_vma=False,
+    )
+    out, dropped = fn(
+        x, gate_idx, gate_w,
+        p["experts"]["w_gate"]["weight"],
+        p["experts"]["w_up"]["weight"],
+        p["experts"]["w_down"]["weight"],
+    )
+    return out, jax.lax.stop_gradient(dropped)
+
+
+# -- block entry point -------------------------------------------------------
+def moe_block(p: Params, x: jnp.ndarray, args) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x [B, S, D] → (out [B, S, D], aux_loss scalar fp32).
+
+    Routes through the impl selected by ``args.moe_impl`` (see module
+    docstring). The returned aux term is **fully pre-scaled**:
+    ``moe_aux_weight * load_balance + router_z_weight * z_loss``; callers
+    add it to the CE loss unweighted. Aux is computed from real tokens only
+    and is identical across impls (it depends on the router, not the
+    dispatch).
+    """
+    B, S, D = x.shape
+    E, K = args.num_local_experts, args.num_experts_per_tok
+    impl = getattr(args, "moe_impl", "grouped") or "grouped"
+
+    router_logits = x.astype(jnp.float32) @ p["router"]["weight"].astype(jnp.float32)
+    probs = jax.nn.softmax(router_logits, axis=-1)  # [B, S, E] fp32
+
+    if impl == "einsum":
+        out, dropped = _einsum_moe(p, x, probs, args)
+        gate_idx = jax.lax.top_k(probs, K)[1]  # stats only
+    elif impl == "grouped":
+        gate_w, gate_idx = jax.lax.top_k(probs, K)  # [B, S, K]
+        gate_w = gate_w / jnp.maximum(gate_w.sum(-1, keepdims=True), 1e-9)
+        mesh = _usable_ep_mesh(args, E)
+        if mesh is not None:
+            out, dropped = _grouped_moe_ep(p, x, gate_idx, gate_w, args, mesh)
+        else:
+            out = _grouped_ffn(
+                p["experts"], x.reshape(B * S, D), gate_idx.reshape(B * S, K),
+                gate_w.reshape(B * S, K), E,
+                gm.pick_block_t(B * S * K, E),
+            ).reshape(B, S, D)
+            dropped = jnp.zeros((), jnp.float32)
+    else:
+        raise ValueError(f"unknown moe impl {impl!r} (grouped|einsum)")
 
     aw = float(getattr(args, "moe_aux_weight", 0.0) or 0.0)
     zw = float(getattr(args, "router_z_weight", 0.0) or 0.0)
@@ -157,4 +464,11 @@ def moe_block(p: Params, x: jnp.ndarray, args) -> Tuple[jnp.ndarray, jnp.ndarray
         aux = aux + aw * load_balancing_loss(probs, jnp.argmax(router_logits, axis=-1), E)
     if zw:
         aux = aux + zw * router_z_loss(router_logits)
+
+    if stats_tap_active():
+        record_stats({
+            "moe_load": jax.lax.stop_gradient(
+                jnp.bincount(gate_idx.reshape(-1), length=E).astype(jnp.float32)),
+            "moe_dropped": jax.lax.stop_gradient(dropped.astype(jnp.float32)),
+        })
     return out, aux

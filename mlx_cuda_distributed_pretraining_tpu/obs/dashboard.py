@@ -106,7 +106,7 @@ DASHBOARD_HTML = """<!DOCTYPE html>
   <div class="panel">
     <h2>Workers</h2>
     <table id="workers"><thead><tr>
-      <th></th><th>worker</th><th>step</th><th>loss</th><th>tok/s</th><th>mfu</th><th>last seen</th>
+      <th></th><th>worker</th><th>step</th><th>loss</th><th>tok/s</th><th>mfu</th><th>moe ent</th><th>last seen</th>
     </tr></thead><tbody></tbody></table>
   </div>
 </div>
@@ -312,6 +312,10 @@ function renderWorkers(workers, agg) {
       "<td>" + fmt(m.loss, 4) + "</td>" +
       "<td>" + (m["tok/s"] ? Math.round(m["tok/s"]).toLocaleString() : "–") + "</td>" +
       "<td>" + (typeof m.mfu === "number" ? (100 * m.mfu).toFixed(1) + "%" : "–") + "</td>" +
+      // MoE runs only: normalized routing entropy + dropped selections
+      // (absent keys render "–", so dense runs are unaffected).
+      "<td>" + (typeof m.moe_entropy === "number" ? m.moe_entropy.toFixed(3) +
+        (m.moe_drop ? " / drop " + m.moe_drop : "") : "–") + "</td>" +
       '<td style="color:var(' + (alive ? "--status-good" : "--status-critical") +
       ')">' + (alive ? "\\u25cf " + Math.round(ago) + "s ago" : "\\u25cb stale") + "</td>";
     tb.appendChild(tr);

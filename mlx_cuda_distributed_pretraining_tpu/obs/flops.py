@@ -54,12 +54,43 @@ def flops_per_token(n_params: int, num_layers: int, seq_len: int,
     return 6.0 * float(n_params) + 6.0 * float(num_layers) * float(seq_len) * float(d_attn)
 
 
+def moe_active_params(n_params: int, num_layers: int, hidden_size: int,
+                      intermediate_size: int, num_experts: int,
+                      experts_per_tok: int) -> int:
+    """Params a token actually multiplies against in an MoE model.
+
+    ``n_params`` counts all E experts, but each token passes through the
+    router plus only K of them (plus every shared weight), so ``6*N``
+    over-counts MoE FLOPs by ~E/K. Subtract the (E-K) inactive experts'
+    three SwiGLU matrices per layer; the router and all shared weights stay
+    in. Matches the grouped dispatch exactly and the einsum impl's useful
+    work (capacity-slot padding is overhead, not model FLOPs).
+    """
+    if num_experts <= 0 or experts_per_tok <= 0 or experts_per_tok >= num_experts:
+        return int(n_params)
+    per_expert = 3 * int(hidden_size) * int(intermediate_size)
+    inactive = int(num_layers) * (int(num_experts) - int(experts_per_tok)) * per_expert
+    return int(n_params) - inactive
+
+
 def model_flops_per_token(model_cfg: Any, n_params: int, seq_len: int) -> float:
     """FLOPs/token from a ModelConfig (config.py) plus the exact param
     count (llama.num_params — analytic dim products would drift from
-    tied-embedding / MoE variants)."""
+    tied-embedding / MoE variants). MoE configs (``moe.num_local_experts``)
+    are costed on ACTIVE params — router + top-k experts + shared weights —
+    so ``mfu=`` on MoE window lines and bench rows reflects work actually
+    done rather than E/K-times it."""
     d_attn = int(model_cfg.num_heads) * int(model_cfg.head_dim)
-    return flops_per_token(n_params, int(model_cfg.num_layers), int(seq_len), d_attn)
+    moe = dict(getattr(model_cfg, "moe", None) or {})
+    n_active = int(n_params)
+    if int(moe.get("num_local_experts", 0) or 0) > 0:
+        n_active = moe_active_params(
+            n_params, int(model_cfg.num_layers), int(model_cfg.hidden_size),
+            int(model_cfg.intermediate_size),
+            int(moe.get("num_local_experts", 0) or 0),
+            int(moe.get("num_experts_per_tok", 0) or 0),
+        )
+    return flops_per_token(n_active, int(model_cfg.num_layers), int(seq_len), d_attn)
 
 
 def peak_flops_per_chip(device_kind: Optional[str] = None) -> Optional[float]:

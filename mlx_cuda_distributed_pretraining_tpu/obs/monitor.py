@@ -86,6 +86,11 @@ class LogTailer:
                 fmt = (".3e" if k == "lr" else ".0f" if k == "tok/s"
                        else ".3f" if k == "mfu" else ".4f")
                 parts.append(f"{k}={self.latest[k]:{fmt}}")
+        # MoE routing health (only present on MoE runs — models/moe.py tap).
+        if "moe_entropy" in self.latest:
+            parts.append(f"moe_ent={self.latest['moe_entropy']:.3f}")
+        if "moe_drop" in self.latest:
+            parts.append(f"moe_drop={int(self.latest['moe_drop'])}")
         if self.val_losses:
             parts.append(f"val_loss={self.val_losses[-1]:.4f}@{self.val_steps[-1]}")
         return " | ".join(parts)

@@ -68,6 +68,29 @@ def batch_pspec(mesh: Mesh) -> P:
     return P(data_axes if data_axes else None, seq_axis)
 
 
+def moe_dispatch_specs(mesh: Mesh) -> dict:
+    """PartitionSpecs for the grouped-MoE shard_map dispatch (models/moe.py).
+
+    The sorted dispatch drops below GSPMD, so the boundary specs are built
+    here next to the parameter rules they must agree with: activations and
+    router outputs (gate indices/weights, and with them the derived
+    group-offset tensors) are batch-sharded like ``batch_pspec``; stacked
+    expert weights split their leading dim over ``ep`` exactly as the
+    ``experts.*`` parameter rules above; the dropped-token count is
+    replicated (psum over every mesh axis inside the body).
+    """
+    data_axes = tuple(a for a in ("dp", "fsdp", "ep") if _axis(mesh, a))
+    batch = data_axes if data_axes else None
+    ep = _axis(mesh, "ep")
+    return {
+        "batch_axes": data_axes,
+        "activation": P(batch, None, None),   # x [B, S, D] / out [B, S, D]
+        "gate": P(batch, None, None),         # gate idx/weights [B, S, K]
+        "expert_weight": P(ep, None, None),   # [E, D, I] / [E, I, D]
+        "replicated": P(),
+    }
+
+
 def tree_pspecs(params: Any, mesh: Mesh) -> Any:
     """PartitionSpec tree for a param pytree (paths joined with '.')."""
     from ..utils.tree import flatten_dict, unflatten_dict

@@ -49,17 +49,34 @@ def make_train_step(
     zero_level: int = 0,
     log_grad_norm: bool = False,
     params_like: Optional[Any] = None,
+    moe_stats_experts: int = 0,
 ) -> Tuple[Callable, Optional[Any]]:
     """Build the jitted step.
 
     ``loss_fn(params, batch) -> (loss, token_count)``.
     Returns ``(step_fn, state_shardings)``; state_shardings is None off-mesh.
     ``step_fn(state, batch) -> (state, metrics)`` with donated state.
+
+    ``moe_stats_experts > 0`` declares that loss_fn was built
+    ``with_moe_stats`` and returns ``(loss, (token_count, stats))``
+    (models/llama.py loss_fn / models/moe.py): the layer-summed expert-load
+    vector and dropped-selection count then ride the metrics dict as
+    ``moe_load`` [E] / ``moe_dropped``.
     """
+    moe_stats = moe_stats_experts > 0
+
+    def zero_stats():
+        from ..models.moe import zero_stats as zs
+
+        return zs(moe_stats_experts)
 
     def grads_of(params, batch):
-        (loss, toks), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
-        return loss, toks, grads
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+        if moe_stats:
+            toks, stats = aux
+        else:
+            toks, stats = aux, None
+        return loss, toks, stats, grads
 
     def accumulate(params, batch):
         # batch leaves [A*b, L] -> scan over A microbatches of [b, L]
@@ -68,25 +85,30 @@ def make_train_step(
 
         micro = jax.tree_util.tree_map(reshape, batch)
         zero_g = jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        zero_s = zero_stats() if moe_stats else None
 
         def body(carry, mb):
-            acc_loss, acc_toks, acc_g = carry
-            loss, toks, g = grads_of(params, mb)
+            acc_loss, acc_toks, acc_s, acc_g = carry
+            loss, toks, stats, g = grads_of(params, mb)
             acc_g = jax.tree_util.tree_map(lambda a, b: a + b, acc_g, g)
-            return (acc_loss + loss, acc_toks + toks, acc_g), None
+            if moe_stats:
+                acc_s = {k: acc_s[k] + stats[k] for k in acc_s}
+            return (acc_loss + loss, acc_toks + toks, acc_s, acc_g), None
 
-        (loss_sum, toks, grads), _ = jax.lax.scan(
-            body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32), zero_g), micro
+        (loss_sum, toks, stats, grads), _ = jax.lax.scan(
+            body,
+            (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32), zero_s, zero_g),
+            micro,
         )
         inv = 1.0 / accum_steps
-        return loss_sum * inv, toks, jax.tree_util.tree_map(lambda g: g * inv, grads)
+        return loss_sum * inv, toks, stats, jax.tree_util.tree_map(lambda g: g * inv, grads)
 
     def train_step(state: TrainState, batch: Dict[str, jnp.ndarray]):
         params = state["params"]
         if accum_steps > 1:
-            loss, toks, grads = accumulate(params, batch)
+            loss, toks, stats, grads = accumulate(params, batch)
         else:
-            loss, toks, grads = grads_of(params, batch)
+            loss, toks, stats, grads = grads_of(params, batch)
         updates, opt_state = optimizer.update(grads, state["opt_state"], params)
         new_params = apply_updates(params, updates)
         metrics = {
@@ -94,6 +116,9 @@ def make_train_step(
             "toks": toks,
             "nonfinite": jnp.logical_not(jnp.isfinite(loss)).astype(jnp.int32),
         }
+        if moe_stats:
+            metrics["moe_load"] = stats["moe_load"]
+            metrics["moe_dropped"] = stats["moe_dropped"]
         if log_grad_norm:
             metrics["grad_norm"] = global_norm(grads)
         new_state = {"params": new_params, "opt_state": opt_state, "step": state["step"] + 1}
@@ -125,6 +150,7 @@ def make_multi_step(
     zero_level: int = 0,
     log_grad_norm: bool = False,
     params_like: Optional[Any] = None,
+    moe_stats_experts: int = 0,
 ) -> Tuple[Callable, Optional[Any]]:
     """K train steps per device dispatch (``system.steps_per_dispatch``).
 
@@ -145,7 +171,7 @@ def make_multi_step(
     single, shardings = make_train_step(
         loss_fn, optimizer, accum_steps=accum_steps, mesh=mesh,
         zero_level=zero_level, log_grad_norm=log_grad_norm,
-        params_like=params_like)
+        params_like=params_like, moe_stats_experts=moe_stats_experts)
 
     def multi_step(state, batches):
         def body(s, b):

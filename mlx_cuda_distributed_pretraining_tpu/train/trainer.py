@@ -166,12 +166,26 @@ class Trainer:
         scan_layers = bool(getattr(cfg.system, "scan_layers", False))
         z_loss_weight = float(cfg.training.hyperparameters.get("z_loss") or 0.0)
 
+        # MoE training steps carry routing stats (expert load, dropped
+        # selections) out through loss_fn's aux — models/moe.py tap. The
+        # pipeline step builds its own loss and does not thread stats.
+        import inspect as _inspect
+
+        pp_mesh = (self.mesh is not None and "pp" in self.mesh.axis_names
+                   and self.mesh.shape["pp"] > 1)
+        self.moe_stats_experts = (
+            args.num_local_experts
+            if (args.is_moe and not pp_mesh and hasattr(arch, "loss_fn")
+                and "with_moe_stats" in
+                _inspect.signature(arch.loss_fn).parameters) else 0)
+        _stats_kw = {"with_moe_stats": True} if self.moe_stats_experts else {}
+
         def loss_fn(params, batch):
             return arch.loss_fn(
                 params, batch, args, compute_dtype=self.compute_dtype,
                 remat=self.remat, remat_ratio=self.remat_ratio,
                 ce_chunk=ce_chunk, scan_layers=scan_layers,
-                z_loss_weight=z_loss_weight,
+                z_loss_weight=z_loss_weight, **_stats_kw,
             )
 
         # Validation excludes MoE router aux terms: val loss / ppl stay pure
@@ -282,6 +296,7 @@ class Trainer:
                 zero_level=cfg.system.zero_optimization_level,
                 log_grad_norm=cfg.logging.log_gradient_norm,
                 params_like=self.params,
+                moe_stats_experts=self.moe_stats_experts,
             )
             if self.steps_per_dispatch > 1:
                 from .train_step import make_multi_step
@@ -293,6 +308,7 @@ class Trainer:
                     zero_level=cfg.system.zero_optimization_level,
                     log_grad_norm=cfg.logging.log_gradient_norm,
                     params_like=self.params,
+                    moe_stats_experts=self.moe_stats_experts,
                 )
             self.eval_step = make_eval_step(self.eval_loss_fn, self.mesh, self.state_shardings)
 
@@ -360,6 +376,16 @@ class Trainer:
             "train_tok_s", "global tokens/second over the last window")
         self._g_mfu = self.metrics.gauge(
             "train_mfu", "model FLOPs utilization over the last window")
+        if self.moe_stats_experts:
+            self._m_moe_dropped = self.metrics.counter(
+                "moe_dropped_tokens_total",
+                "expert selections dropped by capacity limits (0 when dropless)")
+            self._g_moe_load = self.metrics.gauge(
+                "moe_expert_load_frac",
+                "per-expert fraction of routed selections over the last window")
+            self._g_moe_entropy = self.metrics.gauge(
+                "moe_balance_entropy",
+                "normalized routing entropy over the last window (1.0 = uniform)")
 
         if resume and for_training:
             self._resume()
@@ -679,6 +705,7 @@ class Trainer:
             zero_level=self.config.system.zero_optimization_level,
             log_grad_norm=self.config.logging.log_gradient_norm,
             params_like=self.params,
+            moe_stats_experts=self.moe_stats_experts,
         )
         if self.steps_per_dispatch > 1:
             from .train_step import make_multi_step
@@ -690,6 +717,7 @@ class Trainer:
                 zero_level=self.config.system.zero_optimization_level,
                 log_grad_norm=self.config.logging.log_gradient_norm,
                 params_like=self.params,
+                moe_stats_experts=self.moe_stats_experts,
             )
         self.state = init_train_state(self.state["params"], self.optimizer)
         if self.mesh is not None and self.state_shardings is not None:
@@ -744,6 +772,9 @@ class Trainer:
 
         window_tokens = 0
         window_steps = 0
+        # Per-step MoE routing stats stay device-resident until the log
+        # line reads them (one sync per window, same as loss).
+        window_moe: list = []
         # Anything booked so far (step-0 validation, lr finder) happened
         # before the first window's clock starts — flush it into the run
         # totals so every window's components sum to its own wall time.
@@ -921,6 +952,9 @@ class Trainer:
                         self.goodput.add("dispatch_s", t_d)
 
                 window_steps += 1
+                if self.moe_stats_experts and "moe_load" in metrics:
+                    # Device arrays, no sync: summed/read at the log line.
+                    window_moe.append((metrics["moe_load"], metrics["moe_dropped"]))
                 if step % log_int == 0 or step == self.total_steps:
                     loss = float(metrics["loss"])  # device sync point
                     last_loss = loss
@@ -962,6 +996,30 @@ class Trainer:
                     }
                     if "grad_norm" in metrics:
                         line["grad_norm"] = float(metrics["grad_norm"])
+                    if window_moe:
+                        # Routing observability (models/moe.py stats tap):
+                        # expert-load fractions over the window, normalized
+                        # balance entropy (1.0 = uniform routing, 0.0 = one
+                        # expert takes everything), and the dropped-selection
+                        # count (always 0 for the dropless grouped impl;
+                        # nonzero under einsum capacity or a capped ep
+                        # exchange factor).
+                        import numpy as _np
+
+                        load = _np.asarray(sum(m[0] for m in window_moe), _np.float64)
+                        dropped = int(sum(m[1] for m in window_moe))
+                        total = max(load.sum(), 1.0)
+                        frac = load / total
+                        nz = frac[frac > 0]
+                        ent = float(-(nz * _np.log(nz)).sum() / math.log(max(len(load), 2)))
+                        line["moe_entropy"] = ent
+                        line["moe_drop"] = dropped
+                        line["moe_load_max"] = float(frac.max())
+                        self._g_moe_entropy.set(ent)
+                        self._m_moe_dropped.inc(dropped)
+                        for e, f in enumerate(frac):
+                            self._g_moe_load.set(float(f), expert=str(e))
+                        window_moe = []
                     if int(metrics["nonfinite"]):
                         self.logger.log(f"WARNING: non-finite loss at step {step}")
                     self.logger.log_metrics(step, line)

@@ -205,6 +205,143 @@ def test_moe_train_step_on_ep_mesh_matches_single_device():
     assert spec and spec[0] == "ep", f"expert dim not ep-sharded: {spec}"
 
 
+# -- grouped (dropless, sort-based) dispatch ---------------------------------
+
+def test_grouped_matches_einsum_loss_and_grads():
+    # At ample capacity (CF = E/K) the einsum oracle drops nothing, so both
+    # impls compute the same math modulo fp32 summation order.
+    import dataclasses
+
+    args_g = dataclasses.replace(MOE_ARGS, moe_impl="grouped", moe_group_size=16)
+    args_e = dataclasses.replace(
+        MOE_ARGS, moe_impl="einsum", moe_group_size=16,
+        moe_capacity_factor=float(MOE_ARGS.num_local_experts)
+        / MOE_ARGS.num_experts_per_tok)
+    params = llama.init_params(jax.random.PRNGKey(0), MOE_ARGS)
+    batch = _batch()
+    lg, gg = jax.value_and_grad(lambda p: llama.loss_fn(p, batch, args_g)[0])(params)
+    le, ge = jax.value_and_grad(lambda p: llama.loss_fn(p, batch, args_e)[0])(params)
+    assert float(lg) == pytest.approx(float(le), abs=1e-6)
+    flat_g = jax.tree_util.tree_leaves_with_path(gg)
+    flat_e = jax.tree_util.tree_leaves_with_path(ge)
+    for (kg, vg), (ke, ve) in zip(flat_g, flat_e):
+        assert kg == ke
+        np.testing.assert_allclose(
+            np.asarray(vg), np.asarray(ve), atol=1e-6, rtol=1e-4,
+            err_msg=f"grad mismatch at {jax.tree_util.keystr(kg)}")
+
+
+def test_grouped_is_dropless_keeps_overflow_tokens():
+    # Starved capacity: the einsum impl drops selections (counted in its
+    # routing stats), the sorted grouped path keeps every one.
+    import dataclasses
+
+    args_e = dataclasses.replace(
+        MOE_ARGS, moe_impl="einsum", moe_group_size=16, moe_capacity_factor=0.25)
+    args_g = dataclasses.replace(args_e, moe_impl="grouped")
+    params = llama.init_params(jax.random.PRNGKey(0), MOE_ARGS)
+    batch = _batch()
+
+    def run(args):
+        loss, (_, stats) = llama.loss_fn(params, batch, args, with_moe_stats=True)
+        return float(loss), float(stats["moe_dropped"])
+
+    loss_e, dropped_e = run(args_e)
+    loss_g, dropped_g = run(args_g)
+    assert dropped_e > 0, "starved einsum capacity must drop selections"
+    assert dropped_g == 0, "grouped dispatch must be dropless"
+    assert np.isfinite(loss_e) and np.isfinite(loss_g)
+    # the kept overflow tokens actually change the computed loss
+    assert loss_g != pytest.approx(loss_e, abs=1e-7)
+
+
+def test_gmm_backends_match_ragged_fwd_and_bwd():
+    # blocked and (interpret-mode) pallas against the XLA-native
+    # ragged_dot reference: forward values and both gradients.
+    from mlx_cuda_distributed_pretraining_tpu.ops import grouped_matmul as gm
+
+    bt = 64
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(256, 32)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(4, 32, 48)), jnp.float32)
+    sizes = jnp.asarray([64, 0, 128, 64], jnp.int32)  # empty group included
+
+    def loss(x, w, backend):
+        y = gm.gmm(x, w, sizes, block_t=bt, backend=backend)
+        return (y * y).sum(), y
+
+    (ref_l, ref_y), (ref_dx, ref_dw) = jax.value_and_grad(
+        loss, argnums=(0, 1), has_aux=True)(x, w, "ragged")
+    for backend in ("blocked", "pallas"):
+        (l, y), (dx, dw) = jax.value_and_grad(
+            loss, argnums=(0, 1), has_aux=True)(x, w, backend)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref_y),
+                                   atol=1e-5, rtol=1e-5, err_msg=backend)
+        np.testing.assert_allclose(np.asarray(dx), np.asarray(ref_dx),
+                                   atol=1e-3, rtol=1e-4, err_msg=backend)
+        np.testing.assert_allclose(np.asarray(dw), np.asarray(ref_dw),
+                                   atol=1e-3, rtol=1e-4, err_msg=backend)
+
+
+def test_gmm_unknown_backend_rejected():
+    from mlx_cuda_distributed_pretraining_tpu.ops import grouped_matmul as gm
+
+    with pytest.raises(ValueError, match="unknown gmm backend"):
+        gm.gmm(jnp.zeros((8, 4)), jnp.zeros((2, 4, 4)),
+               jnp.asarray([8, 0]), block_t=8, backend="nope")
+
+
+def test_aux_loss_ignores_group_padding():
+    # Regression: aux is computed from real-token router probs before
+    # dispatch, so the S=250 -> 256 group padding (and any other group
+    # size) must not move it at all.
+    import dataclasses
+
+    args = dataclasses.replace(MOE_ARGS, max_position_embeddings=256)
+    params = llama.init_params(jax.random.PRNGKey(0), args)
+    p = params["layers"][0]["feed_forward"]
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 250, 32)), jnp.float32)
+    auxes = [
+        float(moe.moe_block(
+            p, x, dataclasses.replace(args, moe_impl=impl, moe_group_size=g))[1])
+        for impl in ("einsum", "grouped") for g in (256, 125, 250)
+    ]
+    assert auxes[0] > 0
+    for a in auxes[1:]:
+        assert a == auxes[0], f"aux moved with group padding: {auxes}"
+
+
+@pytest.mark.slow
+def test_moe_grouped_ep4_matches_single_device():
+    # Pure ep mesh, one expert shard per device: the all_to_all sorted
+    # exchange must reproduce the single-device grouped loss.
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 virtual devices")
+    sys_cfg = SystemConfig(seed=0, device="cpu", mesh={"ep": 4})
+    mesh = build_mesh(sys_cfg, devices=jax.devices()[:4])
+    params = llama.init_params(jax.random.PRNGKey(0), MOE_ARGS)
+    tr = TrainingConfig(
+        hyperparameters={"learning_rate": 1e-3},
+        scheduler={"type": "cosine"},
+        optimization={"optimizer": "adamw"},
+    )
+    opt = build_optimizer(tr, 10)
+
+    def loss_fn(p, b):
+        return llama.loss_fn(p, b, MOE_ARGS)
+
+    batch = _batch(bs=4)
+    sstep, _ = make_train_step(loss_fn, opt)
+    sstate = init_train_state(jax.tree_util.tree_map(jnp.copy, params), opt)
+    _, smetrics = sstep(sstate, batch)
+
+    step, shardings = make_train_step(loss_fn, opt, mesh=mesh, params_like=params)
+    state = jax.device_put(init_train_state(params, opt), shardings)
+    _, metrics = step(state, batch)
+    assert float(metrics["loss"]) == pytest.approx(
+        float(smetrics["loss"]), rel=1e-6)
+
+
 @pytest.mark.slow
 def test_shampoo_bank_stats_shard_over_ep():
     """Shampoo's per-expert preconditioner stats [E, m, m] must shard over

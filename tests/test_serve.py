@@ -393,6 +393,31 @@ def test_paged_preemption_recompute_keeps_greedy_output():
     assert m["kv_blocks_used"] == 0 and m["kv_blocks_free"] == 4
 
 
+def test_moe_model_batch_engine_greedy_matches_generate_text():
+    # The batch engine's step shares moe_block with training: a MoE
+    # checkpoint must greedy-decode under --engine batch token-for-token
+    # with the single-stream locked path (grouped dispatch is dropless and
+    # deterministic, so decode-time routing is capacity-independent).
+    import dataclasses
+
+    margs = dataclasses.replace(
+        ARGS, num_local_experts=4, num_experts_per_tok=2,
+        moe_aux_weight=0.01, router_z_weight=0.001)
+    mparams = llama.init_params(jax.random.PRNGKey(1), margs)
+    prompts = PARITY_PROMPTS[:3]
+    singles = [
+        generate_text(mparams, margs, TOK, p, max_new_tokens=16,
+                      temperature=0.0)
+        for p in prompts
+    ]
+    cfg = EngineConfig(num_slots=3, max_len=MAX_LEN, prefill_chunk=16)
+    eng = BatchEngine(mparams, margs, TOK, cfg)
+    outs, _ = _collect(eng, prompts, max_tokens=16, temperature=0.0)
+    for ref, out in zip(singles, outs):
+        assert out["text"] == ref
+        assert out["finish_reason"] in ("length", "stop")
+
+
 def test_server_locked_path_unchanged_and_reshaping_knobs_fall_back():
     service = InferenceService(PARAMS, ARGS, TOK, run_name="tiny")
     service.engine = _engine().start()
