@@ -898,6 +898,182 @@ def bench_serve_paged_case(vocab, name="serve_paged"):
     return row
 
 
+def bench_serve_prefix_case(vocab, name="serve_prefix"):
+    """Automatic prefix caching on vs off at the SAME KV byte budget.
+
+    A flood of 24 requests whose prompts are 86% shared prefix (two
+    192-token group templates + a 32-token unique tail — the templated-
+    traffic regime the cache targets, well past the >= 50%-shared bar).
+    Each group's chain is seeded by one request before timing, exactly
+    like a warmed production cache; the cache-off arm runs the identical
+    protocol so the seed cost cancels. Meaningful on CPU: the win is
+    skipped prefill compute, not chip parallelism. Acceptance bar is
+    >= 2x flood prefill throughput AND >= 2x TTFT p50 vs cache-off."""
+    import jax
+    import numpy as np
+
+    from mlx_cuda_distributed_pretraining_tpu.models import llama
+    from mlx_cuda_distributed_pretraining_tpu.serve import (
+        BatchEngine,
+        EngineConfig,
+    )
+
+    sc = SCALES["2m"]
+    MAX_LEN = 256
+    SHARED, TAIL, NEW = 192, 32, 4
+    GROUPS, FLOOD = 2, 24
+    BLOCK = 32
+    BUDGET = 8 * MAX_LEN  # KV positions — identical for both arms
+    args = llama.LlamaArgs(
+        vocab_size=vocab, max_position_embeddings=MAX_LEN, **sc["shape"])
+    params = llama.init_params(jax.random.PRNGKey(0), args)
+    rng = np.random.default_rng(0)
+    heads = [rng.integers(2, vocab, size=SHARED).tolist()
+             for _ in range(GROUPS)]
+    prompts = [heads[i % GROUPS] + rng.integers(2, vocab, size=TAIL).tolist()
+               for i in range(FLOOD)]
+    warm = rng.integers(2, vocab, size=SHARED + TAIL).tolist()
+
+    def run(prefix_on):
+        eng = BatchEngine(params, args, _IdTok(),
+                          EngineConfig(num_slots=8, max_len=MAX_LEN,
+                                       prefill_chunk=64, max_queue=64,
+                                       kv_backend="paged", block_size=BLOCK,
+                                       num_blocks=BUDGET // BLOCK,
+                                       prefix_cache=prefix_on)).start()
+        try:
+            eng._submit_ids(warm, NEW, 0.0, 0).wait(600)  # compile
+            for h in heads:  # seed each group chain (both arms, fairness)
+                eng._submit_ids(h + [2, 3], NEW, 0.0, 0).wait(600)
+            t0 = time.perf_counter()
+            reqs = [eng._submit_ids(ids, NEW, 0.0, 0) for ids in prompts]
+            for r in reqs:
+                r.wait(600)
+            wall = time.perf_counter() - t0
+            ttfts = sorted(r.result["ttft_ms"] for r in reqs)
+            m = eng.metrics()
+            return {"wall": wall,
+                    "prefill_tok_s": FLOOD * (SHARED + TAIL) / wall,
+                    "ttft_p50_ms": ttfts[len(ttfts) // 2],
+                    "hit_rate": m.get("prefix_cache_hit_rate", 0.0),
+                    "evictions": m.get("prefix_cache_evictions", 0)}
+        finally:
+            eng.stop()
+
+    on, off = run(True), run(False)
+    return {
+        "case": name, "vocab": vocab, "shared_tokens": SHARED,
+        "tail_tokens": TAIL, "new_tokens": NEW, "flood_requests": FLOOD,
+        "prefix_groups": GROUPS,
+        "shared_fraction": round(SHARED / (SHARED + TAIL), 2),
+        "kv_budget_tokens": BUDGET, "block_size": BLOCK,
+        "prefill_tok_s_on": round(on["prefill_tok_s"], 1),
+        "prefill_tok_s_off": round(off["prefill_tok_s"], 1),
+        "ttft_p50_ms_on": round(on["ttft_p50_ms"], 1),
+        "ttft_p50_ms_off": round(off["ttft_p50_ms"], 1),
+        "cache_hit_rate": on["hit_rate"],
+        "cache_evictions": on["evictions"],
+        "prefill_speedup": round(
+            on["prefill_tok_s"] / max(off["prefill_tok_s"], 1e-9), 2),
+        "ttft_speedup": round(
+            off["ttft_p50_ms"] / max(on["ttft_p50_ms"], 1e-9), 2),
+    }
+
+
+def bench_serve_router_case(name="serve_router"):
+    """load_gen flood through the prefix-affinity router: 2 replicas vs 1
+    at identical offered load (shared-prefix workload, 4 groups). Uses
+    the real text path — InferenceService + HTTP servers in-process, the
+    repo tokenizer — because the router hashes prompt BYTES. The
+    acceptance bar (>= 1.7x aggregate decode tok/s with 2 replicas) is a
+    chip-parallelism bar: each replica owns an accelerator in
+    production, so the row records ``cores`` to make the basis explicit
+    — on a 1-core CPU container both replicas time-share one core and
+    the honest ratio is ~1x; the case is the harness that demonstrates
+    scaling wherever replicas get their own compute."""
+    import importlib.util
+    import os
+
+    import jax
+
+    from mlx_cuda_distributed_pretraining_tpu.config import DataConfig
+    from mlx_cuda_distributed_pretraining_tpu.infer.server import (
+        InferenceService,
+        serve,
+    )
+    from mlx_cuda_distributed_pretraining_tpu.models import llama
+    from mlx_cuda_distributed_pretraining_tpu.serve import (
+        BatchEngine,
+        EngineConfig,
+        Router,
+        serve_router,
+    )
+    from mlx_cuda_distributed_pretraining_tpu.tokenizer import (
+        TokenizerManager,
+    )
+
+    spec = importlib.util.spec_from_file_location(
+        "load_gen", os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "scripts", "load_gen.py"))
+    load_gen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(load_gen)
+
+    tok = TokenizerManager(DataConfig())
+    sc = SCALES["2m"]
+    MAX_LEN = 256
+    args = llama.LlamaArgs(vocab_size=tok.vocab_size,
+                           max_position_embeddings=MAX_LEN, **sc["shape"])
+    params = llama.init_params(jax.random.PRNGKey(0), args)
+
+    def replica():
+        service = InferenceService(params, args, tok, run_name="bench")
+        service.engine = BatchEngine(
+            params, args, tok,
+            EngineConfig(num_slots=8, max_len=MAX_LEN, prefill_chunk=64,
+                         max_queue=128)).start()
+        httpd = serve(service, port=0)
+        return service, httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def flood(urls):
+        stack = [replica() for _ in urls]
+        router = Router([u for _, _, u in stack], poll_interval_s=0.2)
+        rhttpd = serve_router(router, port=0)
+        try:
+            for _, _, u in stack:  # pay each replica's jit compile
+                load_gen._one_request(u, {"prompt": "warm", "max_tokens": 4},
+                                      600.0)
+            summary = load_gen.run_load(
+                f"http://127.0.0.1:{rhttpd.server_address[1]}",
+                concurrency=8, requests=48, prompt="measure this",
+                max_tokens=32, temperature=0.0, deadline_s=None,
+                timeout=600.0, shared_prefix_tokens=64, prefix_groups=4)
+            return summary
+        finally:
+            rhttpd.shutdown()
+            rhttpd.server_close()
+            router.stop()
+            for service, httpd, _ in stack:
+                httpd.shutdown()
+                httpd.server_close()
+                service.close()
+
+    one, two = flood([1]), flood([1, 2])
+    return {
+        "case": name, "vocab": tok.vocab_size, "requests": 48,
+        "concurrency": 8, "max_tokens": 32, "shared_prefix_tokens": 64,
+        "prefix_groups": 4, "cores": os.cpu_count(),
+        "tok_s_1rep": one["client_tok_s"], "tok_s_2rep": two["client_tok_s"],
+        "router_speedup": round(
+            (two["client_tok_s"] or 0.0) / max(one["client_tok_s"] or 0.0,
+                                               1e-9), 2),
+        "cache_hit_rate_1rep": one.get("cache_hit_rate"),
+        "cache_hit_rate_2rep": two.get("cache_hit_rate"),
+        "ttft_hit_p50_s": two.get("ttft_hit_p50_s"),
+        "ttft_miss_p50_s": two.get("ttft_miss_p50_s"),
+        "ok_2rep": two.get("ok"),
+    }
+
+
 def bench_moe_case(vocab, steps, name="moe_8x40m"):
     """Grouped (dropless, sort-based — ops/grouped_matmul.py) vs einsum
     (GShard dispatch tensors) MoE training throughput on the SAME model:
@@ -1187,6 +1363,14 @@ def build_plan(vocab, steps):
         # budget, >= 2x peak concurrent sequences under mixed lengths, no
         # decode-throughput regression at uniform occupancy 8.
         ("serve_paged", "serve", lambda: bench_serve_paged_case(vocab), 240),
+        # serve_prefix is the prefix-caching acceptance case: >= 2x flood
+        # prefill throughput / TTFT p50 vs prefix_cache=off at the SAME
+        # KV byte budget under 86%-shared-prefix traffic.
+        ("serve_prefix", "serve", lambda: bench_serve_prefix_case(vocab), 240),
+        # serve_router floods load_gen through the prefix-affinity router
+        # at 1 vs 2 replicas; the >= 1.7x aggregate-tok/s bar needs each
+        # replica on its own compute (the row records cores).
+        ("serve_router", "serve", lambda: bench_serve_router_case(), 300),
         # moe_8x40m: grouped (dropless sorted dispatch) vs einsum (GShard
         # capacity tensors) on the same model — a dispatch-algorithm
         # comparison that is meaningful on CPU, like the serve family.

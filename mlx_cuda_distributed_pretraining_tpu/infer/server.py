@@ -25,6 +25,12 @@ Two engines (``--engine``):
   repetition_penalty) fall back to the locked path — the batched step
   samples by temperature only.
 
+Streaming: a ``"stream": true`` body turns the response into SSE
+(text/event-stream) — one ``data: {"token": id, "text": delta}`` event
+per sampled token, then a final ``data: {"done": true, ...result}``
+event. Batch-engine requests stream token-by-token; locked/fallback
+requests emit the final event only.
+
 The first request pays the jit compile either way.
 """
 
@@ -32,6 +38,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import queue as queue_mod
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -129,7 +136,8 @@ class InferenceService:
                                        deadline_s=deadline_s)
             stats_keys = ("generation_tokens", "generation_tps",
                           "mean_logprob", "prompt_tokens",
-                          "stopped_on_token", "ttft_ms")
+                          "stopped_on_token", "ttft_ms",
+                          "prefix_cached_tokens")
             return {
                 "text": out["text"],
                 "tokens": int(out["tokens"]),
@@ -166,6 +174,28 @@ class InferenceService:
             },
             **{k: round(float(v), 4) for k, v in stats.items()},
         }
+
+    def submit_stream(self, prompt: str, max_tokens: int = 64,
+                      temperature: float = 0.0, top_p: float = 0.0,
+                      min_p: float = 0.0,
+                      repetition_penalty: Optional[float] = None,
+                      seed: int = 0,
+                      deadline_s: Optional[float] = None):
+        """Submit through the batch engine for token-by-token streaming;
+        None when the request must take the locked path instead (no
+        engine, or logit-reshaping knobs) — the caller then buffers."""
+        if self.engine is None:
+            return None
+        q_rep = (self._quantize(repetition_penalty)
+                 if repetition_penalty else None)
+        if self._quantize(top_p) or self._quantize(min_p) \
+                or (q_rep or 1.0) != 1.0:
+            return None
+        max_tokens = max(1, min(int(max_tokens), self.max_tokens_limit))
+        return self.engine.submit(prompt, max_tokens=max_tokens,
+                                  temperature=self._quantize(temperature),
+                                  seed=seed, deadline_s=deadline_s,
+                                  stream=True)
 
     def health(self) -> dict:
         d = {
@@ -247,6 +277,59 @@ def make_handler(service: InferenceService):
             self.end_headers()
             self.wfile.write(body)
 
+        def _sse_begin(self):
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+
+        def _sse(self, obj: dict):
+            self.wfile.write(b"data: " + json.dumps(obj).encode() + b"\n\n")
+            self.wfile.flush()
+
+        def _stream_generate(self, req: dict, prompt: str,
+                             effective_max: int,
+                             deadline_s: Optional[float]) -> None:
+            """SSE response: token events as the engine emits them, then
+            the final result. Submission errors (429/400) raise BEFORE
+            any header is written, so do_POST's handlers still apply."""
+            rp = req.get("repetition_penalty")
+            kw = dict(max_tokens=effective_max,
+                      temperature=float(req.get("temperature", 0.0)),
+                      top_p=float(req.get("top_p", 0.0)),
+                      min_p=float(req.get("min_p", 0.0)),
+                      repetition_penalty=(float(rp) if rp is not None
+                                          else None),
+                      seed=int(req.get("seed", 0)), deadline_s=deadline_s)
+            sreq = service.submit_stream(prompt, **kw)
+            if sreq is None:
+                # Locked / logit-reshaping fallback: compute fully (any
+                # error still maps to a JSON status), then emit one event.
+                out = service.generate(prompt=prompt, **kw)
+                self._sse_begin()
+                self._sse({"done": True, **out})
+                return
+            self._sse_begin()
+            toks: list = []
+            prev = ""
+            while True:
+                try:
+                    tok = sreq.stream_q.get(timeout=600.0)
+                except queue_mod.Empty:
+                    self._sse({"done": True, "error": "stream timeout"})
+                    return
+                if tok is None:
+                    break
+                toks.append(int(tok))
+                full = service.tokenizer.detokenize(toks)
+                self._sse({"token": int(tok), "text": full[len(prev):]})
+                prev = full
+            sreq.wait(timeout=30.0)
+            if sreq.error is not None:
+                self._sse({"done": True, "error": sreq.error})
+            else:
+                self._sse({"done": True, **(sreq.result or {})})
+
         def do_GET(self):
             path = self.path.rstrip("/")
             if path in ("", "/healthz"):
@@ -291,6 +374,11 @@ def make_handler(service: InferenceService):
                     1, min(int(req.get("max_tokens", 64)),
                            service.max_tokens_limit))
                 dl = req.get("deadline_s")
+                if req.get("stream"):
+                    self._stream_generate(req, prompt, effective_max,
+                                          float(dl) if dl is not None
+                                          else None)
+                    return
                 out = service.generate(
                     prompt=prompt,
                     max_tokens=effective_max,
@@ -346,6 +434,34 @@ def request_generate(url: str, prompt: str, timeout: float = 300.0,
         return json.loads(resp.read())
 
 
+def request_stream(url: str, prompt: str, timeout: float = 300.0,
+                   **kwargs):
+    """Streaming client: yields each decoded SSE event dict from a
+    ``"stream": true`` /generate request (token events, then the final
+    ``{"done": true, ...}`` summary). Works against a replica server or
+    the router front door (serve/router.py) identically."""
+    import urllib.request
+
+    body = json.dumps({"prompt": prompt, "stream": True, **kwargs}).encode()
+    req = urllib.request.Request(
+        url.rstrip("/") + "/generate", data=body,
+        headers={"Content-Type": "application/json"})
+    resp = urllib.request.urlopen(req, timeout=timeout)
+    try:
+        buf = b""
+        while True:
+            chunk = resp.read1(8192)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n\n" in buf:
+                raw, buf = buf.split(b"\n\n", 1)
+                if raw.startswith(b"data: "):
+                    yield json.loads(raw[len(b"data: "):])
+    finally:
+        resp.close()
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--run", required=True)
@@ -389,6 +505,12 @@ def main(argv=None) -> int:
     p.add_argument("--spec-max-ngram", type=int, default=3,
                    help="paged backend: longest suffix n-gram for prompt-"
                         "lookup drafting")
+    p.add_argument("--no-prefix-cache", action="store_true",
+                   help="paged backend: disable automatic prefix caching "
+                        "(content-hash KV block reuse across requests)")
+    p.add_argument("--prefix-min-hit-blocks", type=int, default=1,
+                   help="paged backend: shortest cached block-chain worth "
+                        "adopting at admission")
     p.add_argument("--deadline-s", type=float, default=None,
                    help="batch engine: default per-request deadline")
     p.add_argument("--stats-url", default=None,
@@ -410,6 +532,8 @@ def main(argv=None) -> int:
             kv_backend=a.kv_backend, block_size=a.block_size,
             num_blocks=a.num_blocks, spec_draft_len=a.spec_draft_len,
             spec_max_ngram=a.spec_max_ngram,
+            prefix_cache=not a.no_prefix_cache,
+            prefix_min_hit_blocks=a.prefix_min_hit_blocks,
             default_deadline_s=a.deadline_s, stats_url=a.stats_url))
     httpd = ThreadingHTTPServer((a.host, a.port), make_handler(service))
     print(f"serving {a.run} ({service.n_params / 1e6:.1f}M params, "

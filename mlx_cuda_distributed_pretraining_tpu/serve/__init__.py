@@ -20,19 +20,32 @@ many requests concurrently from ONE compiled decode step:
   per-request deadlines/max-token limits, iteration-level join/evict,
   and recompute-on-resume preemption for arena exhaustion;
 - ``engine``    — the background engine thread tying it together, with
-  per-iteration metrics published through the obs stats protocol.
+  per-iteration metrics published through the obs stats protocol;
+- ``prefix_cache`` — automatic prefix caching bookkeeping: content-hash
+  keys for full KV blocks (chained blake2b), the LRU retire list, and
+  hit/miss/eviction counters; the paged pool adopts cached block-chains
+  at admission so shared prompt prefixes are never recomputed;
+- ``router``    — the multi-replica HTTP front door: consistent-hash
+  prefix/session affinity (cache hits land where the blocks live),
+  least-loaded spill, SSE pass-through, 429 backpressure with
+  Retry-After, and idempotent retry when a replica dies.
 """
 
 from .engine import BatchEngine, EngineConfig, QueueFullError
 from .kv_pool import PagedKVPool, SlotKVPool
+from .prefix_cache import PrefixCache
+from .router import Router, serve_router
 from .scheduler import Request, Scheduler
 
 __all__ = [
     "BatchEngine",
     "EngineConfig",
     "PagedKVPool",
+    "PrefixCache",
     "QueueFullError",
     "Request",
+    "Router",
     "Scheduler",
     "SlotKVPool",
+    "serve_router",
 ]

@@ -37,6 +37,14 @@ advancing row lengths — rejected tail positions are never referenced
 by any block table, so there is no rollback copy. ``draft_len = 0`` is
 plain paged decode.
 
+Prefix caching needs NO step changes: an admission that adopts cached
+blocks simply starts ``paged_prefill_step`` at ``start = adopted
+tokens`` with a table whose leading entries point at SHARED physical
+blocks — the attention mask (``k_idx <= position``) attends the adopted
+prefix through the same table indirection as self-written blocks, and
+since writes only ever land at positions ``>= length`` (tail or fresh
+blocks), shared full blocks are immutable by construction.
+
 Like infer/generate.py, compiled steps are cached per (args, shape
 bucket); attend lengths are power-of-two buckets so a long-serving
 engine compiles O(log max_len) variants, not one per position.

@@ -22,6 +22,7 @@ unmodified.
 from __future__ import annotations
 
 import dataclasses
+import queue
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -56,6 +57,8 @@ class EngineConfig:
     num_blocks: int = 0         # paged: KV arena size; 0 = slotted-equivalent
     spec_draft_len: int = 0     # paged: drafts verified per decode step; 0 off
     spec_max_ngram: int = 3     # paged: prompt-lookup suffix n-gram bound
+    prefix_cache: bool = True   # paged: content-hash block reuse (off = oracle)
+    prefix_min_hit_blocks: int = 1  # shortest cached chain worth adopting
     default_deadline_s: Optional[float] = None  # per-request unless overridden
     stats_url: Optional[str] = None  # ws://host:port of obs stats server
     stats_interval_s: float = 1.0
@@ -68,7 +71,14 @@ class EngineConfig:
 
         with open(path) as f:
             doc = yaml.safe_load(f) or {}
-        serve = doc.get("serve", doc)
+        serve = dict(doc.get("serve", doc))
+        # Nested prefix_cache block (configs/serve-sample.yaml):
+        #   prefix_cache: {enabled: true, min_hit_blocks: 1}
+        pc = serve.get("prefix_cache")
+        if isinstance(pc, dict):
+            serve["prefix_cache"] = bool(pc.get("enabled", True))
+            if "min_hit_blocks" in pc:
+                serve["prefix_min_hit_blocks"] = int(pc["min_hit_blocks"])
         known = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in serve.items() if k in known})
 
@@ -89,7 +99,9 @@ class BatchEngine:
                 args, self.cfg.num_slots, self.cfg.max_len,
                 block_size=self.cfg.block_size,
                 num_blocks=self.cfg.num_blocks,
-                quantize=self.cfg.kv_quant)
+                quantize=self.cfg.kv_quant,
+                prefix_cache=self.cfg.prefix_cache,
+                min_hit_blocks=self.cfg.prefix_min_hit_blocks)
         elif self.cfg.kv_backend == "slotted":
             if self.cfg.spec_draft_len:
                 raise ValueError(
@@ -147,11 +159,26 @@ class BatchEngine:
         self._mg_spec_rate = reg.gauge(
             "serve_spec_acceptance_rate",
             "accepted/proposed draft tokens over the publish window")
+        # Prefix-cache observability (zero on slotted / prefix_cache=off).
+        self._mc_prefix_hits = reg.counter(
+            "serve_prefix_cache_hits_total",
+            "admissions that adopted a cached block-chain")
+        self._mc_prefix_misses = reg.counter(
+            "serve_prefix_cache_misses_total",
+            "admissions with no usable cached prefix")
+        self._mc_prefix_evictions = reg.counter(
+            "serve_prefix_cache_evictions_total",
+            "cached KV blocks reclaimed by allocation pressure")
+        self._mg_prefix_hit_rate = reg.gauge(
+            "serve_prefix_cache_hit_rate",
+            "prompt tokens served from cache / prompt tokens offered")
         self._spec_proposed = 0
         self._spec_accepted = 0
         self._m_last = {"admitted": 0, "rejected": 0, "evicted": 0,
                         "completed": 0, "preempted": 0, "iterations": 0,
-                        "spec_proposed": 0, "spec_accepted": 0}
+                        "spec_proposed": 0, "spec_accepted": 0,
+                        "prefix_hits": 0, "prefix_misses": 0,
+                        "prefix_evictions": 0}
         self._metrics_server = None
 
     # -- lifecycle -----------------------------------------------------------
@@ -204,16 +231,21 @@ class BatchEngine:
     # -- submission ----------------------------------------------------------
     def submit(self, prompt: str, max_tokens: int = 64,
                temperature: float = 0.0, seed: int = 0,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               stream: bool = False) -> Request:
         """Tokenize and enqueue; raises QueueFullError (-> 429) past the
-        queue bound, ValueError when the request can never fit a slot."""
+        queue bound, ValueError when the request can never fit a slot.
+        With ``stream=True`` the request carries a ``stream_q`` the engine
+        pushes each sampled token id into (None = end of stream) — the
+        HTTP layer drains it into an SSE response."""
         ids = [self.tokenizer.bos_id] + self.tokenizer.tokenize(prompt)
         return self._submit_ids(ids, max_tokens, temperature, seed,
-                                deadline_s)
+                                deadline_s, stream=stream)
 
     def _submit_ids(self, ids: List[int], max_tokens: int,
                     temperature: float, seed: int,
-                    deadline_s: Optional[float] = None) -> Request:
+                    deadline_s: Optional[float] = None,
+                    stream: bool = False) -> Request:
         import jax
 
         P = len(ids)
@@ -232,6 +264,8 @@ class BatchEngine:
                       deadline_s=(deadline_s if deadline_s is not None
                                   else self.cfg.default_deadline_s),
                       stop_ids=[self.tokenizer.eos_id])
+        if stream:
+            req.stream_q = queue.Queue()
         req.rng_key = np.asarray(jax.random.PRNGKey(seed))
         self.scheduler.submit(req)
         self._wake.set()
@@ -279,6 +313,10 @@ class BatchEngine:
                 "spec_acceptance_rate": round(
                     self._spec_accepted / max(self._spec_proposed, 1), 4),
             })
+        prefix = getattr(self.pool, "prefix", None)
+        snap["prefix_cache"] = prefix is not None
+        if prefix is not None:
+            snap.update(prefix.stats())
         snap.update(self._metrics)
         return snap
 
@@ -304,6 +342,7 @@ class BatchEngine:
             self._mg_blocks_free.set(self.pool.free_blocks)
             self._mg_free_watermark.set(self.pool.read_watermark())
             self._mg_fragmentation.set(self.pool.fragmentation())
+        prefix = getattr(self.pool, "prefix", None)
         cur = {"admitted": self.scheduler.admitted,
                "rejected": self.scheduler.rejected,
                "evicted": self.scheduler.evicted,
@@ -311,7 +350,10 @@ class BatchEngine:
                "preempted": self.scheduler.preempted,
                "iterations": self.iterations,
                "spec_proposed": self._spec_proposed,
-               "spec_accepted": self._spec_accepted}
+               "spec_accepted": self._spec_accepted,
+               "prefix_hits": prefix.hits if prefix else 0,
+               "prefix_misses": prefix.misses if prefix else 0,
+               "prefix_evictions": prefix.evictions if prefix else 0}
         for k in ("admitted", "rejected", "evicted", "completed",
                   "preempted"):
             d = cur[k] - self._m_last[k]
@@ -326,6 +368,14 @@ class BatchEngine:
         if dp > 0:
             self._mg_spec_rate.set(
                 (cur["spec_accepted"] - self._m_last["spec_accepted"]) / dp)
+        for k, c in (("prefix_hits", self._mc_prefix_hits),
+                     ("prefix_misses", self._mc_prefix_misses),
+                     ("prefix_evictions", self._mc_prefix_evictions)):
+            d = cur[k] - self._m_last[k]
+            if d > 0:
+                c.inc(d)
+        if prefix is not None:
+            self._mg_prefix_hit_rate.set(prefix.hit_rate())
         d = cur["iterations"] - self._m_last["iterations"]
         if d > 0:
             self._mc_iterations.inc(d)
@@ -383,6 +433,15 @@ class BatchEngine:
             b = min(batch_step.round_up(b, pool.block_size), pool.max_len)
         return b
 
+    def _register_prefix(self, req: Request) -> None:
+        """Publish every newly FILLED block of this request into the
+        prefix cache (content-hash keys chained from the sequence head).
+        Called after each lengths[] advance; no-op without a paged pool
+        with prefix caching on."""
+        prefix = getattr(self.pool, "prefix", None)
+        if prefix is not None and req.slot is not None:
+            self.pool.register_upto(req.slot, req.prefill_source())
+
     def _prefill_chunk(self, req: Request) -> None:
         pool, C = self.pool, self.chunk
         source = req.prefill_source()
@@ -409,6 +468,7 @@ class BatchEngine:
         pool.cache = cache
         req.prefilled = start + n
         pool.lengths[req.slot] = min(start + n, P)
+        self._register_prefix(req)
         if not final:
             return
         pool.lengths[req.slot] = P
@@ -541,6 +601,7 @@ class BatchEngine:
                 # tail KV is never referenced and the next window
                 # overwrites it (no rollback copies).
                 pool.lengths[s] = p0 + len(emitted)
+                self._register_prefix(r)
 
     def _emit(self, req: Request, tok: int, lp: float) -> None:
         """Account one sampled token: stop/length bookkeeping mirrors
@@ -551,6 +612,8 @@ class BatchEngine:
         req.tokens.append(tok)
         req.logprobs.append(lp)
         req.last_token = tok
+        if req.stream_q is not None:
+            req.stream_q.put(tok)
         self._win_tokens += 1
         if len(req.tokens) >= req.max_tokens:
             self._finish(req, "length")
@@ -573,6 +636,7 @@ class BatchEngine:
             "mean_logprob": (float(np.mean(req.logprobs))
                              if req.logprobs else 0.0),
             "prompt_tokens": float(len(req.prompt_ids)),
+            "prefix_cached_tokens": float(req.cached_tokens),
             "stopped_on_token": float(reason == "stop"),
             **({"ttft_ms": round(ttft_ms, 1)} if ttft_ms is not None else {}),
         })

@@ -23,9 +23,10 @@ sequence length is ``max_len - 1``.
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 from ..models import llama
+from .prefix_cache import PrefixCache, chain_keys
 
 
 class SlotKVPool:
@@ -75,10 +76,12 @@ class SlotKVPool:
         return self.num_used / self.num_slots
 
     # -- slot lifecycle ------------------------------------------------------
-    def allocate(self, need_tokens: int = 0) -> Optional[int]:
+    def allocate(self, need_tokens: int = 0,
+                 token_ids: Optional[Sequence[int]] = None) -> Optional[int]:
         """Claim a free slot (resets its length); None when the pool is full.
-        ``need_tokens`` is part of the shared pool interface — a slot always
-        holds ``capacity`` tokens, so it is ignored here."""
+        ``need_tokens``/``token_ids`` are part of the shared pool interface
+        — a slot always holds ``capacity`` tokens and has no prefix cache,
+        so both are ignored here."""
         if not self._free:
             return None
         slot = self._free.pop()
@@ -137,13 +140,27 @@ class PagedKVPool:
       * block_size). ``free_watermark`` tracks the minimum free-block count
       since the last ``read_watermark()`` — the headroom metric that says
       how close the arena came to exhaustion.
+
+    Automatic prefix caching (``prefix_cache=True``): every physical block
+    carries a refcount, full blocks become content-addressable through a
+    ``PrefixCache`` (key = hash(parent_key, token_ids); see
+    prefix_cache.py), and ``allocate(token_ids=...)`` adopts the longest
+    cached block-chain for the prompt — block tables point at SHARED
+    physical blocks (zero copy, refcount++) and ``lengths[seq]`` starts at
+    the adopted token count so chunked prefill skips the hit prefix.
+    Freed refcount-0 blocks with published keys retire to an LRU list
+    instead of the free list (their bytes stay adoptable); allocation and
+    ``ensure_capacity`` growth evict from the LRU end only when the plain
+    free list runs dry. ``prefix_cache=False`` (default) is bit-for-bit
+    the pre-cache pool.
     """
 
     kind = "paged"
 
     def __init__(self, args: llama.LlamaArgs, num_seqs: int, max_len: int,
                  block_size: int = 32, num_blocks: int = 0,
-                 dtype=None, quantize: bool = False):
+                 dtype=None, quantize: bool = False,
+                 prefix_cache: bool = False, min_hit_blocks: int = 1):
         import jax.numpy as jnp
         import numpy as np
 
@@ -178,6 +195,14 @@ class PagedKVPool:
         self._free_rows: List[int] = list(range(num_seqs - 1, -1, -1))
         self._free_blocks: List[int] = list(range(num_blocks, 0, -1))
         self._watermark = num_blocks
+        # Prefix cache: per-block refcounts + content-hash bookkeeping.
+        # Block 0 (junk) is never allocated, registered, or refcounted.
+        self.prefix: Optional[PrefixCache] = (
+            PrefixCache(block_size, min_hit_blocks) if prefix_cache else None)
+        self._ref: List[int] = [0] * (num_blocks + 1)
+        # per row: leading full blocks already published + chain parent key
+        self._registered: List[int] = [0] * num_seqs
+        self._chain_key: List[Optional[bytes]] = [None] * num_seqs
 
     # -- capacity ------------------------------------------------------------
     @property
@@ -201,11 +226,17 @@ class PagedKVPool:
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free_blocks)
+        """Allocatable blocks: the plain free list plus retired (refcount
+        0, still content-addressable) cached blocks — both satisfy an
+        allocation, retired ones via LRU eviction."""
+        free = len(self._free_blocks)
+        if self.prefix is not None:
+            free += self.prefix.retired_blocks
+        return free
 
     @property
     def blocks_in_use(self) -> int:
-        return self.num_blocks - len(self._free_blocks)
+        return self.num_blocks - self.free_blocks
 
     def blocks_for(self, tokens: int) -> int:
         return -(-tokens // self.block_size) if tokens > 0 else 0
@@ -223,27 +254,80 @@ class PagedKVPool:
     def read_watermark(self) -> int:
         """Minimum free-block count since the previous call (then reset)."""
         w = self._watermark
-        self._watermark = len(self._free_blocks)
+        self._watermark = self.free_blocks
         return w
 
     def _note_free_level(self) -> None:
-        if len(self._free_blocks) < self._watermark:
-            self._watermark = len(self._free_blocks)
+        free = self.free_blocks
+        if free < self._watermark:
+            self._watermark = free
+
+    # -- block supply --------------------------------------------------------
+    def _take_block(self) -> Optional[int]:
+        """One allocatable block: the plain free list first, then — with
+        the prefix cache on — evict the least-recently-retired cached
+        block (refcount-0 only by construction; its key is unpublished
+        before reuse, so a stale chain can never match recycled bytes)."""
+        if self._free_blocks:
+            return self._free_blocks.pop()
+        if self.prefix is not None:
+            return self.prefix.evict_lru()
+        return None
+
+    def _release_block(self, block: int) -> None:
+        """Refcount-- ; at zero a registered block retires to the prefix
+        LRU (bytes stay adoptable), an unregistered one frees outright."""
+        self._ref[block] -= 1
+        if self._ref[block] > 0:
+            return
+        if self.prefix is None or not self.prefix.retire(block):
+            self._free_blocks.append(block)
 
     # -- sequence lifecycle --------------------------------------------------
-    def allocate(self, need_tokens: int = 0) -> Optional[int]:
+    def allocate(self, need_tokens: int = 0,
+                 token_ids: Optional[Sequence[int]] = None) -> Optional[int]:
         """Claim a batch row and map enough blocks for ``need_tokens``
         (the prompt). None when no row is free OR the arena cannot cover
-        the request — admission is gated on actual free blocks."""
+        the request — admission is gated on actual free blocks.
+
+        With the prefix cache on and ``token_ids`` given, the longest
+        cached block-chain covering the prompt is ADOPTED instead of
+        allocated: those table entries point at shared physical blocks
+        (refcount++, zero copy) and ``lengths[seq]`` starts at the
+        adopted token count — the engine's chunked prefill resumes there.
+        At least the final prompt token is always recomputed (its logits
+        seed sampling), and nothing is mutated on refusal."""
+        adopted: List[int] = []
+        adopted_key: Optional[bytes] = None
+        if self.prefix is not None and token_ids is not None \
+                and need_tokens > 0:
+            adopted, adopted_key = self.prefix.match(
+                token_ids, max_blocks=self.max_blocks)
         need = self.blocks_for(need_tokens)
-        if not self._free_rows or need > len(self._free_blocks):
+        fresh = need - len(adopted)
+        # Retired blocks about to be adopted are NOT allocatable supply:
+        # revival pulls them off the LRU, so exclude them from the gate.
+        adopting_retired = sum(1 for b in adopted if self._ref[b] == 0)
+        if not self._free_rows or fresh > self.free_blocks - adopting_retired:
             return None
         seq = self._free_rows.pop()
-        self.lengths[seq] = 0
         self.tables[seq, :] = 0
-        for i in range(need):
-            self.tables[seq, i] = self._free_blocks.pop()
+        for i, b in enumerate(adopted):
+            self.tables[seq, i] = b
+            self._ref[b] += 1
+            if self._ref[b] == 1:
+                self.prefix.revive(b)
+        for i in range(len(adopted), need):
+            b = self._take_block()
+            self.tables[seq, i] = b
+            self._ref[b] = 1
         self._mapped[seq] = need
+        cached = len(adopted) * self.block_size
+        self.lengths[seq] = cached
+        self._registered[seq] = len(adopted)
+        self._chain_key[seq] = adopted_key
+        if self.prefix is not None and need_tokens > 0:
+            self.prefix.note_lookup(need_tokens, cached)
         self._note_free_level()
         return seq
 
@@ -257,24 +341,52 @@ class PagedKVPool:
         grow = need - self._mapped[seq]
         if grow <= 0:
             return True
-        if grow > len(self._free_blocks):
+        if grow > self.free_blocks:
             return False
         for i in range(self._mapped[seq], need):
-            self.tables[seq, i] = self._free_blocks.pop()
+            b = self._take_block()
+            self.tables[seq, i] = b
+            self._ref[b] = 1
         self._mapped[seq] = need
         self._note_free_level()
         return True
 
+    def register_upto(self, seq: int, token_ids: Sequence[int]) -> None:
+        """Publish content-hash keys for this row's newly-FULL blocks
+        (``lengths[seq] // block_size`` leading blocks hold immutable,
+        fully-written KV; the tail block is still mutable and never
+        published). ``token_ids`` must be the fed-token sequence whose KV
+        the row holds — prompt plus generated — so generated blocks are
+        adoptable too (RadixAttention-style). Idempotent per block: each
+        row tracks how far its chain has been published."""
+        if self.prefix is None:
+            return
+        full = min(self.lengths[seq] // self.block_size, self._mapped[seq])
+        if full <= self._registered[seq]:
+            return
+        keys = chain_keys(token_ids[:full * self.block_size],
+                          self.block_size,
+                          parent_key=self._chain_key[seq],
+                          start_block=self._registered[seq])
+        for i, key in zip(range(self._registered[seq], full), keys):
+            self.prefix.register(key, int(self.tables[seq, i]))
+            self._chain_key[seq] = key
+        self._registered[seq] = full
+
     def free(self, seq: int) -> None:
-        """Return the row and all its mapped blocks; O(mapped) list appends."""
+        """Return the row; each mapped block's refcount drops, and blocks
+        reaching zero either retire to the prefix LRU (registered) or
+        rejoin the free list. O(mapped) list ops."""
         if not 0 <= seq < self.num_slots:
             raise ValueError(f"seq {seq} out of range 0..{self.num_slots - 1}")
         if seq in self._free_rows:
             raise ValueError(f"seq {seq} double-freed")
         for i in range(self._mapped[seq]):
-            self._free_blocks.append(int(self.tables[seq, i]))
+            self._release_block(int(self.tables[seq, i]))
         self.tables[seq, :] = 0  # unmapped rows scatter to the junk block
         self._mapped[seq] = 0
+        self._registered[seq] = 0
+        self._chain_key[seq] = None
         self._free_rows.append(seq)
 
     def reset(self) -> None:
@@ -285,6 +397,11 @@ class PagedKVPool:
         self._free_rows = list(range(self.num_slots - 1, -1, -1))
         self._free_blocks = list(range(self.num_blocks, 0, -1))
         self._watermark = self.num_blocks
+        self._ref = [0] * (self.num_blocks + 1)
+        self._registered = [0] * self.num_slots
+        self._chain_key = [None] * self.num_slots
+        if self.prefix is not None:
+            self.prefix.clear()
 
     def max_active_len(self, seqs) -> int:
         """Longest written length among ``seqs`` — drives the attend bucket

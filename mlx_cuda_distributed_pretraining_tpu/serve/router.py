@@ -1,0 +1,445 @@
+"""Prefix-affinity router: one HTTP front door over N engine replicas.
+
+A single batch engine is bounded by one accelerator; the router is the
+horizontal-scale front door (ROADMAP open item 3). It owns no model —
+it forwards ``/generate`` / ``/v1/completions`` bodies to replica
+servers (infer/server.py processes) and picks the replica so that
+prefix-cache hits actually land where the cached blocks live:
+
+- **prefix affinity** — the routing key is the first KV-block key of the
+  prompt's byte sequence (``prefix_cache.chain_keys`` over raw bytes:
+  the byte-fallback tokenizer is ~1 token/byte, so byte blocks track
+  token blocks). Requests sharing a templated prefix hash to the same
+  replica, whose prefix cache then serves the shared blocks.
+- **session affinity** — a client-supplied ``"session"`` field
+  overrides the prefix key, pinning a conversation (and its growing
+  generated-KV chain) to one replica.
+- **consistent hashing** — keys map onto a ring of virtual nodes, so
+  adding/removing a replica remaps only ~1/N of the key space (cached
+  prefixes elsewhere stay warm).
+- **least-loaded fallback** — a replica whose known queue depth exceeds
+  ``spill_depth`` spills new keys to the least-loaded replica instead of
+  queueing behind the hot spot; with every replica saturated the router
+  answers 429 with a ``Retry-After`` derived from the shallowest queue.
+- **retry on replica death** — generation requests are idempotent
+  (seeded sampling), so a connection failure marks the replica down and
+  replays the request on the next candidate — as long as no response
+  bytes have been forwarded yet. A background poller probes ``/metrics``
+  for queue depth and revives replicas that answer again.
+- **streaming** — ``"stream": true`` bodies are forwarded as-is and the
+  replica's SSE byte stream is piped through unbuffered.
+
+Stdlib only (http.server + urllib), same as the replica server.
+"""
+
+from __future__ import annotations
+
+import argparse
+import bisect
+import hashlib
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from .prefix_cache import chain_keys
+
+__all__ = ["Router", "Replica", "serve_router"]
+
+
+def _hash64(data: bytes) -> int:
+    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(), "big")
+
+
+class _Ring:
+    """Consistent-hash ring with virtual nodes (bounded remap on resize)."""
+
+    def __init__(self, ids: List[str], vnodes: int = 64):
+        points = []
+        for rid in ids:
+            for i in range(vnodes):
+                points.append((_hash64(f"{rid}#{i}".encode()), rid))
+        points.sort()
+        self._hashes = [h for h, _ in points]
+        self._ids = [r for _, r in points]
+
+    def lookup(self, key: bytes) -> Optional[str]:
+        if not self._ids:
+            return None
+        i = bisect.bisect(self._hashes, _hash64(key)) % len(self._ids)
+        return self._ids[i]
+
+
+class Replica:
+    """Router-side view of one engine replica (no model state here)."""
+
+    def __init__(self, rid: str, url: str):
+        self.id = rid
+        self.url = url.rstrip("/")
+        self.up = True            # optimistic until a probe/dispatch fails
+        self.queue_depth = 0
+        self.occupancy = 0
+        self.inflight = 0         # router-side: requests currently forwarded
+        self.last_error: Optional[str] = None
+
+    @property
+    def load(self) -> int:
+        """Dispatch-ordering load: replica queue + what we just sent it."""
+        return self.queue_depth + self.inflight
+
+    def snapshot(self) -> Dict[str, object]:
+        return {"url": self.url, "up": self.up,
+                "queue_depth": self.queue_depth, "inflight": self.inflight,
+                "occupancy": self.occupancy,
+                **({"last_error": self.last_error} if self.last_error else {})}
+
+
+class Router:
+    def __init__(self, replica_urls: List[str], affinity: str = "prefix",
+                 block_size: int = 32,
+                 vnodes: int = 64, spill_depth: int = 8,
+                 poll_interval_s: float = 0.5, retries: int = 1,
+                 request_timeout_s: float = 600.0):
+        if not replica_urls:
+            raise ValueError("router needs at least one replica URL")
+        if affinity not in ("prefix", "none"):
+            raise ValueError(f"unknown affinity {affinity!r} "
+                             "(expected 'prefix' or 'none')")
+        self.replicas: Dict[str, Replica] = {
+            f"r{i}": Replica(f"r{i}", u) for i, u in enumerate(replica_urls)}
+        self.affinity = affinity
+        self.block_size = block_size
+        self.spill_depth = spill_depth
+        self.poll_interval_s = poll_interval_s
+        self.retries = max(0, retries)
+        self.request_timeout_s = request_timeout_s
+        self._ring = _Ring(sorted(self.replicas), vnodes=vnodes)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._poller: Optional[threading.Thread] = None
+        from ..obs.metrics import MetricsRegistry
+
+        self.metrics_registry = MetricsRegistry()
+        reg = self.metrics_registry
+        self._mc_requests = reg.counter(
+            "serve_router_requests_total",
+            "routed requests by replica and outcome")
+        self._mc_retries = reg.counter(
+            "serve_router_retries_total",
+            "requests replayed on another replica after a failure")
+        self._mg_up = reg.gauge(
+            "serve_router_replica_up", "1 = replica answering, 0 = down")
+        self._mg_depth = reg.gauge(
+            "serve_router_replica_queue_depth",
+            "last polled admission-queue depth per replica")
+        self._mg_inflight = reg.gauge(
+            "serve_router_replica_inflight",
+            "requests currently forwarded to the replica")
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "Router":
+        if self._poller is None:
+            self._stop.clear()
+            self.poll_once()  # synchronous first probe: mark dead replicas
+            self._poller = threading.Thread(target=self._poll_loop,
+                                            daemon=True, name="router-poll")
+            self._poller.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._poller is not None:
+            self._poller.join(timeout=5.0)
+            self._poller = None
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            self.poll_once()
+
+    def poll_once(self) -> None:
+        """Probe every replica's /metrics for queue depth (and liveness —
+        a down replica that answers again is revived here)."""
+        for r in self.replicas.values():
+            try:
+                with urllib.request.urlopen(r.url + "/metrics",
+                                            timeout=2.0) as resp:
+                    m = json.loads(resp.read())
+                r.queue_depth = int(m.get("queue_depth", 0))
+                r.occupancy = int(m.get("batch_occupancy", 0))
+                r.up = True
+                r.last_error = None
+            except Exception as e:  # noqa: BLE001 - any failure = down
+                r.up = False
+                r.last_error = f"{type(e).__name__}: {e}"
+            self._mg_up.set(1.0 if r.up else 0.0, replica=r.id)
+            self._mg_depth.set(r.queue_depth, replica=r.id)
+            self._mg_inflight.set(r.inflight, replica=r.id)
+
+    # -- routing -------------------------------------------------------------
+    def routing_key(self, body: dict) -> Optional[bytes]:
+        """Session id if the client pinned one, else the FIRST KV-block
+        key of the prompt bytes (byte blocks ~ token blocks under the
+        byte-fallback tokenizer): every prompt sharing the first
+        ``block_size`` bytes — a templated system prefix — hashes to the
+        same replica regardless of tail or length, landing where the
+        cached blocks live."""
+        session = body.get("session")
+        if session:
+            return f"session:{session}".encode()
+        if self.affinity == "none":
+            return None
+        prompt = body.get("prompt")
+        if isinstance(prompt, list) and prompt:
+            prompt = prompt[0]
+        if not isinstance(prompt, str) or not prompt:
+            return None
+        head = prompt.encode()[:self.block_size]
+        if len(head) < self.block_size:
+            return head  # short prompt: raw bytes still give a stable key
+        return chain_keys(head, self.block_size)[0]
+
+    def candidates(self, key: Optional[bytes]) -> List[Replica]:
+        """Dispatch order: the affinity target first (unless saturated),
+        then every other live replica by ascending load."""
+        with self._lock:
+            alive = [r for r in self.replicas.values() if r.up]
+            if not alive:
+                return []
+            order = sorted(alive, key=lambda r: (r.load, r.id))
+            primary = self._ring.lookup(key) if key is not None else None
+            if primary is not None:
+                p = self.replicas[primary]
+                if p.up and p.queue_depth < self.spill_depth:
+                    order.remove(p)
+                    order.insert(0, p)
+            return order
+
+    # -- dispatch ------------------------------------------------------------
+    def dispatch(self, path: str, body: dict):
+        """Forward ``body`` to the best replica; returns the open HTTP
+        response (caller reads/streams it) plus the replica. Connection
+        failures mark the replica down and replay on the next candidate
+        (idempotent: sampling is seeded); replica 429s propagate after
+        every candidate rejected."""
+        key = self.routing_key(body)
+        cands = self.candidates(key)
+        if not cands:
+            raise NoReplicaError("no live replica")
+        data = json.dumps(body).encode()
+        tried = 0
+        saturated: Optional[urllib.error.HTTPError] = None
+        for r in cands:
+            if tried > self.retries + 1:
+                break
+            tried += 1
+            req = urllib.request.Request(
+                r.url + path, data=data,
+                headers={"Content-Type": "application/json"})
+            try:
+                resp = urllib.request.urlopen(
+                    req, timeout=self.request_timeout_s)
+                return resp, r
+            except urllib.error.HTTPError as e:
+                if e.code == 429:  # replica queue full: try the next one
+                    saturated = e
+                    self._mc_requests.inc(replica=r.id, outcome="saturated")
+                    continue
+                self._mc_requests.inc(replica=r.id, outcome="http_error")
+                raise
+            except Exception as e:  # noqa: BLE001 - connection-level death
+                r.up = False
+                r.last_error = f"{type(e).__name__}: {e}"
+                self._mg_up.set(0.0, replica=r.id)
+                self._mc_requests.inc(replica=r.id, outcome="dead")
+                self._mc_retries.inc()
+                continue
+        if saturated is not None:
+            raise BackpressureError(self.retry_after())
+        raise NoReplicaError("every replica failed or is down")
+
+    def retry_after(self) -> int:
+        """Seconds a 429'd client should wait: scaled to the shallowest
+        queue across live replicas (capped — it is a hint, not a lease)."""
+        with self._lock:
+            depths = [r.queue_depth for r in self.replicas.values() if r.up]
+        return max(1, min(30, min(depths, default=4) // 4 + 1))
+
+    def health(self) -> dict:
+        ups = sum(1 for r in self.replicas.values() if r.up)
+        return {"status": "ok" if ups else "unavailable",
+                "role": "router", "replicas_up": ups,
+                "replicas": {r.id: r.snapshot()
+                             for r in self.replicas.values()},
+                "affinity": self.affinity}
+
+
+class NoReplicaError(Exception):
+    """No live replica could take the request (-> 503)."""
+
+
+class BackpressureError(Exception):
+    """Every candidate replica is queue-full (-> 429 + Retry-After)."""
+
+    def __init__(self, retry_after_s: int):
+        super().__init__(f"all replicas saturated; retry in {retry_after_s}s")
+        self.retry_after_s = retry_after_s
+
+
+def make_router_handler(router: Router):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *a):  # quiet by default
+            pass
+
+        def _reply(self, code: int, payload: dict,
+                   headers: Optional[Dict[str, str]] = None):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            path = self.path.rstrip("/")
+            if path in ("", "/healthz"):
+                h = router.health()
+                self._reply(200 if h["replicas_up"] else 503, h)
+            elif path == "/metrics":
+                self._reply(200, {
+                    "role": "router",
+                    "replicas": {r.id: r.snapshot()
+                                 for r in router.replicas.values()},
+                })
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):
+            path = self.path.rstrip("/")
+            if path not in ("/generate", "/v1/completions"):
+                self._reply(404, {"error": f"unknown path {self.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+            except (ValueError, json.JSONDecodeError) as e:
+                self._reply(400, {"error": str(e)})
+                return
+            try:
+                resp, replica = router.dispatch(path, body)
+            except BackpressureError as e:
+                self._reply(429, {"error": str(e)},
+                            headers={"Retry-After": str(e.retry_after_s)})
+                return
+            except NoReplicaError as e:
+                self._reply(503, {"error": str(e)})
+                return
+            except urllib.error.HTTPError as e:
+                # Replica-side 4xx/5xx: pass status and body through.
+                payload = e.read()
+                self.send_response(e.code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+                return
+            replica.inflight += 1
+            try:
+                self._pipe(resp, replica)
+            finally:
+                replica.inflight -= 1
+                resp.close()
+
+        def _pipe(self, resp, replica) -> None:
+            """Forward the replica response verbatim — one buffered body
+            for JSON, unbuffered chunks for SSE streams."""
+            ctype = resp.headers.get("Content-Type", "application/json")
+            clen = resp.headers.get("Content-Length")
+            self.send_response(resp.status)
+            self.send_header("Content-Type", ctype)
+            if clen is not None:
+                self.send_header("Content-Length", clen)
+            self.end_headers()
+            try:
+                if clen is not None:
+                    self.wfile.write(resp.read(int(clen)))
+                else:
+                    # SSE: read1 returns whatever the replica has flushed
+                    # (read(n) would block for a full buffer mid-stream).
+                    while True:
+                        chunk = resp.read1(8192)
+                        if not chunk:
+                            break
+                        self.wfile.write(chunk)
+                        self.wfile.flush()
+                router._mc_requests.inc(replica=replica.id, outcome="ok")
+            except Exception:  # noqa: BLE001 - replica died mid-stream
+                # Bytes already left for the client: cannot retry (the
+                # request would double-bill tokens); surface the break.
+                replica.up = False
+                router._mc_requests.inc(replica=replica.id,
+                                        outcome="broken_stream")
+                raise
+
+    return Handler
+
+
+def serve_router(router: Router, host: str = "127.0.0.1",
+                 port: int = 0) -> ThreadingHTTPServer:
+    """Start the router HTTP front door on a background thread; returns
+    the server (stop with shutdown() + server_close(), then router.stop())."""
+    router.start()
+    httpd = ThreadingHTTPServer((host, port), make_router_handler(router))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="serve-router")
+    t.start()
+    return httpd
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--replicas", required=True,
+                   help="comma-separated replica base URLs "
+                        "(http://host:port of infer.server processes)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8500)
+    p.add_argument("--affinity", choices=("prefix", "none"), default="prefix",
+                   help="prefix = consistent-hash the first prompt block "
+                        "(cache hits land where the blocks live); none = "
+                        "pure least-loaded")
+    p.add_argument("--block-size", type=int, default=32,
+                   help="bytes per affinity block (match the replicas' KV "
+                        "block size)")
+    p.add_argument("--spill-depth", type=int, default=8,
+                   help="replica queue depth beyond which new keys spill "
+                        "to the least-loaded replica")
+    p.add_argument("--poll-interval", type=float, default=0.5,
+                   help="seconds between replica /metrics probes")
+    p.add_argument("--retries", type=int, default=1,
+                   help="replays on another replica after a connection "
+                        "failure (requests are idempotent: seeded sampling)")
+    a = p.parse_args(argv)
+    router = Router([u for u in a.replicas.split(",") if u],
+                    affinity=a.affinity, block_size=a.block_size,
+                    spill_depth=a.spill_depth,
+                    poll_interval_s=a.poll_interval, retries=a.retries)
+    httpd = serve_router(router, a.host, a.port)
+    print(f"router over {len(router.replicas)} replicas "
+          f"on http://{a.host}:{httpd.server_address[1]}")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        router.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -27,7 +27,7 @@ import itertools
 import threading
 import time
 from collections import deque
-from typing import Deque, Dict, List, Optional
+from typing import Any, Deque, Dict, List, Optional
 
 # Request lifecycle states
 QUEUED = "queued"      # accepted, waiting for a slot
@@ -65,6 +65,8 @@ class Request:
         self.rng_key = None         # per-request PRNG chain (engine-owned)
         self.tokens: List[int] = []
         self.logprobs: List[float] = []
+        self.cached_tokens = 0      # prompt tokens adopted from the prefix cache
+        self.stream_q: Optional[Any] = None  # queue.Queue when streaming (SSE)
         self.first_token_at: Optional[float] = None  # TTFT marker
         self.finish_reason: Optional[str] = None
         self.error: Optional[str] = None
@@ -81,6 +83,8 @@ class Request:
         self.result = result
         self.error = error
         self._done.set()
+        if self.stream_q is not None:
+            self.stream_q.put(None)  # stream sentinel: no more tokens
 
     @property
     def position(self) -> int:
@@ -133,13 +137,19 @@ class Scheduler:
         with self.lock:
             while self.queue and pool.num_free > 0:
                 req = self.queue[0]
-                slot = pool.allocate(len(req.prefill_source()))
+                source = req.prefill_source()
+                slot = pool.allocate(len(source), token_ids=source)
                 if slot is None:
                     break
                 self.queue.popleft()
                 req.slot = slot
                 req.state = PREFILL
-                req.prefilled = 0
+                # A prefix-caching pool may have ADOPTED cached blocks for
+                # a leading chunk of the prompt: lengths[slot] is the
+                # already-valid KV extent, so prefill resumes there
+                # instead of position 0 (0 on non-caching pools).
+                req.prefilled = pool.lengths[slot]
+                req.cached_tokens = max(req.cached_tokens, req.prefilled)
                 self.running[slot] = req
                 self.admitted += 1
                 out.append(req)

@@ -15,6 +15,15 @@ Stdlib-only, so it runs anywhere the repo does:
 Point it at a ``--engine locked`` server and then a ``--engine batch``
 one to see continuous batching under identical offered load (the
 serve_batch bench case does the same comparison in-process).
+
+Shared-prefix workload (``--shared-prefix-tokens N --prefix-groups G``):
+every request's prompt starts with one of G fixed ~N-token prefixes
+(the byte-fallback tokenizer is ~1 token/char), modelling templated
+traffic — system prompts, few-shot headers. Against a prefix-caching
+server the summary splits TTFT p50/p95 by cache hit vs miss (the server
+reports ``prefix_cached_tokens`` per request) and adds the aggregate
+``cache_hit_rate``; against the router (serve/router.py) each group is
+consistently hashed to one replica, so hits land where the blocks live.
 """
 
 from __future__ import annotations
@@ -40,19 +49,32 @@ def _one_request(url: str, body: dict, timeout: float) -> dict:
             ttft = out.get("ttft_ms")
             return {"status": resp.status, "latency_s": time.monotonic() - t0,
                     "tokens": int(out.get("tokens", 0)),
-                    "ttft_s": ttft / 1e3 if ttft is not None else None}
+                    "ttft_s": ttft / 1e3 if ttft is not None else None,
+                    "prompt_tokens": float(out.get("prompt_tokens", 0.0)),
+                    "cached_tokens": float(
+                        out.get("prefix_cached_tokens", 0.0))}
     except urllib.error.HTTPError as e:
         return {"status": e.code, "latency_s": time.monotonic() - t0,
-                "tokens": 0, "ttft_s": None}
+                "tokens": 0, "ttft_s": None, "prompt_tokens": 0.0,
+                "cached_tokens": 0.0}
     except Exception as e:  # noqa: BLE001 - count it, keep loading
         return {"status": f"error:{type(e).__name__}",
                 "latency_s": time.monotonic() - t0, "tokens": 0,
-                "ttft_s": None}
+                "ttft_s": None, "prompt_tokens": 0.0, "cached_tokens": 0.0}
+
+
+def group_prefix(group: int, tokens: int) -> str:
+    """Deterministic ~``tokens``-token shared prefix for one group (the
+    byte-fallback tokenizer maps ~1 token per char)."""
+    stem = f"[group {group}] shared context block; "
+    reps = -(-tokens // len(stem))
+    return (stem * reps)[:tokens]
 
 
 def run_load(url: str, concurrency: int, requests: int, prompt: str,
              max_tokens: int, temperature: float, deadline_s: float | None,
-             timeout: float) -> dict:
+             timeout: float, shared_prefix_tokens: int = 0,
+             prefix_groups: int = 1) -> dict:
     results: list = []
     lock = threading.Lock()
     counter = iter(range(requests))
@@ -63,7 +85,11 @@ def run_load(url: str, concurrency: int, requests: int, prompt: str,
                 i = next(counter, None)
             if i is None:
                 return
-            body = {"prompt": f"{prompt} [{i}]", "max_tokens": max_tokens,
+            head = (group_prefix(i % max(prefix_groups, 1),
+                                 shared_prefix_tokens)
+                    if shared_prefix_tokens > 0 else "")
+            body = {"prompt": f"{head}{prompt} [{i}]",
+                    "max_tokens": max_tokens,
                     "temperature": temperature, "seed": i}
             if deadline_s is not None:
                 body["deadline_s"] = deadline_s
@@ -117,6 +143,25 @@ def run_load(url: str, concurrency: int, requests: int, prompt: str,
         "tok_latency_p99_s": pct(per_tok, 0.99, 5),
         "client_tok_s": round(toks / wall, 1) if wall > 0 else None,
     }
+    if shared_prefix_tokens > 0:
+        # Hit = the server adopted cached prefix blocks for the request.
+        hit_t = sorted(r["ttft_s"] for r in ok
+                       if r["ttft_s"] is not None and r["cached_tokens"] > 0)
+        miss_t = sorted(r["ttft_s"] for r in ok
+                        if r["ttft_s"] is not None
+                        and r["cached_tokens"] == 0)
+        offered = sum(r["prompt_tokens"] for r in ok)
+        cached = sum(r["cached_tokens"] for r in ok)
+        summary.update({
+            "shared_prefix_tokens": shared_prefix_tokens,
+            "prefix_groups": prefix_groups,
+            "cache_hits": len(hit_t), "cache_misses": len(miss_t),
+            "cache_hit_rate": (round(cached / offered, 4) if offered else 0.0),
+            "ttft_hit_p50_s": pct(hit_t, 0.50),
+            "ttft_hit_p95_s": pct(hit_t, 0.95),
+            "ttft_miss_p50_s": pct(miss_t, 0.50),
+            "ttft_miss_p95_s": pct(miss_t, 0.95),
+        })
     try:
         with urllib.request.urlopen(url.rstrip("/") + "/metrics",
                                     timeout=10) as resp:
@@ -138,9 +183,18 @@ def main(argv=None) -> int:
                    help="per-request deadline passed to the batch engine")
     p.add_argument("--timeout", type=float, default=300.0,
                    help="client-side HTTP timeout per request")
+    p.add_argument("--shared-prefix-tokens", type=int, default=0,
+                   help="prepend a ~N-token group-shared prefix to every "
+                        "prompt (0 = off); TTFT is then split by prefix-"
+                        "cache hit vs miss")
+    p.add_argument("--prefix-groups", type=int, default=1,
+                   help="number of distinct shared prefixes the requests "
+                        "rotate through")
     a = p.parse_args(argv)
     summary = run_load(a.url, a.concurrency, a.requests, a.prompt,
-                       a.max_tokens, a.temperature, a.deadline_s, a.timeout)
+                       a.max_tokens, a.temperature, a.deadline_s, a.timeout,
+                       shared_prefix_tokens=a.shared_prefix_tokens,
+                       prefix_groups=a.prefix_groups)
     print(json.dumps(summary))
     return 0
 

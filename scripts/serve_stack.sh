@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# Launch a local serving stack: N batch-engine replicas (infer/server.py,
+# prefix caching on) plus the prefix-affinity router front door
+# (serve/router.py), then smoke-test one STREAMED request through the
+# router and print its SSE events. PIDs land next to the logs so the
+# stack can be torn down with `kill $(cat "$OUT"/*.pid)`.
+#
+# Usage: scripts/serve_stack.sh <run-name> [replicas] [runs_root] [base_port]
+#
+#   scripts/serve_stack.sh myrun 2
+#   python scripts/load_gen.py --url http://127.0.0.1:8500 \
+#       --shared-prefix-tokens 64 --prefix-groups 4
+set -euo pipefail
+
+RUN="${1:?usage: serve_stack.sh <run-name> [replicas] [runs_root] [base_port]}"
+N="${2:-2}"
+RUNS_ROOT="${3:-runs}"
+BASE_PORT="${4:-8451}"
+ROUTER_PORT="${5:-8500}"
+OUT="$RUNS_ROOT/$RUN.serve-stack"
+mkdir -p "$OUT"
+
+URLS=""
+for i in $(seq 0 $((N - 1))); do
+  PORT=$((BASE_PORT + i))
+  LOG="$OUT/replica-$i.log"
+  nohup python -m mlx_cuda_distributed_pretraining_tpu.infer.server \
+    --run "$RUN" --runs-root "$RUNS_ROOT" --engine batch \
+    --port "$PORT" >"$LOG" 2>&1 &
+  echo $! > "$OUT/replica-$i.pid"
+  URLS="$URLS${URLS:+,}http://127.0.0.1:$PORT"
+  echo "replica $i: pid=$(cat "$OUT/replica-$i.pid") port=$PORT log=$LOG"
+done
+
+# Wait for every replica to answer /healthz (first request pays the jit
+# compile, so give them time).
+for i in $(seq 0 $((N - 1))); do
+  PORT=$((BASE_PORT + i))
+  for _ in $(seq 1 120); do
+    curl -sf "http://127.0.0.1:$PORT/healthz" >/dev/null 2>&1 && break
+    sleep 1
+  done
+done
+
+nohup python -m mlx_cuda_distributed_pretraining_tpu.serve.router \
+  --replicas "$URLS" --port "$ROUTER_PORT" >"$OUT/router.log" 2>&1 &
+echo $! > "$OUT/router.pid"
+echo "router: pid=$(cat "$OUT/router.pid") port=$ROUTER_PORT replicas=$URLS"
+for _ in $(seq 1 30); do
+  curl -sf "http://127.0.0.1:$ROUTER_PORT/healthz" >/dev/null 2>&1 && break
+  sleep 1
+done
+
+echo "smoke: one streamed request through the router"
+curl -sN "http://127.0.0.1:$ROUTER_PORT/generate" \
+  -H 'Content-Type: application/json' \
+  -d '{"prompt": "the quick brown fox", "max_tokens": 8, "stream": true}'
+echo
+echo "stack up. tear down with: kill \$(cat $OUT/*.pid)"

@@ -1,0 +1,439 @@
+"""Automatic prefix caching (serve/prefix_cache.py + paged pool
+adoption) and the multi-replica router (serve/router.py).
+
+Parity oracle: ``prefix_cache=off`` is bit-for-bit the pre-cache engine,
+so every cache arm asserts token-identical greedy output against it —
+adoption, partial-tail recompute, eviction-then-refill, refcounted free,
+int8 KV and the spec-decode arm. Router tests run real replica servers
+in-process (infer/server.py on port 0)."""
+
+import json
+import math
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from mlx_cuda_distributed_pretraining_tpu.config import DataConfig
+from mlx_cuda_distributed_pretraining_tpu.infer.server import (
+    InferenceService,
+    request_stream,
+    serve,
+)
+from mlx_cuda_distributed_pretraining_tpu.models import llama
+from mlx_cuda_distributed_pretraining_tpu.models.llama import LlamaArgs
+from mlx_cuda_distributed_pretraining_tpu.serve import (
+    BatchEngine,
+    EngineConfig,
+    PagedKVPool,
+    PrefixCache,
+    Request,
+    Router,
+    Scheduler,
+    serve_router,
+)
+from mlx_cuda_distributed_pretraining_tpu.serve.prefix_cache import chain_keys
+from mlx_cuda_distributed_pretraining_tpu.tokenizer import TokenizerManager
+
+TOK = TokenizerManager(DataConfig())
+ARGS = LlamaArgs(
+    vocab_size=TOK.vocab_size, hidden_size=32, intermediate_size=64,
+    num_layers=2, num_heads=4, num_kv_heads=2, head_dim=8,
+    max_position_embeddings=128,
+)
+PARAMS = llama.init_params(jax.random.PRNGKey(0), ARGS)
+MAX_LEN = 128
+
+
+def _engine(**kw):
+    cfg = EngineConfig(**{"num_slots": 2, "max_len": MAX_LEN,
+                          "prefill_chunk": 16, **kw})
+    return BatchEngine(PARAMS, ARGS, TOK, cfg)
+
+
+def _gen_seq(eng, prompts, max_tokens=24, **kw):
+    """Sequential generation (deterministic admission order, so the
+    second identical prompt always sees the first one's cached blocks)."""
+    eng.start()
+    try:
+        return [eng.generate(p, max_tokens=max_tokens, temperature=0.0,
+                             timeout=300.0, **kw) for p in prompts], \
+               eng.metrics()
+    finally:
+        eng.stop()
+
+
+# -- prefix cache bookkeeping (no device) -------------------------------------
+
+def test_chain_keys_chain_and_partial_tail():
+    ids = list(range(70))
+    keys = chain_keys(ids, 32)
+    assert len(keys) == 2  # 70 tokens = 2 full blocks + partial tail
+    # chained: block 1's key depends on block 0's
+    assert chain_keys(ids[:64], 32) == keys
+    assert chain_keys([9] + ids[1:], 32)[0] != keys[0]
+    # resumable: start_block + parent_key continues the same chain
+    assert chain_keys(ids, 32, parent_key=keys[0], start_block=1) == [keys[1]]
+
+
+def test_prefix_cache_match_register_retire_evict():
+    pc = PrefixCache(block_size=4)
+    ids = list(range(13))  # 3 full blocks + 1 tail token
+    keys = chain_keys(ids, 4)
+    assert pc.match(ids) == ([], None)  # cold: nothing cached
+    for k, b in zip(keys, (7, 8, 9)):
+        assert pc.register(k, b)
+    assert not pc.register(keys[0], 55)  # first writer wins
+    blocks, last = pc.match(ids)
+    assert blocks == [7, 8, 9] and last == keys[2]
+    # never the final token: a 12-token prompt adopts only 2 blocks
+    assert pc.match(ids[:12])[0] == [7, 8]
+    assert pc.match(ids, max_blocks=1)[0] == [7]
+    # divergent tail stops the walk at the shared prefix
+    assert pc.match(ids[:8] + [99, 99, 99, 99, 0])[0] == [7, 8]
+    # retire -> adoptable from the LRU; evict pops oldest and unpublishes
+    for b in (7, 8, 9):
+        assert pc.retire(b)
+    assert pc.retired_blocks == 3
+    pc.revive(7)
+    assert pc.evict_lru() == 8  # oldest retired (7 was revived)
+    assert pc.match(ids)[0] == [7]  # chain broken at the evicted block
+    assert pc.evictions == 1
+    assert not pc.retire(55)  # unregistered -> plain free list
+
+
+def test_prefix_cache_counters_never_nan():
+    pc = PrefixCache(block_size=4, min_hit_blocks=2)
+    assert pc.hit_rate() == 0.0  # fresh: no division by zero
+    assert all(math.isfinite(v) for v in pc.stats().values())
+    # below min_hit_blocks the match reports nothing
+    pc.register(chain_keys(list(range(8)), 4)[0], 3)
+    assert pc.match(list(range(9))) == ([], None)
+    pc.note_lookup(10, 0)
+    pc.note_lookup(10, 8)
+    assert pc.hits == 1 and pc.misses == 1
+    assert pc.hit_rate() == pytest.approx(8 / 20)
+
+
+# -- paged pool adoption (no device math, real pool) --------------------------
+
+def _fill_and_register(pool, seq, ids):
+    pool.lengths[seq] = len(ids)
+    pool.ensure_capacity(seq, len(ids))
+    pool.register_upto(seq, ids)
+
+
+def test_pool_adopts_cached_chain_zero_copy():
+    pool = PagedKVPool(ARGS, num_seqs=2, max_len=MAX_LEN, block_size=32,
+                       num_blocks=8, prefix_cache=True)
+    ids = list(range(70))  # 3 blocks (2 full + tail)
+    s0 = pool.allocate(len(ids), token_ids=ids)
+    assert pool.lengths[s0] == 0 and pool.prefix.misses == 1
+    _fill_and_register(pool, s0, ids)
+    shared = [int(b) for b in pool.tables[s0][:2]]
+    # a second identical prompt adopts the two FULL blocks zero-copy
+    s1 = pool.allocate(len(ids), token_ids=ids)
+    assert pool.lengths[s1] == 64  # prefill resumes after the adopted KV
+    assert [int(b) for b in pool.tables[s1][:2]] == shared
+    assert int(pool.tables[s1][2]) not in shared  # fresh tail block
+    assert pool.prefix.hits == 1 and pool.prefix.hit_tokens == 64
+    # refcounted free: first free keeps the shared blocks live ...
+    pool.free(s0)
+    assert pool._ref[shared[0]] == 1 and pool.prefix.retired_blocks == 0
+    # ... second free retires them to the LRU (still adoptable, counted free)
+    pool.free(s1)
+    assert pool.prefix.retired_blocks == 2
+    assert pool.free_blocks == 8 and pool.blocks_in_use == 0
+    s2 = pool.allocate(len(ids), token_ids=ids)
+    assert pool.lengths[s2] == 64  # revived straight off the LRU
+
+
+def test_pool_eviction_unpublishes_and_refuses_without_mutation():
+    pool = PagedKVPool(ARGS, num_seqs=2, max_len=MAX_LEN, block_size=32,
+                       num_blocks=3, prefix_cache=True)
+    ids = list(range(70))
+    s0 = pool.allocate(len(ids), token_ids=ids)
+    _fill_and_register(pool, s0, ids)
+    pool.free(s0)  # 2 registered blocks on the LRU + 1 plain free
+    assert pool.free_blocks == 3 and pool.prefix.retired_blocks == 2
+    # a non-matching 3-block prompt must evict the cached chain
+    other = list(range(1000, 1070))
+    s1 = pool.allocate(len(other), token_ids=other)
+    assert s1 is not None and pool.prefix.evictions >= 1
+    pool.free(s1)
+    # the evicted chain no longer matches: allocation is a miss again
+    s2 = pool.allocate(len(ids), token_ids=ids)
+    assert pool.lengths[s2] == 0
+    pool.free(s2)
+    # refusal gate: adopting retired blocks consumes LRU supply, so a
+    # request needing adopted + more fresh than remain must refuse cleanly
+    small = PagedKVPool(ARGS, num_seqs=2, max_len=MAX_LEN, block_size=32,
+                        num_blocks=3, prefix_cache=True)
+    s = small.allocate(len(ids), token_ids=ids)
+    _fill_and_register(small, s, ids)
+    small.free(s)
+    used_before = small.blocks_in_use
+    # 4 blocks needed: 2 adopted (from LRU) + 2 fresh, but only 1 other
+    # block exists -> refuse with no state change
+    assert small.allocate(MAX_LEN - 1, token_ids=list(range(127))) is None
+    assert small.blocks_in_use == used_before
+    assert small.prefix.retired_blocks == 2
+
+
+def test_pool_growth_preserves_registered_keys():
+    pool = PagedKVPool(ARGS, num_seqs=1, max_len=MAX_LEN, block_size=32,
+                       num_blocks=4, prefix_cache=True)
+    ids = list(range(40))
+    s0 = pool.allocate(len(ids), token_ids=ids)
+    _fill_and_register(pool, s0, ids)
+    key0 = pool.prefix.key_of(int(pool.tables[s0][0]))
+    assert key0 is not None
+    # decode growth maps more blocks; earlier published keys survive
+    assert pool.ensure_capacity(s0, 100)
+    assert pool.prefix.key_of(int(pool.tables[s0][0])) == key0
+    # and the longer sequence registers as a continuation of the chain
+    longer = ids + list(range(40, 96))
+    pool.lengths[s0] = 96
+    pool.register_upto(s0, longer)
+    assert pool.prefix.cached_blocks == 3
+    pool.free(s0)
+    s1 = pool.allocate(len(longer) + 1, token_ids=longer + [7])
+    assert pool.lengths[s1] == 96  # whole generated chain adoptable
+
+
+# -- engine parity: prefix on == prefix off -----------------------------------
+
+SHARED = "the quick brown fox jumps over the lazy dog again and "
+PREFIX_PROMPTS = [SHARED + "one", SHARED + "one", SHARED + "two wide",
+                  SHARED + "one"]
+
+
+def test_prefix_on_off_greedy_parity_and_hit_accounting():
+    off, _ = _gen_seq(_engine(prefix_cache=False), PREFIX_PROMPTS)
+    on, m = _gen_seq(_engine(prefix_cache=True, block_size=16),
+                     PREFIX_PROMPTS)
+    for a, b in zip(off, on):
+        assert b["text"] == a["text"]
+        assert b["tokens"] == a["tokens"]
+        assert b["finish_reason"] == a["finish_reason"]
+    # repeats adopted the shared prefix (warm hits), firsts missed
+    assert m["prefix_cache"] is True
+    assert m["prefix_cache_hits"] >= 2
+    assert m["prefix_cache_hit_rate"] > 0.0
+    assert on[1]["prefix_cached_tokens"] > 0
+    assert off[1].get("prefix_cached_tokens", 0.0) == 0.0
+    # partial tail: prompt 3 shares blocks with 1 but diverges at the tail
+    assert on[2]["prefix_cached_tokens"] < float(
+        len(TOK.tokenize(PREFIX_PROMPTS[2])))
+
+
+def test_prefix_parity_int8_kv():
+    off, _ = _gen_seq(_engine(prefix_cache=False, kv_quant=True),
+                      PREFIX_PROMPTS[:2], max_tokens=16)
+    on, m = _gen_seq(_engine(prefix_cache=True, kv_quant=True,
+                             block_size=16), PREFIX_PROMPTS[:2],
+                     max_tokens=16)
+    assert [o["text"] for o in on] == [o["text"] for o in off]
+    assert m["prefix_cache_hits"] >= 1
+
+
+def test_prefix_parity_spec_decode():
+    off, _ = _gen_seq(_engine(prefix_cache=False, spec_draft_len=4),
+                      PREFIX_PROMPTS[:2], max_tokens=24)
+    on, m = _gen_seq(_engine(prefix_cache=True, spec_draft_len=4,
+                             block_size=16), PREFIX_PROMPTS[:2],
+                     max_tokens=24)
+    assert [o["text"] for o in on] == [o["text"] for o in off]
+    assert m["prefix_cache_hits"] >= 1 and m["spec_proposed"] > 0
+
+
+def test_prefix_parity_eviction_then_refill():
+    # Arena so small that caching the first prompt's blocks must be
+    # evicted by the second; the third (repeat of the first) refills.
+    prompts = [SHARED + "one", "zq " * 30, SHARED + "one"]
+    off, _ = _gen_seq(_engine(prefix_cache=False, num_slots=1,
+                              num_blocks=4, block_size=32), prompts,
+                      max_tokens=12)
+    on, m = _gen_seq(_engine(prefix_cache=True, num_slots=1,
+                             num_blocks=4, block_size=32), prompts,
+                     max_tokens=12)
+    assert [o["text"] for o in on] == [o["text"] for o in off]
+    assert m["prefix_cache_evictions"] >= 1
+
+
+# -- satellite: expire on a preempted request ---------------------------------
+
+def test_expired_preempted_request_releases_shared_blocks_once():
+    pool = PagedKVPool(ARGS, num_seqs=2, max_len=MAX_LEN, block_size=32,
+                       num_blocks=8, prefix_cache=True)
+    sched = Scheduler(max_queue=4)
+    ids = list(range(70))
+    r0 = Request(ids, max_tokens=4)
+    r1 = Request(ids, max_tokens=4, deadline_s=30.0)
+    sched.submit(r0)
+    sched.admit(pool)
+    _fill_and_register(pool, r0.slot, ids)
+    sched.finish(pool, r0, "stop")
+    sched.submit(r1)
+    sched.admit(pool)  # r1 adopts r0's retired chain
+    assert r1.prefilled == 64 and r1.cached_tokens == 64
+    shared = int(pool.tables[r1.slot][0])
+    assert pool._ref[shared] == 1
+    used = pool.blocks_in_use
+    # preemption releases the blocks (shared ones retire, ref 1 -> 0)...
+    sched.preempt(pool, r1)
+    assert r1.slot is None and pool.blocks_in_use < used
+    assert pool._ref[shared] == 0
+    # ...and the deadline lapsing in the queue must NOT free them again
+    evicted = sched.expire(pool, now=time.monotonic() + 60.0)
+    assert evicted == [r1]
+    assert r1.finish_reason == "deadline" and r1.error  # -> HTTP 504
+    assert pool._ref[shared] == 0 and pool.blocks_in_use == 0
+    assert pool.free_blocks == 8
+    # the retired chain survives the eviction and is still adoptable
+    s = pool.allocate(len(ids), token_ids=ids)
+    assert pool.lengths[s] == 64
+
+
+# -- satellite: metrics well-defined before any traffic -----------------------
+
+def test_fresh_engine_metrics_no_traffic_no_nan():
+    eng = _engine(spec_draft_len=4)  # never started, zero traffic
+    m = eng.metrics()
+    assert m["spec_acceptance_rate"] == 0.0  # no division by zero
+    assert m["prefix_cache"] is True
+    for k in ("prefix_cache_hits", "prefix_cache_misses",
+              "prefix_cache_evictions", "prefix_cache_hit_rate"):
+        assert m[k] == 0
+    for v in m.values():
+        if isinstance(v, float):
+            assert math.isfinite(v)
+    # gauges/counters exist in the registry snapshot pre-traffic too
+    snap = eng.metrics_registry.snapshot()
+    assert "serve_prefix_cache_hit_rate" in snap
+    assert "serve_spec_acceptance_rate" in snap
+    # slotted backend reports no prefix cache but stays NaN-free
+    m2 = _engine(kv_backend="slotted").metrics()
+    assert m2["prefix_cache"] is False
+
+
+# -- router -------------------------------------------------------------------
+
+def _post(url, body, timeout=300.0):
+    req = urllib.request.Request(
+        url + "/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _replica(**kw):
+    service = InferenceService(PARAMS, ARGS, TOK, run_name="tiny")
+    service.engine = _engine(**kw).start()
+    httpd = serve(service, port=0)
+    return service, httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def test_router_ring_is_deterministic_and_affine():
+    r = Router(["http://a", "http://b", "http://c"])
+    key = r.routing_key({"prompt": SHARED + "xyz"})
+    assert key == r.routing_key({"prompt": SHARED + "different tail"})
+    assert key is not None
+    picks = {r._ring.lookup(key) for _ in range(8)}
+    assert len(picks) == 1  # stable
+    skey = r.routing_key({"prompt": "anything", "session": "s1"})
+    assert skey == r.routing_key({"prompt": "else", "session": "s1"})
+    assert skey != r.routing_key({"prompt": "else", "session": "s2"})
+
+
+def test_router_two_replicas_streams_and_survives_death():
+    sa, ha, ua = _replica()
+    sb, hb, ub = _replica()
+    router = Router([ua, ub], poll_interval_s=0.1, retries=2)
+    rhttpd = serve_router(router, port=0)
+    url = f"http://127.0.0.1:{rhttpd.server_address[1]}"
+    try:
+        status, out = _post(url, {"prompt": SHARED + "route me",
+                                  "max_tokens": 6})
+        assert status == 200 and out["engine"] == "batch"
+        # session affinity: every request of one session lands on ONE
+        # replica (completed counters move on exactly one engine)
+        base = [sa.engine.metrics()["completed"],
+                sb.engine.metrics()["completed"]]
+        for i in range(3):
+            _post(url, {"prompt": f"turn {i}", "max_tokens": 4,
+                        "session": "conv-1"})
+        moved = [sa.engine.metrics()["completed"] - base[0],
+                 sb.engine.metrics()["completed"] - base[1]]
+        assert sorted(moved) == [0, 3]
+        # streaming through the router: token events then the summary
+        events = list(request_stream(url, SHARED + "stream it",
+                                     max_tokens=5))
+        assert events[-1].get("done") is True
+        deltas = "".join(e.get("text", "") for e in events[:-1])
+        assert deltas == events[-1]["text"]
+        assert len(events) - 1 == events[-1]["tokens"]
+        # kill one replica mid-service: requests keep completing
+        dead = sa if moved[0] else sb
+        dead.close()
+        (ha if dead is sa else hb).shutdown()
+        (ha if dead is sa else hb).server_close()
+        for i in range(3):
+            status, out = _post(url, {"prompt": f"turn {i}", "max_tokens": 4,
+                                      "session": "conv-1"})
+            assert status == 200
+        assert router.health()["replicas_up"] == 1
+    finally:
+        rhttpd.shutdown()
+        rhttpd.server_close()
+        router.stop()
+        for s, h in ((sa, ha), (sb, hb)):
+            try:
+                s.close()
+                h.shutdown()
+                h.server_close()
+            except Exception:  # noqa: BLE001 - one pair already closed
+                pass
+
+
+def test_router_backpressure_propagates_429_with_retry_after():
+    service = InferenceService(PARAMS, ARGS, TOK, run_name="tiny")
+    service.engine = _engine(max_queue=1)  # engine NOT started
+    httpd = serve(service, port=0)
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    router = Router([url], poll_interval_s=30.0)
+    rhttpd = serve_router(router, port=0)
+    rurl = f"http://127.0.0.1:{rhttpd.server_address[1]}"
+    try:
+        service.engine.submit("fill", max_tokens=4)  # queue now full
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(rurl, {"prompt": "overflow", "max_tokens": 4}, timeout=60.0)
+        assert exc.value.code == 429
+        assert int(exc.value.headers["Retry-After"]) >= 1
+    finally:
+        rhttpd.shutdown()
+        rhttpd.server_close()
+        router.stop()
+        httpd.shutdown()
+        httpd.server_close()
+        service.close()
+
+
+def test_replica_sse_stream_matches_buffered_result():
+    service, httpd, url = _replica(prefix_cache=True, block_size=16)
+    try:
+        _, buffered = _post(url, {"prompt": SHARED + "sse", "max_tokens": 6,
+                                  "seed": 0})
+        events = list(request_stream(url, SHARED + "sse", max_tokens=6,
+                                     seed=0))
+        final = events[-1]
+        assert final.get("done") is True
+        assert final["text"] == buffered["text"]
+        assert final["prefix_cached_tokens"] >= 0.0
+        assert all("token" in e for e in events[:-1])
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        service.close()
