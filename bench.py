@@ -980,66 +980,91 @@ def bench_serve_prefix_case(vocab, name="serve_prefix"):
     }
 
 
+_ROUTER_REPLICA = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+cores = sys.argv[1]
+if cores and hasattr(os, "sched_setaffinity"):
+    os.sched_setaffinity(0, {{int(c) for c in cores.split(",")}})
+import jax
+from mlx_cuda_distributed_pretraining_tpu.config import DataConfig
+from mlx_cuda_distributed_pretraining_tpu.infer.server import (
+    InferenceService, serve)
+from mlx_cuda_distributed_pretraining_tpu.models import llama
+from mlx_cuda_distributed_pretraining_tpu.serve import BatchEngine, EngineConfig
+from mlx_cuda_distributed_pretraining_tpu.tokenizer import TokenizerManager
+
+tok = TokenizerManager(DataConfig())
+args = llama.LlamaArgs(vocab_size=tok.vocab_size,
+                       max_position_embeddings=256, **{shape!r})
+params = llama.init_params(jax.random.PRNGKey(0), args)
+service = InferenceService(params, args, tok, run_name="bench")
+service.engine = BatchEngine(
+    params, args, tok,
+    EngineConfig(num_slots=8, max_len=256, prefill_chunk=64,
+                 max_queue=128)).start()
+httpd = serve(service, port=0)
+print("REPLICA_PORT", httpd.server_address[1], flush=True)
+while True:
+    time.sleep(3600)
+"""
+
+
 def bench_serve_router_case(name="serve_router"):
     """load_gen flood through the prefix-affinity router: 2 replicas vs 1
     at identical offered load (shared-prefix workload, 4 groups). Uses
-    the real text path — InferenceService + HTTP servers in-process, the
-    repo tokenizer — because the router hashes prompt BYTES. The
-    acceptance bar (>= 1.7x aggregate decode tok/s with 2 replicas) is a
-    chip-parallelism bar: each replica owns an accelerator in
-    production, so the row records ``cores`` to make the basis explicit
-    — on a 1-core CPU container both replicas time-share one core and
-    the honest ratio is ~1x; the case is the harness that demonstrates
-    scaling wherever replicas get their own compute."""
+    the real text path — the repo tokenizer — because the router hashes
+    prompt BYTES.
+
+    Each replica is its own PROCESS pinned (``sched_setaffinity``) to a
+    disjoint CPU-core subset, modelling production where each replica
+    owns an accelerator. Both the 1-replica and 2-replica runs give
+    every replica the SAME ``cores_per_replica`` slice, so the ratio
+    measures added replicas, not added cores-per-replica. The >= 1.7x
+    aggregate-tok/s bar is only meaningful when there are >= 2 cores to
+    split (``bar_enforced``); on a 1-core container both replicas
+    time-share one core and the honest ratio is ~1x."""
     import importlib.util
     import os
+    import subprocess
 
-    import jax
+    from mlx_cuda_distributed_pretraining_tpu.serve import Router, serve_router
 
-    from mlx_cuda_distributed_pretraining_tpu.config import DataConfig
-    from mlx_cuda_distributed_pretraining_tpu.infer.server import (
-        InferenceService,
-        serve,
-    )
-    from mlx_cuda_distributed_pretraining_tpu.models import llama
-    from mlx_cuda_distributed_pretraining_tpu.serve import (
-        BatchEngine,
-        EngineConfig,
-        Router,
-        serve_router,
-    )
-    from mlx_cuda_distributed_pretraining_tpu.tokenizer import (
-        TokenizerManager,
-    )
-
+    repo = os.path.dirname(os.path.abspath(__file__))
     spec = importlib.util.spec_from_file_location(
-        "load_gen", os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 "scripts", "load_gen.py"))
+        "load_gen", os.path.join(repo, "scripts", "load_gen.py"))
     load_gen = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(load_gen)
 
-    tok = TokenizerManager(DataConfig())
-    sc = SCALES["2m"]
-    MAX_LEN = 256
-    args = llama.LlamaArgs(vocab_size=tok.vocab_size,
-                           max_position_embeddings=MAX_LEN, **sc["shape"])
-    params = llama.init_params(jax.random.PRNGKey(0), args)
+    try:
+        all_cores = sorted(os.sched_getaffinity(0))
+    except AttributeError:
+        all_cores = list(range(os.cpu_count() or 1))
+    cores_per_replica = max(1, len(all_cores) // 2)
 
-    def replica():
-        service = InferenceService(params, args, tok, run_name="bench")
-        service.engine = BatchEngine(
-            params, args, tok,
-            EngineConfig(num_slots=8, max_len=MAX_LEN, prefill_chunk=64,
-                         max_queue=128)).start()
-        httpd = serve(service, port=0)
-        return service, httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo  # also drops any accelerator sitecustomize
+    env["JAX_PLATFORMS"] = "cpu"  # replicas must not fight over one chip
 
-    def flood(urls):
-        stack = [replica() for _ in urls]
-        router = Router([u for _, _, u in stack], poll_interval_s=0.2)
+    def spawn_replica(idx):
+        cores = all_cores[idx * cores_per_replica:(idx + 1) * cores_per_replica]
+        src = _ROUTER_REPLICA.format(repo=repo, shape=SCALES["2m"]["shape"])
+        proc = subprocess.Popen(
+            [sys.executable, "-c", src, ",".join(map(str, cores))],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+            text=True)
+        line = proc.stdout.readline()
+        if not line.startswith("REPLICA_PORT"):
+            proc.kill()
+            raise RuntimeError(f"replica {idx} died before binding: {line!r}")
+        return proc, f"http://127.0.0.1:{int(line.split()[1])}"
+
+    def flood(n_replicas):
+        procs_urls = [spawn_replica(i) for i in range(n_replicas)]
+        router = Router([u for _, u in procs_urls], poll_interval_s=0.2)
         rhttpd = serve_router(router, port=0)
         try:
-            for _, _, u in stack:  # pay each replica's jit compile
+            for _, u in procs_urls:  # pay each replica's jit compile
                 load_gen._one_request(u, {"prompt": "warm", "max_tokens": 4},
                                       600.0)
             summary = load_gen.run_load(
@@ -1052,25 +1077,143 @@ def bench_serve_router_case(name="serve_router"):
             rhttpd.shutdown()
             rhttpd.server_close()
             router.stop()
-            for service, httpd, _ in stack:
-                httpd.shutdown()
-                httpd.server_close()
-                service.close()
+            for proc, _ in procs_urls:
+                proc.kill()
+                proc.communicate()
 
-    one, two = flood([1]), flood([1, 2])
+    one, two = flood(1), flood(2)
+    speedup = round((two["client_tok_s"] or 0.0)
+                    / max(one["client_tok_s"] or 0.0, 1e-9), 2)
+    bar_enforced = len(all_cores) >= 2
     return {
-        "case": name, "vocab": tok.vocab_size, "requests": 48,
+        "case": name, "requests": 48,
         "concurrency": 8, "max_tokens": 32, "shared_prefix_tokens": 64,
-        "prefix_groups": 4, "cores": os.cpu_count(),
+        "prefix_groups": 4, "cores": len(all_cores),
+        "cores_per_replica": cores_per_replica,
         "tok_s_1rep": one["client_tok_s"], "tok_s_2rep": two["client_tok_s"],
-        "router_speedup": round(
-            (two["client_tok_s"] or 0.0) / max(one["client_tok_s"] or 0.0,
-                                               1e-9), 2),
+        "router_speedup": speedup,
+        "bar_enforced": bar_enforced,
+        "bar_met": (speedup >= 1.7) if bar_enforced else None,
         "cache_hit_rate_1rep": one.get("cache_hit_rate"),
         "cache_hit_rate_2rep": two.get("cache_hit_rate"),
         "ttft_hit_p50_s": two.get("ttft_hit_p50_s"),
         "ttft_miss_p50_s": two.get("ttft_miss_p50_s"),
         "ok_2rep": two.get("ok"),
+    }
+
+
+_SERVE_TP_WORKER = """
+import json, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import jax
+
+from mlx_cuda_distributed_pretraining_tpu.models import llama
+from mlx_cuda_distributed_pretraining_tpu.parallel import build_serve_mesh
+from mlx_cuda_distributed_pretraining_tpu.serve import BatchEngine, EngineConfig
+
+assert jax.device_count() == 2, jax.devices()
+
+# Host-sync audit: every device->host readback in the serve loop goes
+# through np.asarray(jax.Array) or jax.device_get. tp must not add any.
+_sync = {{"n": 0}}
+_asarray, _devget = np.asarray, jax.device_get
+def _count_asarray(a, *ar, **kw):
+    if isinstance(a, jax.Array):
+        _sync["n"] += 1
+    return _asarray(a, *ar, **kw)
+def _count_devget(x):
+    _sync["n"] += 1
+    return _devget(x)
+np.asarray, jax.device_get = _count_asarray, _count_devget
+
+vocab = {vocab}
+args = llama.LlamaArgs(vocab_size=vocab, max_position_embeddings=256,
+                       **{shape!r})
+params = llama.init_params(jax.random.PRNGKey(0), args)
+rng = np.random.default_rng(0)
+P, NEW = 64, 32
+prompts = [rng.integers(2, vocab, size=P).tolist() for _ in range(4)]
+
+class Tok:
+    bos_id, eos_id = 1, -1
+    def tokenize(self, s):
+        return []
+    def detokenize(self, ids):
+        return ""
+
+def run(mesh):
+    eng = BatchEngine(params, args, Tok(),
+                      EngineConfig(num_slots=4, max_len=256,
+                                   prefill_chunk=64), mesh=mesh).start()
+    try:
+        eng._submit_ids(prompts[0], NEW, 0.0, 0).wait(600)  # compile
+        ttfts = []
+        for ids in prompts:  # prefill-dominated 1-token requests
+            t0 = time.perf_counter()
+            eng._submit_ids(ids, 1, 0.0, 0).wait(600)
+            ttfts.append(time.perf_counter() - t0)
+        s0 = _sync["n"]
+        t0 = time.perf_counter()
+        reqs = [eng._submit_ids(ids, NEW, 0.0, 0) for ids in prompts]
+        for r in reqs:
+            r.wait(600)
+        dt = time.perf_counter() - t0
+        # Total over the FIXED flood: deterministic (iteration counts are
+        # not — admission batching shifts with step latency).
+        return {{"tok_s": round(len(prompts) * NEW / dt, 1),
+                 "ttft_p50_s": round(sorted(ttfts)[len(ttfts) // 2], 4),
+                 "host_syncs": _sync["n"] - s0,
+                 "tokens": [list(r.tokens) for r in reqs],
+                 "mesh": eng.metrics()["mesh"]}}
+    finally:
+        eng.stop()
+
+one = run(None)
+two = run(build_serve_mesh({{"tp": 2}}))
+print("SERVE_TP " + json.dumps({{"tp1": one, "tp2": two}}), flush=True)
+"""
+
+
+def bench_serve_tp_case(vocab, name="serve_tp"):
+    """Tensor-parallel serving acceptance: tp=2 vs tp=1 (unsharded) in a
+    subprocess with TWO FORCED HOST (CPU) devices. Greedy decode must be
+    token-IDENTICAL (sharding is a layout annotation, not a numerics
+    change), and the host-sync count over a fixed flood must be unchanged —
+    GSPMD keeps logits/sampling on device; tp must not introduce extra
+    readbacks. The tok/s and TTFT columns are layout-overhead telemetry:
+    on virtual CPU devices (one physical socket) tp=2 pays collective
+    overhead for no extra compute, so the interesting direction is "not
+    catastrophically slower"; the speedup story needs real chips."""
+    import os
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    src = _SERVE_TP_WORKER.format(repo=repo, vocab=vocab,
+                                  shape=SCALES["2m"]["shape"])
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    proc = subprocess.run([sys.executable, "-c", src], env=env,
+                          capture_output=True, text=True, timeout=900)
+    line = next((ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("SERVE_TP ")), None)
+    if proc.returncode != 0 or line is None:
+        raise RuntimeError(
+            f"serve_tp worker rc={proc.returncode}: {proc.stderr[-1500:]}")
+    res = json.loads(line[len("SERVE_TP "):])
+    one, two = res["tp1"], res["tp2"]
+    return {
+        "case": name, "vocab": vocab, "devices": 2, "mesh": two["mesh"],
+        "prompt": 64, "new_tokens": 32, "num_slots": 4,
+        "decode_tok_s_tp1": one["tok_s"], "decode_tok_s_tp2": two["tok_s"],
+        "ttft_p50_s_tp1": one["ttft_p50_s"],
+        "ttft_p50_s_tp2": two["ttft_p50_s"],
+        "host_syncs_tp1": one["host_syncs"],
+        "host_syncs_tp2": two["host_syncs"],
+        "syncs_unchanged": one["host_syncs"] == two["host_syncs"],
+        "tokens_identical": one["tokens"] == two["tokens"],
     }
 
 
@@ -1368,9 +1511,14 @@ def build_plan(vocab, steps):
         # KV byte budget under 86%-shared-prefix traffic.
         ("serve_prefix", "serve", lambda: bench_serve_prefix_case(vocab), 240),
         # serve_router floods load_gen through the prefix-affinity router
-        # at 1 vs 2 replicas; the >= 1.7x aggregate-tok/s bar needs each
-        # replica on its own compute (the row records cores).
+        # at 1 vs 2 replicas, each replica a subprocess pinned to a
+        # disjoint core subset; the >= 1.7x aggregate-tok/s bar is only
+        # enforced with >= 2 cores (the row records cores_per_replica).
         ("serve_router", "serve", lambda: bench_serve_router_case(), 300),
+        # serve_tp: GSPMD tensor-parallel engine, tp=2 vs tp=1 on two
+        # forced host devices — token-identical greedy, unchanged
+        # per-step host-sync count, layout-overhead tok/s + TTFT.
+        ("serve_tp", "serve", lambda: bench_serve_tp_case(vocab), 300),
         # moe_8x40m: grouped (dropless sorted dispatch) vs einsum (GShard
         # capacity tensors) on the same model — a dispatch-algorithm
         # comparison that is meaningful on CPU, like the serve family.

@@ -23,6 +23,9 @@ Rule IDs (stable — used in suppressions and the baseline):
 - ``jit-in-loop``         jax.jit called inside a loop body.
 - ``time-in-jit``         wall-clock reads / sleep / print / open inside
                           a jitted function body (trace-time constants).
+- ``legacy-shard-map-import`` direct ``jax.experimental.shard_map``
+                          import anywhere but ``parallel/compat.py`` (the
+                          single shim for the ``jax.shard_map`` rename).
 """
 
 from __future__ import annotations
@@ -693,3 +696,52 @@ class TimeInJit(Rule):
                         "only at trace time — the compiled program never "
                         "repeats the I/O; use jax.debug.print/"
                         "jax.debug.callback for per-call output"))
+
+
+# -- legacy-shard-map-import ------------------------------------------------
+
+# The one module allowed to touch the moving target directly: it wraps the
+# jax.experimental.shard_map -> jax.shard_map rename behind a stable name
+# (PR 6). Everyone else imports the shim, so the next upstream move is a
+# one-file fix.
+_SHARD_MAP_SHIM = "parallel/compat.py"
+_SHARD_MAP_MOD = "jax.experimental.shard_map"
+
+
+@register
+class LegacyShardMapImport(Rule):
+    id = "legacy-shard-map-import"
+    description = (
+        "direct jax.experimental.shard_map import outside parallel/"
+        "compat.py: that module path is deprecated upstream (renamed to "
+        "jax.shard_map) and the compat shim is the single migration "
+        "point — import shard_map from ..parallel.compat instead."
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if ctx.path.replace("\\", "/").endswith(_SHARD_MAP_SHIM):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == _SHARD_MAP_MOD \
+                            or alias.name.startswith(_SHARD_MAP_MOD + "."):
+                        yield self._flag(ctx, node, f"import {alias.name}")
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if node.level == 0 and (
+                        mod == _SHARD_MAP_MOD
+                        or mod.startswith(_SHARD_MAP_MOD + ".")):
+                    yield self._flag(ctx, node, f"from {mod} import ...")
+                elif node.level == 0 and mod == "jax.experimental":
+                    for alias in node.names:
+                        if alias.name == "shard_map":
+                            yield self._flag(
+                                ctx, node,
+                                "from jax.experimental import shard_map")
+
+    def _flag(self, ctx: ModuleContext, node: ast.AST, form: str) -> Finding:
+        return self.finding(ctx, node, (
+            f"`{form}` — jax.experimental.shard_map is the deprecated "
+            "module path (renamed to jax.shard_map); import shard_map "
+            "from parallel/compat.py, the single shim for the rename"))

@@ -463,10 +463,18 @@ class CheckpointManager:
         return params, opt_state, training_state
 
     @staticmethod
-    def load_params(model_path: str, like: Optional[Any] = None) -> Any:
+    def load_params(model_path: str, like: Optional[Any] = None,
+                    mesh: Optional[Any] = None) -> Any:
         """Tolerant load (reference: models/llama.py:414-477): extra keys in
-        the file are dropped, missing keys keep the ``like`` value."""
+        the file are dropped, missing keys keep the ``like`` value.
+
+        With ``mesh``, this is reshard-on-load: the on-disk checkpoint is
+        mesh-agnostic (full host arrays, whatever mesh trained it), and each
+        leaf lands directly in the mesh's ``NamedSharding`` per
+        ``parallel/sharding_rules.param_pspec``."""
         arrays, _ = load_safetensors(model_path)
+        if mesh is not None:
+            arrays = CheckpointManager.shard_arrays(arrays, mesh)
         nested = unflatten_dict(arrays)
         if like is None:
             return nested
@@ -474,10 +482,42 @@ class CheckpointManager:
         out = {}
         for k, ref in like_flat.items():
             if k in arrays:
-                out[k] = arrays[k].astype(ref.dtype).reshape(ref.shape)
+                v = arrays[k]
+                if mesh is not None:
+                    if v.dtype != ref.dtype or v.shape != ref.shape:
+                        raise CheckpointIntegrityError(
+                            f"reshard-on-load: {k} is {v.dtype}{v.shape} on "
+                            f"disk but {ref.dtype}{ref.shape} in the model; "
+                            f"cast/reshape would re-materialize the full "
+                            f"array on one host")
+                    out[k] = v
+                else:
+                    out[k] = v.astype(ref.dtype).reshape(ref.shape)
             else:
                 out[k] = ref
         return _restructure_like(like, unflatten_dict(out))
+
+    @staticmethod
+    def shard_arrays(arrays: Dict[str, np.ndarray], mesh: Any) -> Dict[str, Any]:
+        """Place a flat ``{dotted.path: host array}`` dict onto ``mesh`` per
+        the training param rules — reshard-on-load.
+
+        Each device materializes ONLY its slice (``make_array_from_callback``
+        feeds per-device index views of the host buffer): no host-side
+        gather, and no device ever holds a full replica of a sharded leaf.
+        The checkpoint on disk is always full host arrays, so a file saved
+        under fsdp=2, tp=1, or a single device reshards identically."""
+        from jax.sharding import NamedSharding
+
+        from ..parallel.sharding_rules import param_pspec
+
+        placed: Dict[str, Any] = {}
+        for k, v in arrays.items():
+            arr = np.asarray(v)
+            sharding = NamedSharding(mesh, param_pspec(k, arr.shape, mesh))
+            placed[k] = jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx, a=arr: a[idx])
+        return placed
 
     def latest_step(self) -> Optional[str]:
         """Highest numeric step with a model file, or "final" if present."""

@@ -68,10 +68,12 @@ class InferenceService:
         self.started_at = int(time.time())
         self.engine = None  # set by attach_engine (--engine batch)
 
-    def attach_engine(self, cfg=None) -> "object":
+    def attach_engine(self, cfg=None, mesh=None) -> "object":
         """Start the continuous-batching engine (serve/) and route
         compatible requests through it. The locked path stays available
-        for logit-reshaping sampling knobs."""
+        for logit-reshaping sampling knobs. ``mesh`` is a prebuilt serving
+        mesh (parallel.build_serve_mesh) — the one the params were
+        reshard-on-loaded into when the server ran with ``--mesh``."""
         from ..serve import BatchEngine, EngineConfig
 
         if cfg is None:
@@ -82,7 +84,7 @@ class InferenceService:
             cfg = dataclasses.replace(
                 cfg, max_len=self.args.max_position_embeddings)
         self.engine = BatchEngine(self.params, self.args, self.tokenizer,
-                                  cfg).start()
+                                  cfg, mesh=mesh).start()
         return self.engine
 
     def close(self) -> None:
@@ -94,10 +96,11 @@ class InferenceService:
     def from_run(cls, run: str, runs_root: str = "runs",
                  kv_quant: bool = False, max_tokens_limit: int = 4096,
                  speculative: bool = False,
-                 draft_len: int = 8) -> "InferenceService":
+                 draft_len: int = 8, mesh=None) -> "InferenceService":
         from ..train.trainer import load_trained
 
-        params, args, tok, _cfg = load_trained(run, runs_root=runs_root)
+        params, args, tok, _cfg = load_trained(run, runs_root=runs_root,
+                                               mesh=mesh)
         return cls(params, args, tok, kv_quant=kv_quant, run_name=run,
                    max_tokens_limit=max_tokens_limit,
                    speculative=speculative, draft_len=draft_len)
@@ -516,14 +519,28 @@ def main(argv=None) -> int:
     p.add_argument("--stats-url", default=None,
                    help="batch engine: ws:// URL of the obs stats server "
                         "for per-iteration serving metrics")
+    p.add_argument("--mesh", default=None,
+                   help="batch engine: serving mesh spec, e.g. tp=2 or "
+                        "tp=2,dp=2 — GSPMD-shards every prefill/decode "
+                        "step over the device mesh; the checkpoint "
+                        "reshards straight into it on load (yaml: "
+                        "serving.mesh)")
     a = p.parse_args(argv)
 
+    mesh = None
+    if a.mesh:
+        if a.engine != "batch":
+            p.error("--mesh requires --engine batch")
+        from ..parallel import build_serve_mesh
+
+        mesh = build_serve_mesh(a.mesh)
     service = InferenceService.from_run(a.run, a.runs_root,
                                         kv_quant=a.kv_quant,
                                         max_tokens_limit=a.max_tokens_limit,
                                         speculative=a.spec,
-                                        draft_len=a.draft_len)
+                                        draft_len=a.draft_len, mesh=mesh)
     if a.engine == "batch":
+        from ..parallel import parse_mesh_spec
         from ..serve import EngineConfig
 
         service.attach_engine(EngineConfig(
@@ -534,7 +551,8 @@ def main(argv=None) -> int:
             spec_max_ngram=a.spec_max_ngram,
             prefix_cache=not a.no_prefix_cache,
             prefix_min_hit_blocks=a.prefix_min_hit_blocks,
-            default_deadline_s=a.deadline_s, stats_url=a.stats_url))
+            default_deadline_s=a.deadline_s, stats_url=a.stats_url,
+            mesh=parse_mesh_spec(a.mesh) if a.mesh else None), mesh=mesh)
     httpd = ThreadingHTTPServer((a.host, a.port), make_handler(service))
     print(f"serving {a.run} ({service.n_params / 1e6:.1f}M params, "
           f"engine={a.engine}) on http://{a.host}:{httpd.server_address[1]}")

@@ -1,7 +1,7 @@
-from .mesh import build_mesh, mesh_axis_sizes
+from .mesh import build_mesh, build_serve_mesh, mesh_axis_sizes, parse_mesh_spec
 from .sharding_rules import batch_pspec, param_pspec, state_sharding, tree_pspecs
 
 __all__ = [
-    "build_mesh", "mesh_axis_sizes", "batch_pspec", "param_pspec",
-    "state_sharding", "tree_pspecs",
+    "build_mesh", "build_serve_mesh", "mesh_axis_sizes", "parse_mesh_spec",
+    "batch_pspec", "param_pspec", "state_sharding", "tree_pspecs",
 ]

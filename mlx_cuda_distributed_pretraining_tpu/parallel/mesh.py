@@ -22,7 +22,9 @@ Axes (any subset, in this order):
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+import warnings
+from types import SimpleNamespace
+from typing import Any, Dict, List, Optional, Union
 
 import jax
 import numpy as np
@@ -30,6 +32,12 @@ from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
 AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+# Axes the serving mesh may use: tp splits attention heads / MLP columns
+# of every prefill/decode dispatch; dp replicates the model and splits
+# the batch rows. The trainer-only axes (pp/fsdp/ep/sp) have no serving
+# semantics — the batched steps are not written for them.
+SERVE_AXES = ("dp", "tp")
 
 
 def mesh_axis_sizes(system_cfg: Any, n_devices: Optional[int] = None) -> Dict[str, int]:
@@ -55,6 +63,17 @@ def mesh_axis_sizes(system_cfg: Any, n_devices: Optional[int] = None) -> Dict[st
     total = int(np.prod(list(sizes.values())))
     if total > n:
         raise ValueError(f"mesh {sizes} needs {total} devices, have {n}")
+    if total < n:
+        # Legal (build_mesh takes a prefix of the device list) but almost
+        # always a config bug on real hardware: the remaining chips draw
+        # power and do nothing. Loud so it survives log truncation.
+        warnings.warn(
+            f"mesh {sizes} covers {total} of {n} devices — "
+            f"{n - total} device(s) STRANDED (idle). Use -1 on one axis to "
+            f"absorb the remainder, or shrink the visible device set.",
+            RuntimeWarning,
+            stacklevel=2,
+        )
     return {a: sizes.get(a, 1) for a in AXIS_ORDER if sizes.get(a, 1) > 1 or a in sizes}
 
 
@@ -72,3 +91,47 @@ def build_mesh(system_cfg: Any, devices: Optional[List] = None) -> Mesh:
     devices = devices[: mesh_device_count(sizes)]
     dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
     return Mesh(dev_array, names)
+
+
+def parse_mesh_spec(spec: str) -> Dict[str, int]:
+    """Parse a CLI mesh spec like ``"tp=2"`` or ``"tp=2,dp=2"`` into axis sizes."""
+    sizes: Dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad mesh spec segment {part!r}; expected axis=N")
+        axis, _, val = part.partition("=")
+        try:
+            sizes[axis.strip()] = int(val)
+        except ValueError:
+            raise ValueError(f"bad mesh axis size {val!r} in {spec!r}") from None
+    return sizes
+
+
+def build_serve_mesh(
+    mesh_sizes: Union[None, str, Dict[str, int]],
+    devices: Optional[List] = None,
+) -> Optional[Mesh]:
+    """Serving mesh over ``tp``×``dp`` — the same named axes (and axis order,
+    via ``AXIS_ORDER``/``mesh_axis_sizes``) the trainer uses, so
+    ``sharding_rules.param_pspec`` applies to serving params verbatim.
+
+    ``mesh_sizes`` is ``{"tp": 2}``-style (``"tp=2,dp=1"`` strings accepted;
+    ``-1`` means "all remaining devices"). Returns ``None`` for an empty or
+    all-ones spec: the engine then runs the pre-mesh single-device path with
+    byte-identical jit cache keys.
+    """
+    if isinstance(mesh_sizes, str):
+        mesh_sizes = parse_mesh_spec(mesh_sizes)
+    sizes = {k: int(v) for k, v in (mesh_sizes or {}).items()}
+    bad = set(sizes) - set(SERVE_AXES)
+    if bad:
+        raise ValueError(
+            f"serving mesh supports axes {SERVE_AXES}, got {sorted(bad)}; "
+            f"pp/fsdp/ep/sp are trainer-only"
+        )
+    if not sizes or all(v == 1 for v in sizes.values()):
+        return None
+    return build_mesh(SimpleNamespace(mesh=sizes), devices)

@@ -48,15 +48,27 @@ blocks), shared full blocks are immutable by construction.
 Like infer/generate.py, compiled steps are cached per (args, shape
 bucket); attend lengths are power-of-two buckets so a long-serving
 engine compiles O(log max_len) variants, not one per position.
+
+Tensor-parallel serving: every factory takes an optional serving
+``mesh`` (parallel/mesh.py::build_serve_mesh, tp×dp). Params arrive
+pre-placed per the training sharding rules (Megatron-style column/row
+splits), the KV buffers are constrained to ``kv_cache_pspec`` (head dim
+over ``tp``) on the way in AND out — donation-compatible — and logits
+replicate at the single Megatron gather point before sampling. GSPMD
+partitions everything in between; host-visible shapes, shape buckets,
+and the per-step host-sync count are unchanged, so the scheduler is
+oblivious to the mesh.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
 
 from ..infer.generate import _attend_bucket, _round_up, _spec_accept_one
 from ..models import llama
@@ -193,8 +205,53 @@ def _donate_cache():
     return () if jax.default_backend() == "cpu" else (1,)
 
 
-def decode_step(args: llama.LlamaArgs, attend_len: int):
-    """Compiled once per (args, attend bucket) — cached.
+def kv_cache_pspec(mesh: Optional[Mesh], num_kv_heads: int) -> P:
+    """PartitionSpec for a KV buffer ``[rows, T, Hkv, *]``: the head dim
+    over ``tp`` when it divides. Both pool layouts put heads at dim 2 —
+    slotted ``[slots, max_len, Hkv, Dh]``, paged arena ``[num_blocks+1,
+    block_size, Hkv, Dh]`` — and the int8 scale planes ``[.., Hkv, 1]``
+    split the same way, so dequantize-after-gather stays local to the
+    shard. Ragged head counts fall back to replicated (correct, no win)."""
+    if mesh is not None:
+        tp = mesh.shape.get("tp", 1)
+        if tp > 1 and num_kv_heads % tp == 0:
+            return P(None, None, "tp", None)
+    return P()
+
+
+def _c(x, mesh: Optional[Mesh], spec: P):
+    """``with_sharding_constraint`` under an explicit NamedSharding (needs
+    no ambient mesh context); identity when serving unsharded."""
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def _c_layer(layer_cache, mesh: Optional[Mesh], spec: P):
+    """Constrain every buffer of one cache layer (k/v or the int8 quartet
+    — all share the head-dim-2 layout) to ``spec``. Pinning BOTH the
+    incoming and outgoing cache to the same sharding keeps the update
+    alias-compatible, so donation still reuses the pool buffers."""
+    if mesh is None:
+        return layer_cache
+    s = NamedSharding(mesh, spec)
+    return {k: jax.lax.with_sharding_constraint(v, s)
+            for k, v in layer_cache.items()}
+
+
+def _batch_pspec(mesh: Optional[Mesh], B: int) -> P:
+    """Row-parallel spec for per-slot activations ``[B, S, ...]`` when a
+    ``dp`` axis divides the pool size; replicated otherwise."""
+    if mesh is not None:
+        dp = mesh.shape.get("dp", 1)
+        if dp > 1 and B % dp == 0:
+            return P("dp")
+    return P()
+
+
+def decode_step(args: llama.LlamaArgs, attend_len: int,
+                mesh: Optional[Mesh] = None):
+    """Compiled once per (args, attend bucket, mesh) — cached.
 
     Returns ``step(params, cache, tokens, pos, temps, keys)`` →
     ``(cache, tok, logprob, keys)`` where every array's leading axis is
@@ -207,11 +264,12 @@ def decode_step(args: llama.LlamaArgs, attend_len: int):
     - ``keys [B, 2] u32``  — per-row PRNG keys, split-then-sample per
       token exactly like ``generate_step``.
     """
-    key_ = ("decode", args, attend_len)
+    key_ = ("decode", args, attend_len, mesh)
     if key_ in _STEP_CACHE:
         return _STEP_CACHE[key_]
 
     Hq, Hkv, Dh = args.num_heads, args.num_kv_heads, args.head_dim
+    kv_spec = kv_cache_pspec(mesh, Hkv)
 
     @partial(jax.jit, donate_argnums=_donate_cache())
     def step(params, cache, tokens, pos, temps, keys):
@@ -219,12 +277,14 @@ def decode_step(args: llama.LlamaArgs, attend_len: int):
         rows = jnp.arange(B)
         positions = pos[:, None]  # [B, 1]
         x = params["tok_embeddings"]["weight"][tokens][:, None, :]  # [B,1,D]
+        x = _c(x, mesh, _batch_pspec(mesh, B))
         k_idx = jnp.arange(attend_len, dtype=jnp.int32)
         # keys at or before each row's own position (junk beyond a row's
         # write head is never attendable — pool invariant)
         mask = (k_idx[None, None, :] <= positions[:, :, None])  # [B,1,L]
         new_cache = []
         for p, layer_cache in zip(params["layers"], cache):
+            layer_cache = _c_layer(layer_cache, mesh, kv_spec)
             h = llama.rms_norm(x, p["attention_norm"]["weight"],
                                args.rms_norm_eps)
             pa = p["attention"]
@@ -234,7 +294,7 @@ def decode_step(args: llama.LlamaArgs, attend_len: int):
             q = _rope_rows(q, positions, args)
             k = _rope_rows(k, positions, args)
             new_layer, ck, cv = _write_kv_rows(layer_cache, k, v, rows, pos)
-            new_cache.append(new_layer)
+            new_cache.append(_c_layer(new_layer, mesh, kv_spec))
             out = reference_attention(
                 q, ck[:, :attend_len], cv[:, :attend_len],
                 explicit_mask=mask[:, None, None, :, :])
@@ -243,6 +303,9 @@ def decode_step(args: llama.LlamaArgs, attend_len: int):
                                            args.rms_norm_eps), args)
         x = llama.rms_norm(x, params["norm"]["weight"], args.rms_norm_eps)
         logits = _project_logits(params, x, args)[:, 0, :]  # [B, V]
+        # Replicate logits before sampling (vocab-parallel output proj
+        # leaves V sharded over tp; the Megatron-style gather point).
+        logits = _c(logits, mesh, P())
         lp_all = jax.nn.log_softmax(logits, axis=-1)
         split = jax.vmap(lambda kk: jax.random.split(kk, 2))(keys)  # [B,2,2]
         new_keys, subs = split[:, 0], split[:, 1]
@@ -259,8 +322,8 @@ def decode_step(args: llama.LlamaArgs, attend_len: int):
 
 
 def prefill_step(args: llama.LlamaArgs, chunk: int, attend_len: int,
-                 with_logits: bool):
-    """Compiled once per (args, chunk, attend bucket, with_logits).
+                 with_logits: bool, mesh: Optional[Mesh] = None):
+    """Compiled once per (args, chunk, attend bucket, with_logits, mesh).
 
     Returns ``step(params, cache, tokens, slot, pos, last_idx)`` →
     ``(cache, last_logits [1, V] | None)``: writes one ``chunk``-sized
@@ -269,11 +332,12 @@ def prefill_step(args: llama.LlamaArgs, chunk: int, attend_len: int,
     is computed and the true-last-token row selected at ``last_idx`` —
     pad junk past the true length is overwritten by decode before it is
     ever attendable."""
-    key_ = ("prefill", args, chunk, attend_len, with_logits)
+    key_ = ("prefill", args, chunk, attend_len, with_logits, mesh)
     if key_ in _STEP_CACHE:
         return _STEP_CACHE[key_]
 
     Hq, Hkv, Dh = args.num_heads, args.num_kv_heads, args.head_dim
+    kv_spec = kv_cache_pspec(mesh, Hkv)
 
     @partial(jax.jit, donate_argnums=_donate_cache())
     def step(params, cache, tokens, slot, pos, last_idx):
@@ -288,6 +352,7 @@ def prefill_step(args: llama.LlamaArgs, chunk: int, attend_len: int,
             & (k_idx[None, :] < pos + chunk)  # [C, L]
         new_cache = []
         for p, layer_cache in zip(params["layers"], cache):
+            layer_cache = _c_layer(layer_cache, mesh, kv_spec)
             h = llama.rms_norm(x, p["attention_norm"]["weight"],
                                args.rms_norm_eps)
             pa = p["attention"]
@@ -297,7 +362,7 @@ def prefill_step(args: llama.LlamaArgs, chunk: int, attend_len: int,
             q = llama.apply_rope(q, cos, sin, args.rope_traditional)
             k = llama.apply_rope(k, cos, sin, args.rope_traditional)
             new_layer, ck, cv = _write_kv_slot(layer_cache, k, v, slot, pos)
-            new_cache.append(new_layer)
+            new_cache.append(_c_layer(new_layer, mesh, kv_spec))
             out = reference_attention(q, ck[:, :attend_len],
                                       cv[:, :attend_len], explicit_mask=mask)
             x = x + llama._linear(out.reshape(1, chunk, Hq * Dh), pa["wo"])
@@ -307,6 +372,7 @@ def prefill_step(args: llama.LlamaArgs, chunk: int, attend_len: int,
             return new_cache, None
         x = llama.rms_norm(x, params["norm"]["weight"], args.rms_norm_eps)
         logits = _project_logits(params, x, args)  # [1, C, V]
+        logits = _c(logits, mesh, P())
         last = jax.lax.dynamic_slice_in_dim(logits, last_idx, 1, axis=1)
         return new_cache, last[:, 0, :]  # [1, V]
 
@@ -358,8 +424,9 @@ def _paged_gather(layer_cache, tables, nb):
 
 
 def paged_decode_step(args: llama.LlamaArgs, draft_len: int, attend_len: int,
-                      table_width: int, block_size: int, raw: bool = False):
-    """Compiled once per (args, draft_len, attend bucket, table shape).
+                      table_width: int, block_size: int, raw: bool = False,
+                      mesh: Optional[Mesh] = None):
+    """Compiled once per (args, draft_len, attend bucket, table shape, mesh).
 
     One dispatch advances every pool row AND verifies its drafts:
     ``step(params, cache, tokens, pos, tables, temps, keys)`` where
@@ -386,7 +453,7 @@ def paged_decode_step(args: llama.LlamaArgs, draft_len: int, attend_len: int,
     caller's own jit, e.g. the bench decode chain).
     """
     key_ = ("paged_decode", args, draft_len, attend_len, table_width,
-            block_size, raw)
+            block_size, raw, mesh)
     if key_ in _STEP_CACHE:
         return _STEP_CACHE[key_]
 
@@ -396,6 +463,7 @@ def paged_decode_step(args: llama.LlamaArgs, draft_len: int, attend_len: int,
     Hq, Hkv, Dh = args.num_heads, args.num_kv_heads, args.head_dim
     S = draft_len + 1
     nb = attend_len // block_size
+    kv_spec = kv_cache_pspec(mesh, Hkv)
 
     def step(params, cache, tokens, pos, tables, temps, keys):
         B = tokens.shape[0]
@@ -410,12 +478,14 @@ def paged_decode_step(args: llama.LlamaArgs, draft_len: int, attend_len: int,
         blocks = jnp.where(safe, blocks, 0)
         offs = pc % block_size
         x = params["tok_embeddings"]["weight"][tokens]  # [B, S, D]
+        x = _c(x, mesh, _batch_pspec(mesh, B))
         k_idx = jnp.arange(attend_len, dtype=jnp.int32)
         # verify position s attends everything at or before pos + s — its
         # own KV is written first, so drafts see their accepted prefix
         mask = (k_idx[None, None, :] <= positions[:, :, None])  # [B, S, L]
         new_cache = []
         for p, layer_cache in zip(params["layers"], cache):
+            layer_cache = _c_layer(layer_cache, mesh, kv_spec)
             h = llama.rms_norm(x, p["attention_norm"]["weight"],
                                args.rms_norm_eps)
             pa = p["attention"]
@@ -424,7 +494,8 @@ def paged_decode_step(args: llama.LlamaArgs, draft_len: int, attend_len: int,
             v = llama._linear(h, pa["wv"]).reshape(B, S, Hkv, Dh)
             q = _rope_rows(q, positions, args)
             k = _rope_rows(k, positions, args)
-            new_layer = _paged_write(layer_cache, k, v, blocks, offs)
+            new_layer = _c_layer(_paged_write(layer_cache, k, v, blocks, offs),
+                                 mesh, kv_spec)
             new_cache.append(new_layer)
             ck, cv = _paged_gather(new_layer, tables, nb)
             out = reference_attention(
@@ -434,6 +505,7 @@ def paged_decode_step(args: llama.LlamaArgs, draft_len: int, attend_len: int,
                                            args.rms_norm_eps), args)
         x = llama.rms_norm(x, params["norm"]["weight"], args.rms_norm_eps)
         logits = _project_logits(params, x, args)  # [B, S, V]
+        logits = _c(logits, mesh, P())
         lp_all = jax.nn.log_softmax(logits, axis=-1)
         preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, S]
         lp_preds = jnp.take_along_axis(lp_all, preds[..., None],
@@ -476,7 +548,8 @@ def paged_decode_step(args: llama.LlamaArgs, draft_len: int, attend_len: int,
 
 
 def paged_prefill_step(args: llama.LlamaArgs, chunk: int, attend_len: int,
-                       table_width: int, block_size: int, with_logits: bool):
+                       table_width: int, block_size: int, with_logits: bool,
+                       mesh: Optional[Mesh] = None):
     """Paged analogue of ``prefill_step``: writes one ``chunk`` of one
     request's prompt through its block table.
 
@@ -487,7 +560,7 @@ def paged_prefill_step(args: llama.LlamaArgs, chunk: int, attend_len: int,
     before it is attendable) or, past the mapped extent, in the shared
     junk block."""
     key_ = ("paged_prefill", args, chunk, attend_len, table_width,
-            block_size, with_logits)
+            block_size, with_logits, mesh)
     if key_ in _STEP_CACHE:
         return _STEP_CACHE[key_]
 
@@ -496,6 +569,7 @@ def paged_prefill_step(args: llama.LlamaArgs, chunk: int, attend_len: int,
                          f"block_size {block_size}")
     Hq, Hkv, Dh = args.num_heads, args.num_kv_heads, args.head_dim
     nb = attend_len // block_size
+    kv_spec = kv_cache_pspec(mesh, Hkv)
 
     @partial(jax.jit, donate_argnums=_donate_cache())
     def step(params, cache, tokens, table, pos, last_idx):
@@ -512,6 +586,7 @@ def paged_prefill_step(args: llama.LlamaArgs, chunk: int, attend_len: int,
             & (k_idx[None, :] < pos + chunk)  # [C, L]
         new_cache = []
         for p, layer_cache in zip(params["layers"], cache):
+            layer_cache = _c_layer(layer_cache, mesh, kv_spec)
             h = llama.rms_norm(x, p["attention_norm"]["weight"],
                                args.rms_norm_eps)
             pa = p["attention"]
@@ -520,7 +595,8 @@ def paged_prefill_step(args: llama.LlamaArgs, chunk: int, attend_len: int,
             v = llama._linear(h, pa["wv"]).reshape(1, chunk, Hkv, Dh)
             q = llama.apply_rope(q, cos, sin, args.rope_traditional)
             k = llama.apply_rope(k, cos, sin, args.rope_traditional)
-            new_layer = _paged_write(layer_cache, k, v, blocks, offs)
+            new_layer = _c_layer(_paged_write(layer_cache, k, v, blocks, offs),
+                                 mesh, kv_spec)
             new_cache.append(new_layer)
             ck, cv = _paged_gather(new_layer, table[None], nb)
             out = reference_attention(q, ck, cv, explicit_mask=mask)
@@ -531,6 +607,7 @@ def paged_prefill_step(args: llama.LlamaArgs, chunk: int, attend_len: int,
             return new_cache, None
         x = llama.rms_norm(x, params["norm"]["weight"], args.rms_norm_eps)
         logits = _project_logits(params, x, args)  # [1, C, V]
+        logits = _c(logits, mesh, P())
         last = jax.lax.dynamic_slice_in_dim(logits, last_idx, 1, axis=1)
         return new_cache, last[:, 0, :]  # [1, V]
 

@@ -64,6 +64,8 @@ class EngineConfig:
     stats_interval_s: float = 1.0
     worker_id: str = "serve-engine"
     metrics_port: int = 0       # Prometheus exposition (obs/prometheus.py); 0 off
+    mesh: Optional[Dict[str, int]] = None  # serving mesh axes, e.g. {"tp": 2};
+    #                             None/all-ones = single-device (pre-mesh path)
 
     @classmethod
     def from_yaml(cls, path: str) -> "EngineConfig":
@@ -79,13 +81,23 @@ class EngineConfig:
             serve["prefix_cache"] = bool(pc.get("enabled", True))
             if "min_hit_blocks" in pc:
                 serve["prefix_min_hit_blocks"] = int(pc["min_hit_blocks"])
+        # serving: {mesh: {tp: 2}} — the yaml home of the serving mesh
+        # (configs/serve-sample.yaml); serve.mesh also accepted. String
+        # specs ("tp=2,dp=1") parse like the --mesh CLI flag.
+        serving = doc.get("serving")
+        if isinstance(serving, dict) and "mesh" in serving:
+            serve.setdefault("mesh", serving["mesh"])
+        if isinstance(serve.get("mesh"), str):
+            from ..parallel import parse_mesh_spec
+
+            serve["mesh"] = parse_mesh_spec(serve["mesh"])
         known = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in serve.items() if k in known})
 
 
 class BatchEngine:
     def __init__(self, params, args, tokenizer,
-                 cfg: Optional[EngineConfig] = None):
+                 cfg: Optional[EngineConfig] = None, mesh=None):
         self.params = params
         self.args = args
         self.tokenizer = tokenizer
@@ -94,6 +106,17 @@ class BatchEngine:
             raise ValueError(
                 f"max_len {self.cfg.max_len} exceeds the model's "
                 f"max_position_embeddings {args.max_position_embeddings}")
+        # Serving mesh: an explicit Mesh object (e.g. the one the params
+        # were reshard-on-loaded into) wins; otherwise build from the
+        # config's axis sizes. None = the pre-mesh single-device path with
+        # byte-identical jit cache keys.
+        if mesh is None and self.cfg.mesh:
+            from ..parallel import build_serve_mesh
+
+            mesh = build_serve_mesh(self.cfg.mesh)
+        self.mesh = mesh
+        if self.mesh is not None:
+            self.params = self._place_params(params, self.mesh)
         if self.cfg.kv_backend == "paged":
             self.pool = PagedKVPool(
                 args, self.cfg.num_slots, self.cfg.max_len,
@@ -101,14 +124,15 @@ class BatchEngine:
                 num_blocks=self.cfg.num_blocks,
                 quantize=self.cfg.kv_quant,
                 prefix_cache=self.cfg.prefix_cache,
-                min_hit_blocks=self.cfg.prefix_min_hit_blocks)
+                min_hit_blocks=self.cfg.prefix_min_hit_blocks,
+                mesh=self.mesh)
         elif self.cfg.kv_backend == "slotted":
             if self.cfg.spec_draft_len:
                 raise ValueError(
                     "spec_draft_len requires kv_backend='paged' (in-batch "
                     "speculation commits through block tables)")
             self.pool = SlotKVPool(args, self.cfg.num_slots, self.cfg.max_len,
-                                   quantize=self.cfg.kv_quant)
+                                   quantize=self.cfg.kv_quant, mesh=self.mesh)
         else:
             raise ValueError(f"unknown kv_backend {self.cfg.kv_backend!r} "
                              "(expected 'paged' or 'slotted')")
@@ -180,6 +204,31 @@ class BatchEngine:
                         "prefix_hits": 0, "prefix_misses": 0,
                         "prefix_evictions": 0}
         self._metrics_server = None
+        # Serving-mesh shape: set once (the mesh is fixed for the engine's
+        # lifetime), labeled per axis so `serve_mesh_axis_size{axis="tp"}`
+        # reads naturally next to the device total.
+        self._mg_mesh_devices = reg.gauge(
+            "serve_mesh_devices", "devices in the serving mesh (1 = unsharded)")
+        self._mg_mesh_axis = reg.gauge(
+            "serve_mesh_axis_size", "serving mesh axis size by name")
+        self._mg_mesh_devices.set(self.mesh.size if self.mesh else 1)
+        for ax, n in (dict(self.mesh.shape) if self.mesh else {}).items():
+            self._mg_mesh_axis.set(n, axis=ax)
+
+    @staticmethod
+    def _place_params(params, mesh):
+        """Pin every param leaf to the mesh's NamedSharding per the training
+        sharding rules (Megatron column/row splits). Leaves that already
+        carry the right sharding (reshard-on-load) are untouched —
+        device_put with an equal sharding is a no-op, not a copy."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        from ..parallel import tree_pspecs
+
+        return jax.tree_util.tree_map(
+            lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+            params, tree_pspecs(params, mesh))
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "BatchEngine":
@@ -299,6 +348,9 @@ class BatchEngine:
             "completed": s.completed,
             "preempted": s.preempted,
             "kv_backend": self.pool.kind,
+            # Dashboard "mesh" column: "tp=2" / "tp=2,dp=2" / "1dev".
+            "mesh": (",".join(f"{a}={n}" for a, n in self.mesh.shape.items())
+                     if self.mesh is not None else "1dev"),
         }
         if self.pool.kind == "paged":
             snap.update({
@@ -455,13 +507,13 @@ class BatchEngine:
         if pool.kind == "paged":
             step = batch_step.paged_prefill_step(
                 self.args, C, attend, pool.max_blocks, pool.block_size,
-                with_logits=final)
+                with_logits=final, mesh=self.mesh)
             cache, last_logits = step(self.params, pool.cache, toks,
                                       pool.tables[req.slot], np.int32(start),
                                       np.int32(max(n - 1, 0)))
         else:
             step = batch_step.prefill_step(self.args, C, attend,
-                                           with_logits=final)
+                                           with_logits=final, mesh=self.mesh)
             cache, last_logits = step(self.params, pool.cache, toks,
                                       np.int32(req.slot), np.int32(start),
                                       np.int32(max(n - 1, 0)))
@@ -499,7 +551,7 @@ class BatchEngine:
             keys[r.slot] = r.rng_key
         bucket = batch_step.attend_bucket(
             int(pos[[r.slot for r in dec]].max()) + 1, pool.max_len)
-        step = batch_step.decode_step(self.args, bucket)
+        step = batch_step.decode_step(self.args, bucket, mesh=self.mesh)
         cache, tok, lp, new_keys = step(self.params, pool.cache, tokens,
                                         pos, temps, keys)
         pool.cache = cache
@@ -558,7 +610,8 @@ class BatchEngine:
         bucket = self._attend(
             int(pos[[r.slot for r in dec]].max()) + S)
         step = batch_step.paged_decode_step(self.args, k, bucket,
-                                            pool.max_blocks, pool.block_size)
+                                            pool.max_blocks, pool.block_size,
+                                            mesh=self.mesh)
         out = step(self.params, pool.cache, tokens, pos, pool.tables,
                    temps, keys)
         pool.cache = out[0]

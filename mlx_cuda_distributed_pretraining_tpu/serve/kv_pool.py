@@ -29,13 +29,31 @@ from ..models import llama
 from .prefix_cache import PrefixCache, chain_keys
 
 
+def _place_cache(cache, mesh, num_kv_heads):
+    """Device-put a pool's buffers into the serving mesh's NamedSharding
+    (head dim over ``tp``, see batch_step.kv_cache_pspec) so the very first
+    dispatch runs partitioned instead of paying a lazy reshard. Identity
+    without a mesh. Block tables stay host numpy — replicated by virtue of
+    being passed as plain arrays."""
+    if mesh is None:
+        return cache
+    import jax
+    from jax.sharding import NamedSharding
+
+    from .batch_step import kv_cache_pspec
+
+    s = NamedSharding(mesh, kv_cache_pspec(mesh, num_kv_heads))
+    return [{k: jax.device_put(v, s) for k, v in layer.items()}
+            for layer in cache]
+
+
 class SlotKVPool:
     """Fixed pool of KV-cache slots with per-slot length state."""
 
     kind = "slotted"
 
     def __init__(self, args: llama.LlamaArgs, num_slots: int, max_len: int,
-                 dtype=None, quantize: bool = False):
+                 dtype=None, quantize: bool = False, mesh=None):
         import jax.numpy as jnp
 
         if num_slots < 1:
@@ -52,6 +70,7 @@ class SlotKVPool:
         # Slot positions live pool-side, not per layer.
         for layer in self.cache:
             layer.pop("pos", None)
+        self.cache = _place_cache(self.cache, mesh, args.num_kv_heads)
         self._free: List[int] = list(range(num_slots - 1, -1, -1))
         # Written length per slot (== next write position). Free slots keep
         # their stale value; allocate() resets it.
@@ -160,7 +179,8 @@ class PagedKVPool:
     def __init__(self, args: llama.LlamaArgs, num_seqs: int, max_len: int,
                  block_size: int = 32, num_blocks: int = 0,
                  dtype=None, quantize: bool = False,
-                 prefix_cache: bool = False, min_hit_blocks: int = 1):
+                 prefix_cache: bool = False, min_hit_blocks: int = 1,
+                 mesh=None):
         import jax.numpy as jnp
         import numpy as np
 
@@ -186,9 +206,11 @@ class PagedKVPool:
         self.num_blocks = num_blocks
         self.quantize = quantize
         # +1: physical block 0 is the reserved junk block.
-        self.cache = llama.init_paged_cache(
-            args, num_blocks + 1, block_size,
-            dtype=dtype or jnp.float32, quantize=quantize)
+        self.cache = _place_cache(
+            llama.init_paged_cache(
+                args, num_blocks + 1, block_size,
+                dtype=dtype or jnp.float32, quantize=quantize),
+            mesh, args.num_kv_heads)
         self.tables = np.zeros((num_seqs, self.max_blocks), dtype=np.int32)
         self.lengths: List[int] = [0] * num_seqs
         self._mapped: List[int] = [0] * num_seqs  # blocks mapped per row

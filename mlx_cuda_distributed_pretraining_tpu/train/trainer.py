@@ -1191,10 +1191,16 @@ def _enable_compilation_cache(cache_dir: str) -> str:
     return f"compilation cache: {cache_dir} — {entries} entries, {state}"
 
 
-def load_trained(run_name_or_dir: str, runs_root: str = "runs"):
+def load_trained(run_name_or_dir: str, runs_root: str = "runs", mesh=None):
     """Load a finished run for inference: (params, args, tokenizer, config).
     Mirrors ``Trainer(for_training=False)`` + final-checkpoint load
-    (reference: core/generation.py:33-43)."""
+    (reference: core/generation.py:33-43).
+
+    With ``mesh`` (a serving mesh from ``parallel.build_serve_mesh``) the
+    params reshard on load: checkpoints are mesh-agnostic on disk, and each
+    leaf is placed straight into the serving mesh's ``NamedSharding`` per
+    the training sharding rules — whatever mesh shape trained it, with no
+    full-replica materialization (see CheckpointManager.shard_arrays)."""
     run_dir = run_name_or_dir if os.path.isdir(run_name_or_dir) else os.path.join(runs_root, run_name_or_dir)
     cfg = Config.from_yaml(os.path.join(run_dir, "config.yaml"))
     tok = TokenizerManager.from_run_dir(run_dir)
@@ -1215,7 +1221,10 @@ def load_trained(run_name_or_dir: str, runs_root: str = "runs"):
     from ..utils.tree import unflatten_dict
 
     arrays, _ = load_safetensors(model_path)
-    nested = unflatten_dict({k: jnp.asarray(v) for k, v in arrays.items()})
+    if mesh is not None:
+        nested = unflatten_dict(CheckpointManager.shard_arrays(arrays, mesh))
+    else:
+        nested = unflatten_dict({k: jnp.asarray(v) for k, v in arrays.items()})
     params = _restructure(params0, nested)
     return params, args, tok, cfg
 

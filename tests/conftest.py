@@ -45,3 +45,35 @@ assert hasattr(_xb, "backends_are_initialized"), (
 
 assert not _xb.backends_are_initialized(), "jax backends initialized before conftest"
 _xb._backend_factories.pop("axon", None)
+
+
+# --- shared subprocess-spawn helpers ---------------------------------------
+# Several suites (test_multiprocess, test_supervisor, test_serve_tp, bench
+# children) spawn real Python subprocesses that must see a forced virtual
+# CPU device count. The env recipe is identical everywhere; keep it in ONE
+# place so "how do child processes get N devices" has a single answer.
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def device_env(n, base=None):
+    """Child-process env with ``n`` virtual CPU devices.
+
+    Sets PYTHONPATH to the repo root (which both makes the package importable
+    and drops the axon TPU sitecustomize dir from the inherited path), forces
+    the CPU backend, and forces the host-platform device count.
+    """
+    env = dict(os.environ if base is None else base)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={int(n)}"
+    return env
+
+
+def spawn_with_devices(argv, n, **popen_kw):
+    """subprocess.Popen(argv) under device_env(n), output captured as text."""
+    import subprocess
+
+    kw = dict(stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    kw.update(popen_kw)
+    return subprocess.Popen(argv, env=device_env(n), **kw)

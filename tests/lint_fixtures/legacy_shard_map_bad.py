@@ -1,0 +1,8 @@
+"""BAD fixture: legacy-shard-map-import."""
+import jax.experimental.shard_map  # line 2: deprecated module path
+from jax.experimental.shard_map import shard_map  # line 3: same, from-form
+from jax.experimental import shard_map as smap  # line 4: module via parent
+
+
+def run(f, mesh, x):
+    return shard_map(f, mesh=mesh)(x), smap, jax

@@ -38,6 +38,7 @@ EXPECTED_RULE_IDS = {
     "tracer-leak",
     "jit-in-loop",
     "time-in-jit",
+    "legacy-shard-map-import",
 }
 
 
@@ -93,6 +94,7 @@ def test_baseline_entries_all_still_match():
     ("tracer_leak_bad.py", "tracer-leak", [10, 17]),
     ("jit_in_loop_bad.py", "jit-in-loop", [7]),
     ("time_in_jit_bad.py", "time-in-jit", [9, 11, 12]),
+    ("legacy_shard_map_bad.py", "legacy-shard-map-import", [2, 3, 4]),
 ])
 def test_bad_fixture_fires_at_exact_lines(fixture, rule, lines):
     active, _ = _hits(fixture)
@@ -109,6 +111,7 @@ def test_bad_fixture_fires_at_exact_lines(fixture, rule, lines):
     "tracer_leak_good.py",
     "jit_in_loop_good.py",
     "time_in_jit_good.py",
+    "legacy_shard_map_good.py",
 ])
 def test_good_fixture_is_clean(fixture):
     active, suppressed = _hits(fixture)
@@ -124,6 +127,7 @@ def test_good_fixture_is_clean(fixture):
     ("tracer_leak_suppressed.py", "tracer-leak", 9),
     ("jit_in_loop_suppressed.py", "jit-in-loop", 8),
     ("time_in_jit_suppressed.py", "time-in-jit", 8),
+    ("legacy_shard_map_suppressed.py", "legacy-shard-map-import", 3),
 ])
 def test_suppression_silences_but_counts(fixture, rule, line):
     active, suppressed = _hits(fixture)

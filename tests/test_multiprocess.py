@@ -95,14 +95,10 @@ def _free_port():
 
 
 def _spawn(worker_src, pid, phase):
-    env = dict(os.environ)
-    env.pop("PYTHONPATH", None)  # disable the axon TPU sitecustomize
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-    return subprocess.Popen(
-        [sys.executable, "-c", worker_src, str(pid), phase],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
-    )
+    from conftest import spawn_with_devices
+
+    return spawn_with_devices(
+        [sys.executable, "-c", worker_src, str(pid), phase], n=2)
 
 
 def _run_phase(workdir, phase):

@@ -208,11 +208,9 @@ def test_fresh_start_overwrites_only_without_checkpoint_data(tmp_path):
 # --- slow tier: real training, real kill -9 --------------------------------
 
 def _child_env():
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO  # drop the axon TPU sitecustomize dir
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
-    return env
+    from conftest import device_env
+
+    return device_env(1)
 
 
 def _write_chaos_config(tmp_path, iters):
