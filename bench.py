@@ -41,7 +41,7 @@ decode/prefill additionally use a two-point (T(n_hi)-T(n_lo)) difference
 to cancel the fixed overhead.
 
 Env knobs: BENCH_CASES (comma list: 2m,40m,100m,400m,650m,1b,simple,
-decode,serve,moe,longctx,trainer; default all; plus CI-only "tiny"),
+decode,serve,pp,moe,longctx,trainer; default all; plus CI-only "tiny"),
 BENCH_STEPS, BENCH_VOCAB, BENCH_BUDGET_S. The "serve" family compares
 the continuous-batching engine (serve/) against the locked server path
 at occupancy 1/4/8 — a scheduling comparison that is meaningful on CPU.
@@ -1217,6 +1217,193 @@ def bench_serve_tp_case(vocab, name="serve_tp"):
     }
 
 
+_TRAIN_PP_WORKER = """
+import json, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+from mlx_cuda_distributed_pretraining_tpu.config import TrainingConfig
+from mlx_cuda_distributed_pretraining_tpu.models import llama
+from mlx_cuda_distributed_pretraining_tpu.optim import build_optimizer
+from mlx_cuda_distributed_pretraining_tpu.parallel import pipeline as pl
+from mlx_cuda_distributed_pretraining_tpu.train.train_step import (
+    init_train_state, make_train_step)
+
+assert jax.device_count() == 2, jax.devices()
+
+vocab = {vocab}
+args = llama.LlamaArgs(vocab_size=vocab, max_position_embeddings=128,
+                       **{shape!r})
+# host snapshot: each measured configuration re-materializes the same
+# initial params (the donated train state consumes the device buffers)
+_host = jax.device_get(llama.init_params(jax.random.PRNGKey(0), args))
+def fresh_params():
+    return jax.tree_util.tree_map(jnp.asarray, _host)
+
+BATCH, SEQ, STEPS, M = 8, 128, {steps}, 4
+rng = np.random.default_rng(0)
+flood = []
+for _ in range(STEPS):
+    x = rng.integers(1, vocab - 4, size=(BATCH, SEQ + 1)).astype(np.int32)
+    flood.append({{"inputs": jnp.asarray(x[:, :-1]),
+                   "targets": jnp.asarray(x[:, 1:]),
+                   "mask": jnp.ones((BATCH, SEQ), jnp.float32)}})
+
+def make_opt():
+    tr = TrainingConfig(
+        hyperparameters={{"learning_rate": 1e-3, "gradient_clip": 1.0}},
+        scheduler={{"type": "cosine"}}, optimization={{"optimizer": "adamw"}})
+    return build_optimizer(tr, 1000)
+
+# pp=1 reference: the plain single-program train step over the same flood
+sstep, _ = make_train_step(lambda p, b: llama.loss_fn(p, b, args), make_opt())
+state = init_train_state(fresh_params(), make_opt())
+losses1, t1 = [], []
+for b in flood:
+    t0 = time.perf_counter()
+    state, m = sstep(state, b)
+    l = float(m["loss"])  # host fetch syncs the step
+    losses1.append(l); t1.append(time.perf_counter() - t0)
+
+mesh = Mesh(mesh_utils.create_device_mesh(
+    (2, 1), devices=jax.devices()), ("pp", "dp"))
+
+def run_pp(interleave, compute_skip):
+    step, shardings = pl.make_pipeline_train_step(
+        args, make_opt(), mesh, M, params_like=fresh_params(),
+        interleave=interleave, compute_skip=compute_skip)
+    st = jax.device_put(
+        init_train_state(pl.stack_layers(fresh_params(), interleave=interleave),
+                         make_opt()), shardings)
+    losses, ts = [], []
+    for b in flood:
+        t0 = time.perf_counter()
+        st, m = step(st, b)
+        l = float(m["loss"])
+        losses.append(l); ts.append(time.perf_counter() - t0)
+    return losses, ts
+
+losses_v1, t_v1 = run_pp(1, True)
+losses_v2, t_v2 = run_pp(2, True)
+_, t_noskip = run_pp(1, False)
+
+# Instrumented slab counter: per-device EXECUTED chunk applications for one
+# loss evaluation (remat=None so the count is forward+no-replay). The hook
+# binds when make_pipeline_loss traces, so set it first.
+def count_slabs(interleave, compute_skip):
+    n = [0]
+    pl._SLAB_APP_HOOK = lambda: n.__setitem__(0, n[0] + 1)
+    try:
+        lf = pl.make_pipeline_loss(args, mesh, M, interleave=interleave,
+                                   compute_skip=compute_skip)
+        l, _ = jax.jit(lf)(pl.stack_layers(fresh_params(), interleave=interleave),
+                           flood[0])
+        l.block_until_ready()
+        jax.effects_barrier()
+    finally:
+        pl._SLAB_APP_HOOK = None
+    return n[0]
+
+slabs = {{"v1_skip": count_slabs(1, True), "v1_all": count_slabs(1, False),
+          "v2_skip": count_slabs(2, True), "v2_all": count_slabs(2, False)}}
+
+print("TRAIN_PP " + json.dumps({{
+    "n_params": llama.num_params(_host), "batch": BATCH, "seq": SEQ,
+    "steps": STEPS, "microbatches": M,
+    "losses_pp1": losses1, "losses_pp2_v1": losses_v1,
+    "losses_pp2_v2": losses_v2,
+    "step_s_pp1": t1, "step_s_pp2_v1": t_v1, "step_s_pp2_v2": t_v2,
+    "step_s_pp2_noskip": t_noskip, "slabs": slabs}}), flush=True)
+"""
+
+
+def bench_train_pp_case(vocab, steps, name="train_pp"):
+    """Zero-waste pipeline acceptance: pp=2 vs pp=1 on two forced host (CPU)
+    devices. Three claims, each measured, none chip-dependent:
+
+    - parity: per-step training losses on the pp=2 GPipe schedule (V=1 and
+      interleaved V=2) match the single-program step over the same flood to
+      fp32 tolerance — pipelining is a schedule, not a numerics change.
+    - compute-skip: the instrumented slab counter shows per-device executed
+      chunk applications drop from P*(V*M + P-1) to P*(V*M) with skip on —
+      bubble ticks cost no FLOPs, so MFU accounting can stay useful-only.
+    - telemetry: step time / tok/s / MFU for the pp=2 path next to pp=1.
+      On virtual CPU devices pp=2 splits one socket, so the interesting
+      direction is schedule overhead, not speedup (that needs real chips);
+      bubble_frac and executed_flops_ratio are the analytic companions.
+    """
+    import os
+    import subprocess
+
+    from mlx_cuda_distributed_pretraining_tpu.obs.flops import (
+        pipeline_bubble_frac,
+        pipeline_executed_flops_ratio,
+    )
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    n_steps = max(4, min(int(steps), 8))
+    src = _TRAIN_PP_WORKER.format(repo=repo, vocab=vocab, steps=n_steps,
+                                  shape=SCALES["2m"]["shape"])
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    proc = subprocess.run([sys.executable, "-c", src], env=env,
+                          capture_output=True, text=True, timeout=900)
+    line = next((ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("TRAIN_PP ")), None)
+    if proc.returncode != 0 or line is None:
+        raise RuntimeError(
+            f"train_pp worker rc={proc.returncode}: {proc.stderr[-1500:]}")
+    res = json.loads(line[len("TRAIN_PP "):])
+
+    P, M, V = 2, res["microbatches"], 2
+    def rel_diff(a, b):
+        return max(abs(x - y) / max(abs(y), 1e-9) for x, y in zip(a, b))
+
+    d_v1 = rel_diff(res["losses_pp2_v1"], res["losses_pp1"])
+    d_v2 = rel_diff(res["losses_pp2_v2"], res["losses_pp1"])
+    slabs = res["slabs"]
+    # steady-state step time: skip the compile-bearing first step
+    def steady(ts):
+        tail = ts[1:] or ts
+        return sum(tail) / len(tail)
+
+    toks = res["batch"] * res["seq"]
+    st_v1 = steady(res["step_s_pp2_v1"])
+    ft = flops_per_token(res["n_params"], SCALES["2m"]["shape"]["num_layers"],
+                         res["seq"], 8 * 16)
+    return {
+        "case": name, "vocab": vocab, "devices": 2, "mesh": "pp=2",
+        "batch": res["batch"], "seq": res["seq"], "steps": res["steps"],
+        "microbatches": M, "interleave": V,
+        "loss_rel_diff_v1": round(d_v1, 6),
+        "loss_rel_diff_v2": round(d_v2, 6),
+        "loss_parity": d_v1 < 1e-3 and d_v2 < 1e-3,
+        "slab_apps_v1": [slabs["v1_skip"], slabs["v1_all"]],
+        "slab_apps_v2": [slabs["v2_skip"], slabs["v2_all"]],
+        "skip_works": (slabs["v1_skip"] == P * M
+                       and slabs["v1_all"] == P * (M + P - 1)
+                       and slabs["v2_skip"] == P * V * M
+                       and slabs["v2_all"] == P * (V * M + P - 1)),
+        "bubble_frac_v1": round(pipeline_bubble_frac(P, M), 4),
+        "bubble_frac_v2": round(pipeline_bubble_frac(P, M, interleave=V), 4),
+        "executed_flops_ratio_noskip": round(
+            pipeline_executed_flops_ratio(P, M, compute_skip=False), 4),
+        "step_ms_pp1": round(1000 * steady(res["step_s_pp1"]), 1),
+        "step_ms_pp2_v1": round(1000 * st_v1, 1),
+        "step_ms_pp2_v2": round(1000 * steady(res["step_s_pp2_v2"]), 1),
+        "step_ms_pp2_noskip": round(1000 * steady(res["step_s_pp2_noskip"]), 1),
+        "tok_s": round(toks / st_v1, 0),
+        "flops_per_token": round(ft, 0),
+        "mfu": mfu_or_unknown(ft, toks / st_v1),
+    }
+
+
 def bench_moe_case(vocab, steps, name="moe_8x40m"):
     """Grouped (dropless, sort-based — ops/grouped_matmul.py) vs einsum
     (GShard dispatch tensors) MoE training throughput on the SAME model:
@@ -1519,6 +1706,10 @@ def build_plan(vocab, steps):
         # forced host devices — token-identical greedy, unchanged
         # per-step host-sync count, layout-overhead tok/s + TTFT.
         ("serve_tp", "serve", lambda: bench_serve_tp_case(vocab), 300),
+        # train_pp: zero-waste pipeline schedule, pp=2 vs pp=1 on two
+        # forced host devices — per-step loss parity (V=1 and V=2),
+        # instrumented compute-skip slab counts, bubble/step telemetry.
+        ("train_pp", "pp", lambda: bench_train_pp_case(vocab, steps), 300),
         # moe_8x40m: grouped (dropless sorted dispatch) vs einsum (GShard
         # capacity tensors) on the same model — a dispatch-algorithm
         # comparison that is meaningful on CPU, like the serve family.

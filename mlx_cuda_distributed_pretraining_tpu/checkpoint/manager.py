@@ -395,16 +395,21 @@ class CheckpointManager:
     # -- load ---------------------------------------------------------------
     def load(
         self, step, like_params: Optional[Any] = None, like_opt_state: Optional[Any] = None,
-        strict: bool = False,
+        strict: bool = False, with_params: bool = True,
     ) -> Tuple[Any, Optional[Any], Dict[str, Any]]:
         """Load the step triplet. When the caller expects optimizer state
         (``like_opt_state`` given) but the file is missing or unreadable,
         this WARNS loudly and returns ``opt_state=None`` — the trainer then
         continues with a fresh optimizer, which silently degrades Adam/Muon
         moment statistics. ``strict=True`` (config ``resume.strict``) turns
-        that degradation into a hard :class:`CheckpointIntegrityError`."""
+        that degradation into a hard :class:`CheckpointIntegrityError`.
+
+        ``with_params=False`` skips reading the model file entirely and
+        returns ``params=None`` — for callers that place params themselves
+        (:meth:`load_params_stacked`'s pp-direct resume) and only want the
+        optimizer/training-state pair."""
         model_path, opt_path, state_path = self.paths_for_step(step)
-        params = self.load_params(model_path, like=like_params)
+        params = self.load_params(model_path, like=like_params) if with_params else None
 
         opt_state = None
         if like_opt_state is not None:
@@ -498,7 +503,8 @@ class CheckpointManager:
         return _restructure_like(like, unflatten_dict(out))
 
     @staticmethod
-    def shard_arrays(arrays: Dict[str, np.ndarray], mesh: Any) -> Dict[str, Any]:
+    def shard_arrays(arrays: Dict[str, np.ndarray], mesh: Any,
+                     pspec_fn: Optional[Any] = None) -> Dict[str, Any]:
         """Place a flat ``{dotted.path: host array}`` dict onto ``mesh`` per
         the training param rules — reshard-on-load.
 
@@ -506,18 +512,109 @@ class CheckpointManager:
         feeds per-device index views of the host buffer): no host-side
         gather, and no device ever holds a full replica of a sharded leaf.
         The checkpoint on disk is always full host arrays, so a file saved
-        under fsdp=2, tp=1, or a single device reshards identically."""
+        under fsdp=2, tp=1, or a single device reshards identically.
+
+        ``pspec_fn(key, shape, mesh)`` overrides the placement rule (default
+        ``parallel.sharding_rules.param_pspec``)."""
         from jax.sharding import NamedSharding
 
         from ..parallel.sharding_rules import param_pspec
 
+        if pspec_fn is None:
+            pspec_fn = param_pspec
         placed: Dict[str, Any] = {}
         for k, v in arrays.items():
             arr = np.asarray(v)
-            sharding = NamedSharding(mesh, param_pspec(k, arr.shape, mesh))
+            sharding = NamedSharding(mesh, pspec_fn(k, arr.shape, mesh))
             placed[k] = jax.make_array_from_callback(
                 arr.shape, sharding, lambda idx, a=arr: a[idx])
         return placed
+
+    @staticmethod
+    def load_params_stacked(model_path: str, mesh: Any, num_layers: int,
+                            interleave: int = 1,
+                            like_stacked: Optional[Any] = None) -> Any:
+        """Reshard-on-load straight into the pipeline's stacked layout.
+
+        The checkpoint on disk is mesh-agnostic per-layer host arrays
+        (``layers.{i}.{rest}``); the pipeline wants one stacked tree
+        (``layers.{rest}`` with a leading ``[L]`` — or ``[V, L/V]`` under
+        ``interleave`` — dim) sharded per ``stacked_param_pspec``. Each
+        device's callback stacks ONLY the layer slices named by its own
+        shard index, so a checkpoint saved on an fsdp mesh lands directly
+        in its pp×fsdp placement with no host-side gather and no device
+        ever holding a full stacked replica.
+
+        ``like_stacked`` (the live stacked device params) gates structure:
+        extra file keys are dropped, a wholly absent leaf keeps the live
+        value, and a dtype/shape mismatch raises
+        :class:`CheckpointIntegrityError` (casting would re-materialize the
+        full array on one host). A partially present layer family (some of
+        its L per-layer arrays missing) is always an integrity error.
+        """
+        from jax.sharding import NamedSharding
+
+        from ..parallel.pipeline import stacked_param_pspec
+
+        arrays, _ = load_safetensors(model_path)
+        L, V = int(num_layers), int(interleave)
+        if L <= 0 or (V > 1 and L % V != 0):
+            raise CheckpointIntegrityError(
+                f"load_params_stacked: num_layers={L} not divisible by "
+                f"interleave={V}")
+        Lv = L // V
+
+        per_suffix: Dict[str, Dict[int, np.ndarray]] = {}
+        others: Dict[str, np.ndarray] = {}
+        for k, v in arrays.items():
+            if k.startswith("layers."):
+                _, idx, suffix = k.split(".", 2)
+                per_suffix.setdefault(suffix, {})[int(idx)] = np.asarray(v)
+            else:
+                others[k] = v
+
+        flat_out: Dict[str, Any] = dict(
+            CheckpointManager.shard_arrays(others, mesh))
+        like_flat = flatten_dict(like_stacked) if like_stacked is not None else None
+        for suffix, per in sorted(per_suffix.items()):
+            key = "layers." + suffix
+            missing = [i for i in range(L) if i not in per]
+            if missing:
+                raise CheckpointIntegrityError(
+                    f"load_params_stacked: {key} has {len(missing)}/{L} "
+                    f"per-layer arrays missing (e.g. layer {missing[0]})")
+            base = per[0]
+            shape = (V, Lv, *base.shape) if V > 1 else (L, *base.shape)
+            if like_flat is not None and key in like_flat:
+                ref = like_flat[key]
+                if base.dtype != ref.dtype or shape != tuple(ref.shape):
+                    raise CheckpointIntegrityError(
+                        f"reshard-on-load: {key} stacks to {base.dtype}"
+                        f"{shape} from disk but is {ref.dtype}"
+                        f"{tuple(ref.shape)} live; cast/reshape would "
+                        f"re-materialize the full array on one host")
+            sharding = NamedSharding(
+                mesh, stacked_param_pspec(key, shape, mesh, interleave=V))
+
+            def cb(idx, per=per):
+                if V > 1:
+                    vs = range(*idx[0].indices(V))
+                    js = range(*idx[1].indices(Lv))
+                    rest = tuple(idx[2:])
+                    return np.stack([
+                        np.stack([per[v * Lv + j][rest] for j in js])
+                        for v in vs])
+                ls = range(*idx[0].indices(L))
+                return np.stack([per[i][tuple(idx[1:])] for i in ls])
+
+            flat_out[key] = jax.make_array_from_callback(shape, sharding, cb)
+
+        if like_stacked is None:
+            return unflatten_dict(flat_out)
+        out = {}
+        for k, ref in like_flat.items():
+            out[k] = flat_out.get(k, ref)
+        return _restructure_like(like_stacked, unflatten_dict(out))
 
     def latest_step(self) -> Optional[str]:
         """Highest numeric step with a model file, or "final" if present."""

@@ -250,6 +250,18 @@ class SystemConfig:
     # Pipeline parallelism (pp mesh axis): microbatches per step. 0 means
     # 2 * pp-size (keeps the GPipe bubble fraction under 1/3).
     pipeline_microbatches: int = 0
+    # Interleaved virtual stages (Megatron-style): each device owns V
+    # round-robin chunks of num_layers/(pp*V) layers and activations make
+    # V circuits of the ring, shrinking the warmup/drain bubble from P-1
+    # to (P-1)/V slab-times. V > 1 requires pipeline_microbatches >= pp.
+    # 1 = classic GPipe (bit-identical to the pre-interleave schedule).
+    pipeline_interleave: int = 1
+    # Skip slab compute (and the stage-0 embed gather) on non-working
+    # warmup/drain ticks via lax.cond: per-step slab applications drop
+    # from P*(V*M+P-1) to exactly P*V*M, forward and backward. False
+    # reproduces the original every-tick schedule bit-identically — only
+    # useful for apples-to-apples benches.
+    pipeline_compute_skip: bool = True
     # Fused chunked cross-entropy (ops/fused_ce.py): rows per chunk.
     # 0 = always materialize full logits; -1 = auto (enable when the
     # [B, S, V] logits tensor would be HBM-significant); >0 = fixed chunk.
@@ -338,6 +350,48 @@ _SECTION_TYPES = {
 }
 
 
+def _validate_pipeline_config(cfg: "Config") -> None:
+    """Cross-section pipeline checks at config-load time.
+
+    An invalid microbatch or layer count would otherwise surface as an
+    opaque ``reshape`` tracer error deep inside ``make_pipeline_loss``;
+    failing here names the config keys instead.
+    """
+    sysc = cfg.system
+    pp = int((sysc.mesh or {}).get("pp", 1) or 1)
+    V = getattr(sysc, "pipeline_interleave", 1)
+    V = 1 if V is None else int(V)
+    M = int(getattr(sysc, "pipeline_microbatches", 0) or 0)
+    if V < 1:
+        raise ValueError(
+            f"system.pipeline_interleave must be >= 1, got {V}")
+    if M < 0:
+        raise ValueError(
+            f"system.pipeline_microbatches must be >= 0 (0 = 2*pp), got {M}")
+    if pp <= 1:
+        return
+    m_eff = M or 2 * pp
+    bs = int(cfg.training.batch_size)
+    if bs % m_eff != 0:
+        raise ValueError(
+            f"training.batch_size={bs} must be divisible by "
+            f"system.pipeline_microbatches={m_eff}"
+            f"{'' if M else f' (defaulted to 2*pp={m_eff})'}: each pipeline "
+            f"microbatch carries batch_size/pipeline_microbatches rows")
+    layers = int(cfg.model.num_layers)
+    if layers % (pp * V) != 0:
+        raise ValueError(
+            f"model.num_layers={layers} must be divisible by "
+            f"mesh.pp*pipeline_interleave={pp}*{V}={pp * V}: each of the "
+            f"pp*interleave virtual stage chunks owns an equal slab of layers")
+    if V > 1 and m_eff < pp:
+        raise ValueError(
+            f"system.pipeline_interleave={V} requires pipeline_microbatches "
+            f">= mesh.pp ({m_eff} < {pp}): circuit v's wrap-around "
+            f"activation must leave the ring before stage 0 re-feeds that "
+            f"microbatch for circuit v+1")
+
+
 def _build_section(cls, raw: Optional[Dict[str, Any]]):
     raw = dict(raw or {})
     names = {f.name for f in dataclasses.fields(cls)}
@@ -377,12 +431,14 @@ class Config:
         resume = None
         if config_dict.get("resume"):
             resume = _build_section(ResumeConfig, config_dict["resume"])
-        return cls(
+        cfg = cls(
             name=config_dict["name"],
             overwrite=bool(config_dict.get("overwrite", False)),
             resume=resume,
             **sections,
         )
+        _validate_pipeline_config(cfg)
+        return cfg
 
     @classmethod
     def from_yaml(cls, yaml_path: str) -> "Config":

@@ -111,6 +111,10 @@ def replay_into(registry, path: str) -> int:
     Only monotonic run-lifetime counters are rebuilt (steps, tokens,
     checkpoint saves, goodput seconds, faults, restarts) — gauges like
     loss/MFU are live-window quantities the next step window overwrites.
+    The one gauge exception is ``pipeline_bubble_frac``: it is a constant
+    of the schedule shape (pp, microbatches, interleave), so the last
+    ``step_window`` carrying a ``bubble`` field restores it — a resumed pp
+    run exports the gauge before its first new window closes.
     """
     steps = registry.counter("train_steps_total",
                              "optimizer steps completed over the run lifetime")
@@ -132,6 +136,13 @@ def replay_into(registry, path: str) -> int:
             for comp, secs in (ev.get("goodput") or {}).items():
                 if isinstance(secs, (int, float)) and secs > 0:
                     goodput.inc(float(secs), component=comp)
+            bubble = ev.get("bubble")
+            if isinstance(bubble, (int, float)):
+                registry.gauge(
+                    "pipeline_bubble_frac",
+                    "fraction of pipeline schedule ticks spent in the "
+                    "warmup/drain bubble (idle with compute-skip)",
+                ).set(float(bubble))
         elif et == "checkpoint_save":
             saves.inc()
         elif et == "eval":

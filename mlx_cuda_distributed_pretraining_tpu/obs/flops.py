@@ -121,10 +121,58 @@ def peak_flops_per_chip(device_kind: Optional[str] = None) -> Optional[float]:
 
 def mfu(tok_s: float, flops_per_tok: float,
         peak_per_chip: Optional[float], n_chips: int) -> Optional[float]:
-    """Model FLOPs utilization in [0, 1]-ish, or None when peak unknown."""
+    """Model FLOPs utilization in [0, 1]-ish, or None when peak unknown.
+
+    Useful-FLOPs-only by construction, including under pipeline
+    parallelism: the numerator is analytic model FLOPs times REAL tokens
+    per second, so warmup/drain bubble ticks (and, with
+    ``pipeline_compute_skip: false``, slab applications on masked garbage)
+    only ever show up as a lower ``tok_s`` — never as credited work. The
+    schedule overhead itself is reported separately via
+    :func:`pipeline_bubble_frac` / :func:`pipeline_executed_flops_ratio`.
+    """
     if peak_per_chip is None or peak_per_chip <= 0 or n_chips <= 0:
         return None
     return float(flops_per_tok) * float(tok_s) / (peak_per_chip * n_chips)
+
+
+def pipeline_bubble_frac(pp: int, microbatches: int,
+                         interleave: int = 1) -> float:
+    """Fraction of schedule ticks each stage spends idle (the bubble).
+
+    The GPipe schedule runs ``T = V*M + P - 1`` ticks per step (P stages,
+    M microbatches, V interleaved virtual stages) of which each stage
+    works exactly ``V*M`` — so ``(P-1) / (V*M + P-1)`` of its tick-time is
+    bubble. Interleave shrinks the bubble because each tick applies only
+    ``1/V`` of the stage's layers: the same P-1 warmup/drain ticks cost
+    ``(P-1)/V`` full-slab-times. With compute-skip the bubble is idle
+    time; without it, the same fraction is garbage compute.
+    """
+    P = max(1, int(pp))
+    M = max(1, int(microbatches))
+    V = max(1, int(interleave))
+    return float(P - 1) / float(V * M + P - 1)
+
+
+def pipeline_executed_flops_ratio(pp: int, microbatches: int,
+                                  interleave: int = 1,
+                                  compute_skip: bool = True) -> float:
+    """Hardware slab FLOPs executed per useful slab FLOP.
+
+    1.0 with compute-skip (non-working ticks run no slab compute). With
+    ``pipeline_compute_skip: false`` every stage applies its chunk on all
+    ``V*M + P - 1`` ticks but only ``V*M`` carry real microbatches, so the
+    chips burn ``(V*M + P - 1) / (V*M)`` times the useful FLOPs — strictly
+    worse than an idle bubble. MFU never credits the excess (see
+    :func:`mfu`); this ratio is the honest "what did the hardware do"
+    multiplier for bench rows and capacity planning.
+    """
+    if compute_skip:
+        return 1.0
+    P = max(1, int(pp))
+    M = max(1, int(microbatches))
+    V = max(1, int(interleave))
+    return float(V * M + P - 1) / float(V * M)
 
 
 # Goodput components in reporting order. ``other_s`` is the residual and

@@ -443,8 +443,12 @@ def make_ring_attention(mesh, axis_name: str = "sp", mask_mod: Optional[MaskMod]
 
     fn = partial(ring_attention, axis_name=axis_name, mask_mod=mask_mod,
                  block_q=block_q, block_kv=block_kv)
-    from ..parallel.compat import shard_map
+    # Current API straight off jax when present; the compat shim only
+    # backfills the deprecated experimental path.
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from ..parallel.compat import shard_map as sm
 
-    return shard_map(
+    return sm(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False,
     )

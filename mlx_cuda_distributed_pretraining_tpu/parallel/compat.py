@@ -12,11 +12,33 @@ and nothing references the experimental path outside this file.
 
 from __future__ import annotations
 
+import warnings
 from typing import Optional, Set
 
 import jax
 
 __all__ = ["axis_size", "shard_map"]
+
+# Warn once per process when the deprecated experimental fallback is taken:
+# the legacy path has real limitations (no partial-auto axis_names, and its
+# transpose cannot differentiate a lax.scan nested in the mapped body — see
+# parallel/pipeline.py's unroll workaround), so running on it should be
+# visible in logs without drowning every shard_map construction.
+_warned_legacy = False
+
+
+def _warn_legacy_once() -> None:
+    global _warned_legacy
+    if _warned_legacy:
+        return
+    _warned_legacy = True
+    warnings.warn(
+        "jax.shard_map is unavailable on this jax; falling back to the "
+        "deprecated jax.experimental.shard_map (fully manual, no "
+        "axis_names). Upgrade jax to drop this shim.",
+        DeprecationWarning,
+        stacklevel=3,
+    )
 
 
 def axis_size(axis_name) -> jax.Array:
@@ -46,6 +68,7 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True,
         return current(f, **kwargs)
     from jax.experimental.shard_map import shard_map as legacy
 
+    _warn_legacy_once()
     # axis_names is deliberately NOT translated to legacy ``auto``:
     # partial-auto shard_map on 0.4.x emits a PartitionId instruction the
     # CPU SPMD partitioner rejects. Running fully manual instead is

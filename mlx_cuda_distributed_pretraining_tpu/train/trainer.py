@@ -168,14 +168,14 @@ class Trainer:
 
         # MoE training steps carry routing stats (expert load, dropped
         # selections) out through loss_fn's aux — models/moe.py tap. The
-        # pipeline step builds its own loss and does not thread stats.
+        # pipeline loss threads the same stats through its tick carries
+        # (make_pipeline_loss with_moe_stats), so pp and non-pp runs report
+        # identical routing gauges.
         import inspect as _inspect
 
-        pp_mesh = (self.mesh is not None and "pp" in self.mesh.axis_names
-                   and self.mesh.shape["pp"] > 1)
         self.moe_stats_experts = (
             args.num_local_experts
-            if (args.is_moe and not pp_mesh and hasattr(arch, "loss_fn")
+            if (args.is_moe and hasattr(arch, "loss_fn")
                 and "with_moe_stats" in
                 _inspect.signature(arch.loss_fn).parameters) else 0)
         _stats_kw = {"with_moe_stats": True} if self.moe_stats_experts else {}
@@ -234,6 +234,8 @@ class Trainer:
             and "pp" in self.mesh.axis_names
             and self.mesh.shape["pp"] > 1
         )
+        self.pipeline_interleave = 1
+        self.pipeline_compute_skip = True
         # K train steps per device dispatch (see SystemConfig). Pipeline
         # builds its own step; K>1 is a dense/sharded-step feature.
         self.steps_per_dispatch = max(1, int(
@@ -254,6 +256,10 @@ class Trainer:
             )
 
             pp = self.mesh.shape["pp"]
+            self.pipeline_interleave = max(1, int(
+                getattr(cfg.system, "pipeline_interleave", 1) or 1))
+            self.pipeline_compute_skip = bool(
+                getattr(cfg.system, "pipeline_compute_skip", True))
             self.microbatches = int(cfg.system.pipeline_microbatches or 2 * pp)
             # Pipeline microbatching IS gradient accumulation: fold the
             # configured accum factor in so the effective batch semantics
@@ -269,9 +275,11 @@ class Trainer:
                     f"batch_size {cfg.training.batch_size} must be divisible by "
                     f"pipeline_microbatches {self.microbatches}"
                 )
-            if self.model_args.num_layers % pp != 0:
+            if self.model_args.num_layers % (pp * self.pipeline_interleave) != 0:
                 raise ValueError(
-                    f"num_layers {self.model_args.num_layers} must be divisible by pp={pp}"
+                    f"num_layers {self.model_args.num_layers} must be divisible "
+                    f"by pp*pipeline_interleave="
+                    f"{pp}*{self.pipeline_interleave}"
                 )
             self.train_step, self.state_shardings = make_pipeline_train_step(
                 args, self.optimizer, self.mesh, self.microbatches,
@@ -280,13 +288,19 @@ class Trainer:
                 params_like=self.params,
                 log_grad_norm=cfg.logging.log_gradient_norm,
                 ce_chunk=ce_chunk, z_loss_weight=z_loss_weight,
+                interleave=self.pipeline_interleave,
+                compute_skip=self.pipeline_compute_skip,
+                moe_stats_experts=self.moe_stats_experts,
             )
             self.eval_step = jax.jit(make_pipeline_loss(
                 args, self.mesh, self.microbatches,
                 compute_dtype=self.compute_dtype, include_aux=False,
-                ce_chunk=ce_chunk,
+                ce_chunk=ce_chunk, interleave=self.pipeline_interleave,
+                compute_skip=self.pipeline_compute_skip,
             ))
-            self.state = init_train_state(stack_layers(self.params), self.optimizer)
+            self.state = init_train_state(
+                stack_layers(self.params, interleave=self.pipeline_interleave),
+                self.optimizer)
             self.state = jax.device_put(self.state, self.state_shardings)
         else:
             self.train_step, self.state_shardings = make_train_step(
@@ -386,6 +400,19 @@ class Trainer:
             self._g_moe_entropy = self.metrics.gauge(
                 "moe_balance_entropy",
                 "normalized routing entropy over the last window (1.0 = uniform)")
+        self._g_bubble = None
+        self._bubble_frac = 0.0
+        if self.pipeline:
+            from ..obs.flops import pipeline_bubble_frac
+
+            self._bubble_frac = pipeline_bubble_frac(
+                self.mesh.shape["pp"], self.microbatches,
+                self.pipeline_interleave)
+            self._g_bubble = self.metrics.gauge(
+                "pipeline_bubble_frac",
+                "fraction of pipeline schedule ticks spent in the "
+                "warmup/drain bubble (idle with compute-skip)")
+            self._g_bubble.set(self._bubble_frac)
 
         if resume and for_training:
             self._resume()
@@ -397,7 +424,8 @@ class Trainer:
         if self.pipeline:
             from ..parallel.pipeline import unstack_layers
 
-            return unstack_layers(self.state["params"], self.model_args.num_layers)
+            return unstack_layers(self.state["params"], self.model_args.num_layers,
+                                  interleave=self.pipeline_interleave)
         return self.state["params"]
 
     def _host_opt_state(self):
@@ -406,7 +434,8 @@ class Trainer:
         if self.pipeline:
             from ..parallel.pipeline import unstack_opt_state
 
-            return unstack_opt_state(self.state["opt_state"], self.model_args.num_layers)
+            return unstack_opt_state(self.state["opt_state"], self.model_args.num_layers,
+                                     interleave=self.pipeline_interleave)
         return self.state["opt_state"]
 
     # -- checkpointing ------------------------------------------------------
@@ -559,10 +588,18 @@ class Trainer:
         # The resume source must survive retention GC for the whole run:
         # until the first NEW checkpoint lands it is the only good state.
         self.checkpoints.protect_steps.add(str(tag))
+        # Pipeline + mesh: params reshard straight from disk into the
+        # stacked pp×fsdp placement (load_params_stacked) — no host-side
+        # ``like`` gather of the live state and no device ever holding a
+        # full replica. The optimizer state still takes the host path (its
+        # moment trees are rebuilt leaf-by-leaf against the live structure).
+        pp_direct = self.pipeline and self.mesh is not None
         params, opt_state, tstate = self.checkpoints.load(
-            tag, like_params=self._host_params(),
+            tag,
+            like_params=None if pp_direct else self._host_params(),
             like_opt_state=None if rc.reset_optimizer else self._host_opt_state(),
             strict=bool(rc.strict),
+            with_params=not pp_direct,
         )
         if opt_state is None and not rc.reset_optimizer:
             self.logger.log(
@@ -570,15 +607,26 @@ class Trainer:
                 f"(missing/unreadable) — moment statistics restart from "
                 f"zero; set resume.strict: true to fail instead")
         step = 0 if rc.reset_training_state else int(tstate.get("step", 0))
-        params = jax.tree_util.tree_map(jnp.asarray, params)
+        if pp_direct:
+            model_path, _, _ = self.checkpoints.paths_for_step(tag)
+            params = self.checkpoints.load_params_stacked(
+                model_path, self.mesh, self.model_args.num_layers,
+                interleave=self.pipeline_interleave,
+                like_stacked=self.state["params"])
+        else:
+            params = jax.tree_util.tree_map(jnp.asarray, params)
         if opt_state is not None:
             opt_state = jax.tree_util.tree_map(jnp.asarray, opt_state)
         if self.pipeline:
             from ..parallel.pipeline import stack_layers, stack_opt_state
 
-            params = stack_layers(params)
+            if not pp_direct:
+                params = stack_layers(
+                    params, interleave=self.pipeline_interleave)
             if opt_state is not None:
-                opt_state = stack_opt_state(opt_state, self.model_args.num_layers)
+                opt_state = stack_opt_state(
+                    opt_state, self.model_args.num_layers,
+                    interleave=self.pipeline_interleave)
         self.state = {
             "params": params,
             "opt_state": self.state["opt_state"] if rc.reset_optimizer or opt_state is None
@@ -996,6 +1044,14 @@ class Trainer:
                     }
                     if "grad_norm" in metrics:
                         line["grad_norm"] = float(metrics["grad_norm"])
+                    if self.pipeline:
+                        # Honest schedule accounting: the bubble is a
+                        # property of (pp, M, V), constant across the run,
+                        # but belongs on every window line next to mfu= so
+                        # readers see the idle fraction the MFU number is
+                        # already paying for.
+                        line["bubble"] = round(self._bubble_frac, 4)
+                        self._g_bubble.set(self._bubble_frac)
                     if window_moe:
                         # Routing observability (models/moe.py stats tap):
                         # expert-load fractions over the window, normalized
@@ -1039,11 +1095,14 @@ class Trainer:
                         if secs > 0:
                             self._m_goodput.inc(secs, component=comp)
                     if self.events is not None:
-                        self.events.append(
-                            "step_window", step=step, steps=window_steps,
+                        ev = dict(
+                            step=step, steps=window_steps,
                             toks=int(window_tokens), loss=round(loss, 6),
                             tok_s=round(tok_s, 2), mfu=mfu_val,
                             goodput={k: round(v, 6) for k, v in gp.items()})
+                        if self.pipeline:
+                            ev["bubble"] = round(self._bubble_frac, 6)
+                        self.events.append("step_window", **ev)
                     self._touch_heartbeat(step)
                     window_tokens = 0
                     window_steps = 0

@@ -148,3 +148,47 @@ def test_system_compute_dtype_explicit_key():
     cfg3 = Config.from_dict({"name": "t", "system": {"mixed_precision": True}})
     assert cfg3.system.compute_dtype == "bfloat16"
     assert cfg3.system.fused_ce_chunk == -1
+
+
+def test_pipeline_config_validation():
+    """Invalid pp/interleave/microbatch combinations fail at config load with
+    errors naming the keys, not as reshape tracer errors inside the step."""
+    import pytest
+
+    def mk(**sys_extra):
+        d = {
+            "name": "t",
+            "training": {"hyperparameters": {"batch_size": 32}},
+            "model": {"dimensions": {"num_layers": 16}},
+            "system": {"seed": 0, "device": "cpu", "mesh": {"pp": 4, "dp": 2},
+                       "pipeline_microbatches": 8, **sys_extra},
+        }
+        return Config.from_dict(d)
+
+    cfg = mk(pipeline_interleave=2, pipeline_compute_skip=False)
+    assert cfg.system.pipeline_interleave == 2
+    assert cfg.system.pipeline_compute_skip is False
+    # defaults: interleave 1, compute-skip on
+    assert mk().system.pipeline_interleave == 1
+    assert mk().system.pipeline_compute_skip is True
+
+    with pytest.raises(ValueError, match="batch_size=30 must be divisible"):
+        d = mk().to_dict()
+        d["training"]["hyperparameters"]["batch_size"] = 30
+        Config.from_dict(d)
+    with pytest.raises(ValueError, match=r"num_layers=14 must be divisible"):
+        d = mk(pipeline_interleave=2).to_dict()
+        d["model"]["dimensions"]["num_layers"] = 14
+        Config.from_dict(d)
+    with pytest.raises(ValueError, match="pipeline_microbatches >= mesh.pp"):
+        d = mk(pipeline_interleave=2).to_dict()
+        d["system"]["pipeline_microbatches"] = 2
+        d["training"]["hyperparameters"]["batch_size"] = 4
+        Config.from_dict(d)
+    with pytest.raises(ValueError, match="pipeline_interleave must be >= 1"):
+        mk(pipeline_interleave=0)
+    # pp=1 (or no mesh): the divisibility rules don't apply
+    d = mk().to_dict()
+    d["system"]["mesh"] = {"dp": 2}
+    d["training"]["hyperparameters"]["batch_size"] = 30
+    assert Config.from_dict(d).system.mesh == {"dp": 2}
