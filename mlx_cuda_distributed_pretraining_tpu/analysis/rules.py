@@ -26,6 +26,9 @@ Rule IDs (stable — used in suppressions and the baseline):
 - ``legacy-shard-map-import`` direct ``jax.experimental.shard_map``
                           import anywhere but ``parallel/compat.py`` (the
                           single shim for the ``jax.shard_map`` rename).
+- ``monotonic-clock``     a duration computed by subtracting two
+                          ``time.time()`` readings — wall clocks step
+                          under NTP; use time.monotonic()/perf_counter().
 """
 
 from __future__ import annotations
@@ -745,3 +748,76 @@ class LegacyShardMapImport(Rule):
             f"`{form}` — jax.experimental.shard_map is the deprecated "
             "module path (renamed to jax.shard_map); import shard_map "
             "from parallel/compat.py, the single shim for the rename"))
+
+
+# -- monotonic-clock --------------------------------------------------------
+
+_WALL_CLOCK_CALL = "time.time"
+
+
+@register
+class MonotonicClock(Rule):
+    id = "monotonic-clock"
+    description = (
+        "time.time() is the wall clock: NTP slews and steps it, so a "
+        "duration computed as the difference of two readings can jump "
+        "backwards or gain seconds mid-measurement (the exact failure the "
+        "tracing spans in obs/trace.py exist to keep out of the ledger). "
+        "Use time.monotonic() or time.perf_counter() for intervals; keep "
+        "time.time() for values that must mean calendar time."
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        scopes = [ctx.tree] + [n for n in ast.walk(ctx.tree)
+                               if isinstance(n, (ast.FunctionDef,
+                                                 ast.AsyncFunctionDef))]
+        for scope in scopes:
+            yield from self._check_scope(ctx, scope)
+
+    def _check_scope(self, ctx, scope) -> Iterable[Finding]:
+        fname = getattr(scope, "name", "<module>")
+        # Names bound from a bare time.time() call in this scope. A name
+        # ALSO bound from anything else anywhere in the scope is dropped
+        # (flow-insensitive, so we cannot order the bindings) — errs
+        # toward silence.
+        wall: Set[str] = set()
+        other: Set[str] = set()
+        for n in _walk_skip_defs(scope):
+            targets: list = []
+            if isinstance(n, ast.Assign):
+                targets = n.targets
+            elif isinstance(n, (ast.AnnAssign, ast.NamedExpr)) \
+                    and n.value is not None:
+                targets = [n.target]
+            elif isinstance(n, ast.AugAssign):
+                targets = [n.target]
+            if not targets:
+                continue
+            is_wall = self._is_wall_call(getattr(n, "value", None)) \
+                and not isinstance(n, ast.AugAssign)
+            for t in targets:
+                name = dotted_name(t)
+                if name:
+                    (wall if is_wall else other).add(name)
+        wall -= other
+        for n in _walk_skip_defs(scope):
+            if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Sub) \
+                    and self._is_wall(n.left, wall) \
+                    and self._is_wall(n.right, wall):
+                yield self.finding(ctx, n, (
+                    f"duration computed by subtracting two time.time() "
+                    f"readings in `{fname}` — the wall clock steps under "
+                    "NTP, so the interval can be negative or off by "
+                    "seconds; use time.monotonic() or time.perf_counter() "
+                    "for durations"))
+
+    @staticmethod
+    def _is_wall_call(value: Optional[ast.AST]) -> bool:
+        return isinstance(value, ast.Call) \
+            and dotted_name(value.func) == _WALL_CLOCK_CALL
+
+    def _is_wall(self, node: ast.AST, wall: Set[str]) -> bool:
+        if self._is_wall_call(node):
+            return True
+        name = dotted_name(node)
+        return name is not None and name in wall

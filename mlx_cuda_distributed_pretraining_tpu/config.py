@@ -188,6 +188,11 @@ class LoggingConfig:
     # Prometheus text exposition of the in-process metrics registry
     # (obs/prometheus.py) on this port; 0 disables. Chief process only.
     metrics_port: int = 0
+    # Span tracer (obs/trace.py): {enabled: bool, sample: float,
+    # capacity: int, capture_steps: int}. capture_steps sizes the
+    # SIGUSR2 on-demand window (spans + jax.profiler for the next N
+    # steps without restarting the run).
+    trace: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def logging_interval(self) -> int:

@@ -45,6 +45,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..models import llama
+from ..obs.trace import TRACE_HEADER
 from ..serve.scheduler import QueueFullError
 from .generate import generate_text
 
@@ -119,7 +120,8 @@ class InferenceService:
                  min_p: float = 0.0,
                  repetition_penalty: Optional[float] = None,
                  seed: int = 0,
-                 deadline_s: Optional[float] = None) -> dict:
+                 deadline_s: Optional[float] = None,
+                 trace_id: Optional[str] = None) -> dict:
         # Cap: an unbounded client value would allocate a huge KV cache
         # while holding the lock (XLA OOM can abort the process).
         max_tokens = max(1, min(int(max_tokens), self.max_tokens_limit))
@@ -136,16 +138,20 @@ class InferenceService:
         if self.engine is not None and not reshapes:
             out = self.engine.generate(prompt, max_tokens=max_tokens,
                                        temperature=q_temp, seed=seed,
-                                       deadline_s=deadline_s)
+                                       deadline_s=deadline_s,
+                                       trace_id=trace_id)
             stats_keys = ("generation_tokens", "generation_tps",
                           "mean_logprob", "prompt_tokens",
                           "stopped_on_token", "ttft_ms",
-                          "prefix_cached_tokens")
+                          "prefix_cached_tokens",
+                          "queue_ms", "prefill_ms", "decode_ms")
             return {
                 "text": out["text"],
                 "tokens": int(out["tokens"]),
                 "engine": "batch",
                 "finish_reason": out.get("finish_reason"),
+                **({"trace_id": out["trace_id"]}
+                   if out.get("trace_id") else {}),
                 "effective_params": {
                     "temperature": q_temp, "top_p": q_top_p,
                     "min_p": q_min_p, "repetition_penalty": q_rep,
@@ -183,7 +189,8 @@ class InferenceService:
                       min_p: float = 0.0,
                       repetition_penalty: Optional[float] = None,
                       seed: int = 0,
-                      deadline_s: Optional[float] = None):
+                      deadline_s: Optional[float] = None,
+                      trace_id: Optional[str] = None):
         """Submit through the batch engine for token-by-token streaming;
         None when the request must take the locked path instead (no
         engine, or logit-reshaping knobs) — the caller then buffers."""
@@ -198,7 +205,7 @@ class InferenceService:
         return self.engine.submit(prompt, max_tokens=max_tokens,
                                   temperature=self._quantize(temperature),
                                   seed=seed, deadline_s=deadline_s,
-                                  stream=True)
+                                  stream=True, trace_id=trace_id)
 
     def health(self) -> dict:
         d = {
@@ -223,6 +230,13 @@ class InferenceService:
         if self.engine is not None:
             return self.engine.metrics()
         return {"engine": "locked"}
+
+    def trace(self, clear: bool = False) -> dict:
+        """Chrome trace dump of the engine's span ring (GET /trace)."""
+        if self.engine is not None:
+            return self.engine.tracer.chrome_trace(clear=clear)
+        return {"traceEvents": [], "displayTimeUnit": "ms",
+                "metadata": {"service": "locked"}}
 
 
 def _to_openai_completion(out: dict, req: dict, run_name: str,
@@ -292,7 +306,8 @@ def make_handler(service: InferenceService):
 
         def _stream_generate(self, req: dict, prompt: str,
                              effective_max: int,
-                             deadline_s: Optional[float]) -> None:
+                             deadline_s: Optional[float],
+                             trace_id: Optional[str] = None) -> None:
             """SSE response: token events as the engine emits them, then
             the final result. Submission errors (429/400) raise BEFORE
             any header is written, so do_POST's handlers still apply."""
@@ -303,7 +318,8 @@ def make_handler(service: InferenceService):
                       min_p=float(req.get("min_p", 0.0)),
                       repetition_penalty=(float(rp) if rp is not None
                                           else None),
-                      seed=int(req.get("seed", 0)), deadline_s=deadline_s)
+                      seed=int(req.get("seed", 0)), deadline_s=deadline_s,
+                      trace_id=trace_id)
             sreq = service.submit_stream(prompt, **kw)
             if sreq is None:
                 # Locked / logit-reshaping fallback: compute fully (any
@@ -334,11 +350,18 @@ def make_handler(service: InferenceService):
                 self._sse({"done": True, **(sreq.result or {})})
 
         def do_GET(self):
-            path = self.path.rstrip("/")
+            import urllib.parse
+
+            parts = urllib.parse.urlsplit(self.path)
+            path = parts.path.rstrip("/")
             if path in ("", "/healthz"):
                 self._reply(200, service.health())
             elif path == "/metrics":
                 self._reply(200, service.metrics())
+            elif path == "/trace":
+                # On-demand chrome-trace dump (?clear=1 drains the ring).
+                clear = "clear" in urllib.parse.parse_qs(parts.query)
+                self._reply(200, service.trace(clear=clear))
             elif path == "/v1/models":
                 # OpenAI clients list models before completing against one.
                 self._reply(200, {
@@ -377,10 +400,13 @@ def make_handler(service: InferenceService):
                     1, min(int(req.get("max_tokens", 64)),
                            service.max_tokens_limit))
                 dl = req.get("deadline_s")
+                # Router-minted (or client-supplied) trace id: the engine
+                # keys this request's spans by it.
+                trace_id = self.headers.get(TRACE_HEADER)
                 if req.get("stream"):
                     self._stream_generate(req, prompt, effective_max,
                                           float(dl) if dl is not None
-                                          else None)
+                                          else None, trace_id=trace_id)
                     return
                 out = service.generate(
                     prompt=prompt,
@@ -391,6 +417,7 @@ def make_handler(service: InferenceService):
                     repetition_penalty=float(rp) if rp is not None else None,
                     seed=int(req.get("seed", 0)),
                     deadline_s=float(dl) if dl is not None else None,
+                    trace_id=trace_id,
                 )
                 if path == "/v1/completions":
                     out = _to_openai_completion(
@@ -516,6 +543,12 @@ def main(argv=None) -> int:
                         "adopting at admission")
     p.add_argument("--deadline-s", type=float, default=None,
                    help="batch engine: default per-request deadline")
+    p.add_argument("--trace", action="store_true",
+                   help="batch engine: record per-request spans "
+                        "(queue_wait/prefill/decode; dump via GET /trace)")
+    p.add_argument("--trace-sample", type=float, default=1.0,
+                   help="fraction of requests traced (deterministic by "
+                        "trace id)")
     p.add_argument("--stats-url", default=None,
                    help="batch engine: ws:// URL of the obs stats server "
                         "for per-iteration serving metrics")
@@ -552,6 +585,7 @@ def main(argv=None) -> int:
             prefix_cache=not a.no_prefix_cache,
             prefix_min_hit_blocks=a.prefix_min_hit_blocks,
             default_deadline_s=a.deadline_s, stats_url=a.stats_url,
+            trace=a.trace, trace_sample=a.trace_sample,
             mesh=parse_mesh_spec(a.mesh) if a.mesh else None), mesh=mesh)
     httpd = ThreadingHTTPServer((a.host, a.port), make_handler(service))
     print(f"serving {a.run} ({service.n_params / 1e6:.1f}M params, "

@@ -3,7 +3,7 @@ from .plotting import ema, parse_log, plot_run, write_csv
 from .monitor import LogTailer, find_latest_run, monitor
 from .stats_client import StatsClient
 from .stats_server import StatsServer, StatsState
-from .metrics import MetricsRegistry
+from .metrics import LATENCY_MS_BUCKETS, MetricsRegistry, quantile_from_buckets
 from .flops import (
     GoodputLedger,
     flops_per_token,
@@ -13,6 +13,7 @@ from .flops import (
 )
 from .events import EventLog, append_event, iter_events, replay_into
 from .prometheus import render_prometheus, start_metrics_server
+from .trace import TRACE_HEADER, Span, Tracer, merge_chrome_traces, new_trace_id
 
 __all__ = [
     "Logger",
@@ -27,6 +28,8 @@ __all__ = [
     "StatsServer",
     "StatsState",
     "MetricsRegistry",
+    "LATENCY_MS_BUCKETS",
+    "quantile_from_buckets",
     "GoodputLedger",
     "flops_per_token",
     "model_flops_per_token",
@@ -38,4 +41,9 @@ __all__ = [
     "replay_into",
     "render_prometheus",
     "start_metrics_server",
+    "TRACE_HEADER",
+    "Span",
+    "Tracer",
+    "merge_chrome_traces",
+    "new_trace_id",
 ]

@@ -106,7 +106,7 @@ DASHBOARD_HTML = """<!DOCTYPE html>
   <div class="panel">
     <h2>Workers</h2>
     <table id="workers"><thead><tr>
-      <th></th><th>worker</th><th>step</th><th>loss</th><th>tok/s</th><th>mfu</th><th>moe ent</th><th>cache hit</th><th>mesh</th><th>last seen</th>
+      <th></th><th>worker</th><th>step</th><th>loss</th><th>tok/s</th><th>mfu</th><th>moe ent</th><th>cache hit</th><th>ttft p50/p95</th><th>mesh</th><th>last seen</th>
     </tr></thead><tbody></tbody></table>
   </div>
 </div>
@@ -320,6 +320,11 @@ function renderWorkers(workers, agg) {
       // prompt tokens served from cached KV blocks; training rows "–").
       "<td>" + (typeof m.prefix_cache_hit_rate === "number" ?
         (100 * m.prefix_cache_hit_rate).toFixed(1) + "%" : "–") + "</td>" +
+      // Serving workers only: TTFT histogram quantiles (ms). p95 needs
+      // its own key; older engines publish only the p50-backed ttft_ms.
+      "<td>" + (typeof m.ttft_ms_p50 === "number" ?
+        m.ttft_ms_p50.toFixed(0) + (typeof m.ttft_ms_p95 === "number" ?
+          " / " + m.ttft_ms_p95.toFixed(0) : "") : "–") + "</td>" +
       // Serving workers only: mesh shape ("tp=2" / "1dev"; training "–").
       "<td>" + (typeof m.mesh === "string" ? m.mesh : "–") + "</td>" +
       '<td style="color:var(' + (alive ? "--status-good" : "--status-critical") +

@@ -36,6 +36,37 @@ DEFAULT_BUCKETS = (
     0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0, 300.0,
 )
 
+# Millisecond-scale buckets for request-latency histograms (TTFT and its
+# components). DEFAULT_BUCKETS is seconds-scale and would collapse every
+# sub-second TTFT into two buckets.
+LATENCY_MS_BUCKETS = (
+    1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0, 30000.0,
+)
+
+
+def quantile_from_buckets(rows: List[List[Any]], count: int,
+                          q: float) -> Optional[float]:
+    """Estimate the ``q``-quantile from cumulative histogram buckets.
+
+    ``rows`` is the snapshot shape ``[[le, cumulative_count], ...]`` with a
+    trailing ``["+Inf", total]`` row.  Returns the upper bound of the first
+    bucket whose cumulative count reaches rank ``q * count`` (the standard
+    Prometheus-style estimate, biased high by at most one bucket width);
+    None when the series is empty.  The +Inf bucket reports the largest
+    finite bound so the answer stays plottable.
+    """
+    if count <= 0 or not rows:
+        return None
+    rank = q * count
+    last_finite = None
+    for le, cum in rows:
+        if le != "+Inf":
+            last_finite = float(le)
+            if cum >= rank:
+                return float(le)
+    return last_finite
+
 
 def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))

@@ -91,6 +91,12 @@ class LogTailer:
             parts.append(f"moe_ent={self.latest['moe_entropy']:.3f}")
         if "moe_drop" in self.latest:
             parts.append(f"moe_drop={int(self.latest['moe_drop'])}")
+        # TTFT quantiles (only present when tailing a serving worker's
+        # log — training lines simply lack the keys).
+        if "ttft_ms_p50" in self.latest:
+            t95 = (f"/{self.latest['ttft_ms_p95']:.0f}"
+                   if "ttft_ms_p95" in self.latest else "")
+            parts.append(f"ttft_ms={self.latest['ttft_ms_p50']:.0f}{t95}")
         if self.val_losses:
             parts.append(f"val_loss={self.val_losses[-1]:.4f}@{self.val_steps[-1]}")
         return " | ".join(parts)

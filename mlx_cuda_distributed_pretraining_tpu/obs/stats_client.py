@@ -105,19 +105,19 @@ class StatsClient:
                 await asyncio.sleep(self.reconnect_delay)
 
     async def _pump(self, ws) -> None:
-        last_beat = time.time()
+        last_beat = time.monotonic()
         loop = asyncio.get_running_loop()
         while not self._stop.is_set():
-            timeout = max(0.1, self.heartbeat_interval - (time.time() - last_beat))
+            timeout = max(0.1, self.heartbeat_interval - (time.monotonic() - last_beat))
             try:
                 item = await loop.run_in_executor(None, self._outbox.get, True, timeout)
             except queue.Empty:
                 item = "__beat__"
             if item is None:
                 return
-            if item == "__beat__" or time.time() - last_beat >= self.heartbeat_interval:
+            if item == "__beat__" or time.monotonic() - last_beat >= self.heartbeat_interval:
                 await ws.send(json.dumps({"type": "heartbeat", "worker_id": self.worker_id}))
-                last_beat = time.time()
+                last_beat = time.monotonic()
             if item != "__beat__":
                 try:
                     await ws.send(item)

@@ -60,6 +60,9 @@ class EngineConfig:
     prefix_cache: bool = True   # paged: content-hash block reuse (off = oracle)
     prefix_min_hit_blocks: int = 1  # shortest cached chain worth adopting
     default_deadline_s: Optional[float] = None  # per-request unless overridden
+    trace: bool = False         # span tracer (obs/trace.py); /trace dumps it
+    trace_sample: float = 1.0   # fraction of requests traced (by trace id)
+    trace_capacity: int = 16384  # span ring-buffer bound (oldest dropped)
     stats_url: Optional[str] = None  # ws://host:port of obs stats server
     stats_interval_s: float = 1.0
     worker_id: str = "serve-engine"
@@ -81,6 +84,14 @@ class EngineConfig:
             serve["prefix_cache"] = bool(pc.get("enabled", True))
             if "min_hit_blocks" in pc:
                 serve["prefix_min_hit_blocks"] = int(pc["min_hit_blocks"])
+        # Nested trace block: trace: {enabled: true, sample: 0.1, capacity: N}
+        tr = serve.get("trace")
+        if isinstance(tr, dict):
+            serve["trace"] = bool(tr.get("enabled", True))
+            if "sample" in tr:
+                serve["trace_sample"] = float(tr["sample"])
+            if "capacity" in tr:
+                serve["trace_capacity"] = int(tr["capacity"])
         # serving: {mesh: {tp: 2}} — the yaml home of the serving mesh
         # (configs/serve-sample.yaml); serve.mesh also accepted. String
         # specs ("tp=2,dp=1") parse like the --mesh CLI flag.
@@ -149,11 +160,20 @@ class BatchEngine:
         self._win_t0 = time.monotonic()
         self._win_tokens = 0
         self._last_publish = 0.0
-        self._last_ttft_ms: Optional[float] = None
         self._metrics: Dict[str, Any] = {}
+        # Per-request span tracer (obs/trace.py). Disabled is the default
+        # and free: span() hands back a shared null span, and every call
+        # site additionally guards on `.enabled` so the hot path allocates
+        # nothing.
+        from ..obs.trace import Tracer
+
+        self.tracer = Tracer(self.cfg.worker_id,
+                             capacity=self.cfg.trace_capacity,
+                             sample=self.cfg.trace_sample,
+                             enabled=self.cfg.trace)
         # Shared metrics substrate (obs/metrics.py): same registry shape as
         # the trainer, so one Prometheus scrape config covers both roles.
-        from ..obs.metrics import MetricsRegistry
+        from ..obs.metrics import LATENCY_MS_BUCKETS, MetricsRegistry
 
         self.metrics_registry = MetricsRegistry()
         reg = self.metrics_registry
@@ -165,6 +185,16 @@ class BatchEngine:
             "serve_requests_total", "requests by outcome")
         self._mc_iterations = reg.counter(
             "serve_iterations_total", "engine loop iterations")
+        # TTFT as a real distribution (the old last-value gauge reported
+        # whichever request finished last); components let dashboards
+        # split queue wait from prefill from decode without a trace file.
+        self._mh_ttft = reg.histogram(
+            "serve_ttft_ms", "time to first token (ms)",
+            buckets=LATENCY_MS_BUCKETS)
+        self._mh_ttft_component = reg.histogram(
+            "serve_ttft_component_ms",
+            "per-request latency by component (ms)",
+            buckets=LATENCY_MS_BUCKETS)
         # Paged-pool + speculative-decode observability (gauges read 0 on
         # the slotted backend; the /metrics surface is backend-stable).
         self._mg_blocks_used = reg.gauge(
@@ -281,20 +311,24 @@ class BatchEngine:
     def submit(self, prompt: str, max_tokens: int = 64,
                temperature: float = 0.0, seed: int = 0,
                deadline_s: Optional[float] = None,
-               stream: bool = False) -> Request:
+               stream: bool = False,
+               trace_id: Optional[str] = None) -> Request:
         """Tokenize and enqueue; raises QueueFullError (-> 429) past the
         queue bound, ValueError when the request can never fit a slot.
         With ``stream=True`` the request carries a ``stream_q`` the engine
         pushes each sampled token id into (None = end of stream) — the
-        HTTP layer drains it into an SSE response."""
+        HTTP layer drains it into an SSE response. ``trace_id`` joins this
+        request's spans to an upstream trace (router X-Trace-Id); one is
+        minted when absent so responses always carry an id."""
         ids = [self.tokenizer.bos_id] + self.tokenizer.tokenize(prompt)
         return self._submit_ids(ids, max_tokens, temperature, seed,
-                                deadline_s, stream=stream)
+                                deadline_s, stream=stream, trace_id=trace_id)
 
     def _submit_ids(self, ids: List[int], max_tokens: int,
                     temperature: float, seed: int,
                     deadline_s: Optional[float] = None,
-                    stream: bool = False) -> Request:
+                    stream: bool = False,
+                    trace_id: Optional[str] = None) -> Request:
         import jax
 
         P = len(ids)
@@ -315,6 +349,9 @@ class BatchEngine:
                       stop_ids=[self.tokenizer.eos_id])
         if stream:
             req.stream_q = queue.Queue()
+        from ..obs.trace import new_trace_id
+
+        req.trace_id = trace_id or new_trace_id()
         req.rng_key = np.asarray(jax.random.PRNGKey(seed))
         self.scheduler.submit(req)
         self._wake.set()
@@ -323,9 +360,11 @@ class BatchEngine:
     def generate(self, prompt: str, max_tokens: int = 64,
                  temperature: float = 0.0, seed: int = 0,
                  deadline_s: Optional[float] = None,
-                 timeout: float = 600.0) -> dict:
+                 timeout: float = 600.0,
+                 trace_id: Optional[str] = None) -> dict:
         """Blocking convenience used by the HTTP front end."""
-        req = self.submit(prompt, max_tokens, temperature, seed, deadline_s)
+        req = self.submit(prompt, max_tokens, temperature, seed, deadline_s,
+                          trace_id=trace_id)
         if not req.wait(timeout):
             req.deadline = 0.0  # force eviction next iteration
             self._wake.set()
@@ -372,6 +411,22 @@ class BatchEngine:
         snap.update(self._metrics)
         return snap
 
+    def _ttft_quantiles(self) -> Dict[str, float]:
+        """p50/p95/p99 TTFT estimated from the bounded histogram."""
+        from ..obs.metrics import quantile_from_buckets
+
+        snap = self.metrics_registry.snapshot().get("serve_ttft_ms")
+        if not snap or not snap["series"]:
+            return {}
+        s = snap["series"][0]
+        out: Dict[str, float] = {}
+        for key, q in (("ttft_ms_p50", 0.5), ("ttft_ms_p95", 0.95),
+                       ("ttft_ms_p99", 0.99)):
+            v = quantile_from_buckets(s["buckets"], s["count"], q)
+            if v is not None:
+                out[key] = round(v, 1)
+        return out
+
     def _publish(self) -> None:
         now = time.monotonic()
         if now - self._last_publish < self.cfg.stats_interval_s:
@@ -381,8 +436,12 @@ class BatchEngine:
         self._win_t0, self._win_tokens = now, 0
         self._last_publish = now
         self._metrics = {"tok/s": round(tok_s, 2)}
-        if self._last_ttft_ms is not None:
-            self._metrics["ttft_ms"] = round(self._last_ttft_ms, 1)
+        q = self._ttft_quantiles()
+        if q:
+            self._metrics.update(q)
+            # Back-compat key older dashboards read (was a last-value
+            # gauge; a median is strictly more honest).
+            self._metrics["ttft_ms"] = q["ttft_ms_p50"]
         # Registry mirror: gauges live, scheduler totals as counter deltas
         # (the scheduler keeps monotonic ints; Prometheus counters must
         # only ever be incremented).
@@ -458,7 +517,21 @@ class BatchEngine:
         sched, pool = self.scheduler, self.pool
         for r in sched.expire(pool):
             self._resolve_evicted(r)
-        sched.admit(pool)
+        admitted = sched.admit(pool)
+        if admitted and self.tracer.enabled:
+            for r in admitted:
+                # queue_wait closes at slot binding; kv_alloc and any
+                # prefix-cache adoption happened inside admit().
+                self.tracer.complete(
+                    "queue_wait", r.admitted_at - r.submitted_at,
+                    trace_id=r.trace_id, end_mono=r.admitted_at, req=r.id)
+                self.tracer.instant(
+                    "kv_alloc", trace_id=r.trace_id, slot=r.slot,
+                    prompt_tokens=len(r.prompt_ids))
+                if r.cached_tokens:
+                    self.tracer.instant(
+                        "prefix_adopt", trace_id=r.trace_id,
+                        cached_tokens=r.cached_tokens)
         busy = False
         pre = sched.prefilling()
         if pre:
@@ -495,6 +568,8 @@ class BatchEngine:
             self.pool.register_upto(req.slot, req.prefill_source())
 
     def _prefill_chunk(self, req: Request) -> None:
+        tr = self.tracer
+        t0 = time.perf_counter() if tr.enabled else 0.0
         pool, C = self.pool, self.chunk
         source = req.prefill_source()
         P = len(source)
@@ -521,6 +596,10 @@ class BatchEngine:
         req.prefilled = start + n
         pool.lengths[req.slot] = min(start + n, P)
         self._register_prefix(req)
+        if tr.enabled:
+            tr.complete("prefill_chunk", time.perf_counter() - t0,
+                        trace_id=req.trace_id, req=req.id, start=start,
+                        tokens=n, final=final)
         if not final:
             return
         pool.lengths[req.slot] = P
@@ -529,8 +608,31 @@ class BatchEngine:
         req.rng_key = np.asarray(key)
         if req.first_token_at is None:  # unset on preemption re-prefill
             req.first_token_at = time.monotonic()
-            self._last_ttft_ms = (req.first_token_at - req.submitted_at) * 1e3
         self._emit(req, tok, lp)
+
+    # Decode spans aggregate this many batched steps per request — one
+    # span per token would swamp the ring at decode rates.
+    DECODE_SPAN_TICKS = 8
+
+    def _open_decode_spans(self, dec: List[Request]) -> None:
+        now = time.perf_counter()
+        for r in dec:
+            if r._decode_t0 is None:
+                r._decode_t0 = now
+
+    def _tick_decode_spans(self, dec: List[Request]) -> None:
+        for r in dec:
+            r._decode_ticks += 1
+            if r._decode_ticks >= self.DECODE_SPAN_TICKS and r.state != DONE:
+                self._flush_decode_span(r)
+
+    def _flush_decode_span(self, req: Request) -> None:
+        if req._decode_t0 is not None and self.tracer.enabled:
+            self.tracer.complete(
+                "decode", time.perf_counter() - req._decode_t0,
+                trace_id=req.trace_id, req=req.id, ticks=req._decode_ticks)
+        req._decode_t0 = None
+        req._decode_ticks = 0
 
     def _decode(self, dec: List[Request]) -> None:
         if self.pool.kind == "paged":
@@ -544,6 +646,8 @@ class BatchEngine:
         pos = np.full(B, pool.max_len - 1, np.int32)
         temps = np.zeros(B, np.float32)
         keys = np.zeros((B, 2), np.uint32)
+        if self.tracer.enabled:
+            self._open_decode_spans(dec)
         for r in dec:
             tokens[r.slot] = r.last_token
             pos[r.slot] = pool.lengths[r.slot]
@@ -561,6 +665,8 @@ class BatchEngine:
             pool.lengths[r.slot] += 1
             r.rng_key = keys_h[r.slot]
             self._emit(r, int(tok_h[r.slot]), float(lp_h[r.slot]))
+        if self.tracer.enabled:
+            self._tick_decode_spans(dec)
 
     def _grow_or_preempt(self, dec: List[Request], S: int) -> List[Request]:
         """Map the blocks each decoding row's next verify window needs.
@@ -591,6 +697,8 @@ class BatchEngine:
         dec = self._grow_or_preempt(dec, S)
         if not dec:
             return
+        if self.tracer.enabled:
+            self._open_decode_spans(dec)
         B = pool.num_slots
         # Masked rows: token 0 at position 0 — their (freed) table rows map
         # every entry to the shared junk block, so their writes land there.
@@ -655,6 +763,8 @@ class BatchEngine:
                 # overwrites it (no rollback copies).
                 pool.lengths[s] = p0 + len(emitted)
                 self._register_prefix(r)
+        if self.tracer.enabled:
+            self._tick_decode_spans(dec)
 
     def _emit(self, req: Request, tok: int, lp: float) -> None:
         """Account one sampled token: stop/length bookkeeping mirrors
@@ -667,6 +777,9 @@ class BatchEngine:
         req.last_token = tok
         if req.stream_q is not None:
             req.stream_q.put(tok)
+            if self.tracer.enabled:
+                self.tracer.instant("stream_emit", trace_id=req.trace_id,
+                                    req=req.id, n=len(req.tokens))
         self._win_tokens += 1
         if len(req.tokens) >= req.max_tokens:
             self._finish(req, "length")
@@ -679,6 +792,26 @@ class BatchEngine:
         dt = max(done - req.submitted_at, 1e-9)
         ttft_ms = ((req.first_token_at - req.submitted_at) * 1e3
                    if req.first_token_at else None)
+        # Component breakdown: queue (submit->slot), prefill (slot->first
+        # token), decode (first token->done). Histograms record regardless
+        # of tracing so /metrics carries the distribution on its own.
+        comp: Dict[str, float] = {}
+        if req.admitted_at is not None:
+            comp["queue_ms"] = (req.admitted_at - req.submitted_at) * 1e3
+            if req.first_token_at is not None:
+                comp["prefill_ms"] = (req.first_token_at
+                                      - req.admitted_at) * 1e3
+                comp["decode_ms"] = (done - req.first_token_at) * 1e3
+        if ttft_ms is not None:
+            self._mh_ttft.observe(ttft_ms)
+        for k, v in comp.items():
+            self._mh_ttft_component.observe(v, component=k[:-3])
+        if self.tracer.enabled:
+            self._flush_decode_span(req)
+            self.tracer.complete("request", done - req.submitted_at,
+                                 trace_id=req.trace_id, end_mono=done,
+                                 req=req.id, reason=reason,
+                                 tokens=len(req.tokens))
         req.resolve(result={
             "text": self.tokenizer.detokenize(req.tokens),
             "tokens": len(req.tokens),
@@ -691,5 +824,7 @@ class BatchEngine:
             "prompt_tokens": float(len(req.prompt_ids)),
             "prefix_cached_tokens": float(req.cached_tokens),
             "stopped_on_token": float(reason == "stop"),
+            "trace_id": req.trace_id,
             **({"ttft_ms": round(ttft_ms, 1)} if ttft_ms is not None else {}),
+            **{k: round(v, 2) for k, v in comp.items()},
         })

@@ -41,10 +41,12 @@ import json
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
+from ..obs.trace import TRACE_HEADER, Tracer, new_trace_id
 from .prefix_cache import chain_keys
 
 __all__ = ["Router", "Replica", "serve_router"]
@@ -102,7 +104,9 @@ class Router:
                  block_size: int = 32,
                  vnodes: int = 64, spill_depth: int = 8,
                  poll_interval_s: float = 0.5, retries: int = 1,
-                 request_timeout_s: float = 600.0):
+                 request_timeout_s: float = 600.0,
+                 trace: bool = False, trace_sample: float = 1.0,
+                 trace_capacity: int = 16384):
         if not replica_urls:
             raise ValueError("router needs at least one replica URL")
         if affinity not in ("prefix", "none"):
@@ -120,6 +124,10 @@ class Router:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._poller: Optional[threading.Thread] = None
+        # Router-side spans ("route": full proxy time per request, keyed
+        # by the trace id the router mints and forwards via X-Trace-Id).
+        self.tracer = Tracer("router", capacity=trace_capacity,
+                             sample=trace_sample, enabled=trace)
         from ..obs.metrics import MetricsRegistry
 
         self.metrics_registry = MetricsRegistry()
@@ -218,16 +226,20 @@ class Router:
             return order
 
     # -- dispatch ------------------------------------------------------------
-    def dispatch(self, path: str, body: dict):
+    def dispatch(self, path: str, body: dict,
+                 trace_id: Optional[str] = None):
         """Forward ``body`` to the best replica; returns the open HTTP
         response (caller reads/streams it) plus the replica. Connection
         failures mark the replica down and replay on the next candidate
         (idempotent: sampling is seeded); replica 429s propagate after
-        every candidate rejected."""
+        every candidate rejected. ``trace_id`` (minted here when absent)
+        rides the X-Trace-Id header so replica spans join this trace."""
         key = self.routing_key(body)
         cands = self.candidates(key)
         if not cands:
             raise NoReplicaError("no live replica")
+        if trace_id is None:
+            trace_id = new_trace_id()
         data = json.dumps(body).encode()
         tried = 0
         saturated: Optional[urllib.error.HTTPError] = None
@@ -237,7 +249,8 @@ class Router:
             tried += 1
             req = urllib.request.Request(
                 r.url + path, data=data,
-                headers={"Content-Type": "application/json"})
+                headers={"Content-Type": "application/json",
+                         TRACE_HEADER: trace_id})
             try:
                 resp = urllib.request.urlopen(
                     req, timeout=self.request_timeout_s)
@@ -305,7 +318,8 @@ def make_router_handler(router: Router):
             self.wfile.write(body)
 
         def do_GET(self):
-            path = self.path.rstrip("/")
+            parts = urllib.parse.urlsplit(self.path)
+            path = parts.path.rstrip("/")
             if path in ("", "/healthz"):
                 h = router.health()
                 self._reply(200 if h["replicas_up"] else 503, h)
@@ -315,6 +329,10 @@ def make_router_handler(router: Router):
                     "replicas": {r.id: r.snapshot()
                                  for r in router.replicas.values()},
                 })
+            elif path == "/trace":
+                # On-demand chrome-trace dump (?clear=1 drains the ring).
+                clear = "clear" in urllib.parse.parse_qs(parts.query)
+                self._reply(200, router.tracer.chrome_trace(clear=clear))
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -331,8 +349,13 @@ def make_router_handler(router: Router):
             except (ValueError, json.JSONDecodeError) as e:
                 self._reply(400, {"error": str(e)})
                 return
+            # Honor a client-supplied trace id, else mint one; the replica
+            # sees it via X-Trace-Id and the client gets it echoed back.
+            trace_id = self.headers.get(TRACE_HEADER) or new_trace_id()
+            t0 = time.perf_counter()
             try:
-                resp, replica = router.dispatch(path, body)
+                resp, replica = router.dispatch(path, body,
+                                                trace_id=trace_id)
             except BackpressureError as e:
                 self._reply(429, {"error": str(e)},
                             headers={"Retry-After": str(e.retry_after_s)})
@@ -351,12 +374,16 @@ def make_router_handler(router: Router):
                 return
             replica.inflight += 1
             try:
-                self._pipe(resp, replica)
+                self._pipe(resp, replica, trace_id)
             finally:
                 replica.inflight -= 1
                 resp.close()
+                if router.tracer.enabled:
+                    router.tracer.complete(
+                        "route", time.perf_counter() - t0,
+                        trace_id=trace_id, replica=replica.id, path=path)
 
-        def _pipe(self, resp, replica) -> None:
+        def _pipe(self, resp, replica, trace_id=None) -> None:
             """Forward the replica response verbatim — one buffered body
             for JSON, unbuffered chunks for SSE streams."""
             ctype = resp.headers.get("Content-Type", "application/json")
@@ -365,6 +392,8 @@ def make_router_handler(router: Router):
             self.send_header("Content-Type", ctype)
             if clen is not None:
                 self.send_header("Content-Length", clen)
+            if trace_id is not None:
+                self.send_header(TRACE_HEADER, trace_id)
             self.end_headers()
             try:
                 if clen is not None:
@@ -424,11 +453,18 @@ def main(argv=None) -> int:
     p.add_argument("--retries", type=int, default=1,
                    help="replays on another replica after a connection "
                         "failure (requests are idempotent: seeded sampling)")
+    p.add_argument("--trace", action="store_true",
+                   help="record route spans (dump via GET /trace; merge "
+                        "with replica traces via scripts/trace_report.py)")
+    p.add_argument("--trace-sample", type=float, default=1.0,
+                   help="fraction of requests traced (deterministic by "
+                        "trace id, so router and replicas agree)")
     a = p.parse_args(argv)
     router = Router([u for u in a.replicas.split(",") if u],
                     affinity=a.affinity, block_size=a.block_size,
                     spill_depth=a.spill_depth,
-                    poll_interval_s=a.poll_interval, retries=a.retries)
+                    poll_interval_s=a.poll_interval, retries=a.retries,
+                    trace=a.trace, trace_sample=a.trace_sample)
     httpd = serve_router(router, a.host, a.port)
     print(f"router over {len(router.replicas)} replicas "
           f"on http://{a.host}:{httpd.server_address[1]}")

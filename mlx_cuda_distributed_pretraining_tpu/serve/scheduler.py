@@ -68,6 +68,11 @@ class Request:
         self.cached_tokens = 0      # prompt tokens adopted from the prefix cache
         self.stream_q: Optional[Any] = None  # queue.Queue when streaming (SSE)
         self.first_token_at: Optional[float] = None  # TTFT marker
+        self.trace_id: Optional[str] = None  # propagated via X-Trace-Id
+        self.admitted_at: Optional[float] = None  # slot bound (queue_wait end)
+        # Decode spans are aggregated per-N-ticks (engine-owned bucket).
+        self._decode_t0: Optional[float] = None
+        self._decode_ticks = 0
         self.finish_reason: Optional[str] = None
         self.error: Optional[str] = None
         self.result: Optional[dict] = None
@@ -150,6 +155,7 @@ class Scheduler:
                 # instead of position 0 (0 on non-caching pools).
                 req.prefilled = pool.lengths[slot]
                 req.cached_tokens = max(req.cached_tokens, req.prefilled)
+                req.admitted_at = time.monotonic()
                 self.running[slot] = req
                 self.admitted += 1
                 out.append(req)

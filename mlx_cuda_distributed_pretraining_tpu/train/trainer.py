@@ -47,6 +47,7 @@ from ..obs.events import (
 from ..obs.flops import GoodputLedger, model_flops_per_token, peak_flops_per_chip
 from ..obs.flops import mfu as compute_mfu
 from ..obs.metrics import MetricsRegistry
+from ..obs.trace import Tracer
 from ..optim import build_optimizer, build_schedule, schedule_value
 from ..parallel import build_mesh
 from ..tokenizer import TokenizerManager
@@ -357,6 +358,22 @@ class Trainer:
             cfg.model, self.n_params, cfg.data.max_context_size)
         self.peak_flops = peak_flops_per_chip()
         self.goodput = GoodputLedger()
+        # Span tracer (obs/trace.py): mirrors every goodput booking as a
+        # chrome-trace span carrying the SAME duration, so per-window span
+        # sums reconcile with the ledger by construction. Off by default;
+        # logging.trace.enabled turns it on for the whole run, SIGUSR2
+        # opens an on-demand capture window mid-run.
+        tcfg = dict(cfg.logging.trace or {})
+        self.tracer = Tracer(
+            f"trainer-p{jax.process_index()}",
+            capacity=int(tcfg.get("capacity", 65536)),
+            sample=float(tcfg.get("sample", 1.0)),
+            enabled=bool(tcfg.get("enabled", False)))
+        self._trace_capture_steps = int(tcfg.get("capture_steps", 20))
+        self._trace_request = 0   # bumped by SIGUSR2
+        self._trace_until = 0     # on-demand window end step (exclusive)
+        self._trace_owns_prof = False
+        self._trace_prev_enabled = self.tracer.enabled
         self._compiled = False  # first dispatch books into compile_s
         self._metrics_server = None
         # events.jsonl is the durable telemetry source: replay it FIRST so
@@ -459,11 +476,18 @@ class Trainer:
             self._save_checkpoint_inner(step, blocking)
         dt = time.perf_counter() - t0
         self.goodput.add("ckpt_save_s", dt)
+        self._trace_phase("ckpt_save", dt, step=str(step))
         self._m_saves.inc()
         if self.events is not None:
             self.events.append("checkpoint_save", step=step,
                                seconds=round(dt, 4), blocking=bool(blocking))
         self._touch_heartbeat()
+
+    def _trace_phase(self, name: str, dur_s: float, **args) -> None:
+        """Record one goodput-phase span (same duration the ledger got).
+        A no-op method call when tracing is off — nothing allocated."""
+        if self.tracer.enabled:
+            self.tracer.complete(name, dur_s, **args)
 
     def _touch_heartbeat(self, step: Optional[int] = None) -> None:
         if self._hb_path is None:
@@ -663,6 +687,7 @@ class Trainer:
             result = self._validate_inner(cap)
         dt = time.perf_counter() - t0
         self.goodput.add("eval_s", dt)
+        self._trace_phase("eval", dt)
         if result is not None:
             self._m_evals.inc()
             if self.events is not None:
@@ -890,6 +915,11 @@ class Trainer:
 
             _signal.signal(signum, prev_handlers.get(signum, _signal.SIG_DFL))
 
+        def _on_trace_signal(signum, frame):
+            # On-demand capture trigger: `kill -USR2 <pid>` records spans
+            # + a jax.profiler trace for the next capture_steps steps.
+            self._trace_request += 1
+
         try:
             import signal as _signal
 
@@ -898,6 +928,10 @@ class Trainer:
                 # code; None is not restorable — map it to SIG_DFL.
                 prev = _signal.signal(sig, _on_signal)
                 prev_handlers[sig] = prev if prev is not None else _signal.SIG_DFL
+            if hasattr(_signal, "SIGUSR2"):
+                prev = _signal.signal(_signal.SIGUSR2, _on_trace_signal)
+                prev_handlers[_signal.SIGUSR2] = (
+                    prev if prev is not None else _signal.SIG_DFL)
         except (ValueError, OSError):  # non-main thread: no signal hooks
             prev_handlers = {}
 
@@ -931,6 +965,49 @@ class Trainer:
                         self.logger.log(f"profiler: trace started at step {step}")
                         if self.events is not None:
                             self.events.append("profiler", action="start", step=step)
+                # On-demand capture window (SIGUSR2): both edges gate on
+                # group boundaries (`not pending`) so a scan-dispatched
+                # group never straddles the window.
+                if self._trace_until and step >= self._trace_until \
+                        and not pending:
+                    self._trace_until = 0
+                    if self._trace_owns_prof and prof_active:
+                        import jax.profiler as _prof
+
+                        jax.block_until_ready(self.state["step"])
+                        _prof.stop_trace()
+                        prof_active = False
+                        self._trace_owns_prof = False
+                    out = os.path.join(self.run_dir, f"trace_step{step}.json")
+                    self.tracer.export(out)
+                    self.tracer.enabled = self._trace_prev_enabled
+                    self.logger.log(f"trace capture: spans written to {out}")
+                    if self.events is not None:
+                        self.events.append("trace_capture", action="stop",
+                                           step=step, path=out)
+                if self._trace_request and not self._trace_until \
+                        and not pending:
+                    self._trace_request = 0
+                    self._trace_until = step + max(1, self._trace_capture_steps)
+                    self._trace_prev_enabled = self.tracer.enabled
+                    self.tracer.enabled = True
+                    if not prof_active:
+                        import jax.profiler as _prof
+
+                        try:
+                            _prof.start_trace(
+                                os.path.join(self.run_dir, "profile"))
+                            prof_active = True
+                            self._trace_owns_prof = True
+                        except Exception as e:  # noqa: BLE001 - capture is best-effort
+                            self.logger.log(
+                                f"trace capture: profiler unavailable ({e})")
+                    self.logger.log(
+                        f"trace capture: recording steps "
+                        f"[{step}, {self._trace_until})")
+                    if self.events is not None:
+                        self.events.append("trace_capture", action="start",
+                                           step=step, until=self._trace_until)
                 if self.steps_per_dispatch > 1:
                     if not pending:
                         try:
@@ -943,8 +1020,12 @@ class Trainer:
                                 f"Data stream exhausted before step {step}; stopping")
                             break
                         self.goodput.add("data_wait_s", waits["data_wait_s"])
+                        self._trace_phase("data_wait", waits["data_wait_s"],
+                                          step=step)
                         if self.prefetcher.h2d_blocks_consumer:
                             self.goodput.add("h2d_wait_s", waits["h2d_wait_s"])
+                            self._trace_phase("h2d_wait", waits["h2d_wait_s"],
+                                              step=step)
                         t_dispatch = time.perf_counter()
                         # StepTraceAnnotation: profiler traces carry the
                         # trainer's step numbering, lining up with
@@ -958,11 +1039,13 @@ class Trainer:
                             # state dispatch_s stays meaningful.
                             self._compiled = True
                             self.goodput.add("compile_s", t_d)
+                            self._trace_phase("compile", t_d, step=step)
                             if self.events is not None:
                                 self.events.append("compile", seconds=round(t_d, 4),
                                                    step=step)
                         else:
                             self.goodput.add("dispatch_s", t_d)
+                            self._trace_phase("dispatch", t_d, step=step)
                         pending = [
                             (jax.tree_util.tree_map(lambda a, i=i: a[i], mm),
                              t * jax.process_count())
@@ -984,8 +1067,12 @@ class Trainer:
                     window_tokens += step_tokens
                     self.total_tokens += step_tokens
                     self.goodput.add("data_wait_s", waits["data_wait_s"])
+                    self._trace_phase("data_wait", waits["data_wait_s"],
+                                      step=step)
                     if self.prefetcher.h2d_blocks_consumer:
                         self.goodput.add("h2d_wait_s", waits["h2d_wait_s"])
+                        self._trace_phase("h2d_wait", waits["h2d_wait_s"],
+                                          step=step)
                     t_dispatch = time.perf_counter()
                     with jax.profiler.StepTraceAnnotation("train", step_num=step):
                         self.state, metrics = self.train_step(self.state, batch)
@@ -993,11 +1080,13 @@ class Trainer:
                     if not self._compiled:
                         self._compiled = True
                         self.goodput.add("compile_s", t_d)
+                        self._trace_phase("compile", t_d, step=step)
                         if self.events is not None:
                             self.events.append("compile", seconds=round(t_d, 4),
                                                step=step)
                     else:
                         self.goodput.add("dispatch_s", t_d)
+                        self._trace_phase("dispatch", t_d, step=step)
 
                 window_steps += 1
                 if self.moe_stats_experts and "moe_load" in metrics:
@@ -1103,6 +1192,11 @@ class Trainer:
                         if self.pipeline:
                             ev["bubble"] = round(self._bubble_frac, 6)
                         self.events.append("step_window", **ev)
+                    if self.tracer.enabled:
+                        self.tracer.instant(
+                            "step_window", step=step, tok_s=round(tok_s, 2),
+                            mfu=(mfu_val if mfu_val is not None
+                                 else "unknown"))
                     self._touch_heartbeat(step)
                     window_tokens = 0
                     window_steps = 0
@@ -1173,6 +1267,16 @@ class Trainer:
 
                 jax.block_until_ready(self.state["step"])
                 _prof.stop_trace()
+            # Persist spans (run-long tracing, or an on-demand window cut
+            # short by run end) next to the run's logs.
+            if self.tracer.enabled and self.tracer.stats()["recorded"]:
+                try:
+                    idx = jax.process_index()
+                    self.tracer.export(os.path.join(
+                        self.run_dir,
+                        "trace.json" if idx == 0 else f"trace_p{idx}.json"))
+                except OSError as e:
+                    self.logger.log(f"trace export failed: {e}")
             if prev_handlers:
                 import signal as _signal
 

@@ -24,16 +24,29 @@ server the summary splits TTFT p50/p95 by cache hit vs miss (the server
 reports ``prefix_cached_tokens`` per request) and adds the aggregate
 ``cache_hit_rate``; against the router (serve/router.py) each group is
 consistently hashed to one replica, so hits land where the blocks live.
+
+Per-request tracing (``--trace-out FILE``): writes one CSV row per
+request with the server-minted trace id and the server-side TTFT
+breakdown (queue_ms / prefill_ms / decode_ms) that the batch engine
+attaches to every response. Join the ``trace_id`` column against the
+chrome traces dumped by the router's and replicas' ``/trace``
+endpoints (scripts/trace_report.py does the merge) to see where each
+slow request actually spent its time.
 """
 
 from __future__ import annotations
 
 import argparse
+import csv
 import json
 import threading
 import time
 import urllib.error
 import urllib.request
+
+TRACE_FIELDS = ("trace_id", "status", "latency_s", "ttft_ms", "queue_ms",
+                "prefill_ms", "decode_ms", "tokens", "prompt_tokens",
+                "cached_tokens")
 
 
 def _one_request(url: str, body: dict, timeout: float) -> dict:
@@ -52,15 +65,22 @@ def _one_request(url: str, body: dict, timeout: float) -> dict:
                     "ttft_s": ttft / 1e3 if ttft is not None else None,
                     "prompt_tokens": float(out.get("prompt_tokens", 0.0)),
                     "cached_tokens": float(
-                        out.get("prefix_cached_tokens", 0.0))}
+                        out.get("prefix_cached_tokens", 0.0)),
+                    "trace_id": out.get("trace_id"),
+                    "queue_ms": out.get("queue_ms"),
+                    "prefill_ms": out.get("prefill_ms"),
+                    "decode_ms": out.get("decode_ms")}
     except urllib.error.HTTPError as e:
         return {"status": e.code, "latency_s": time.monotonic() - t0,
                 "tokens": 0, "ttft_s": None, "prompt_tokens": 0.0,
-                "cached_tokens": 0.0}
+                "cached_tokens": 0.0, "trace_id": None, "queue_ms": None,
+                "prefill_ms": None, "decode_ms": None}
     except Exception as e:  # noqa: BLE001 - count it, keep loading
         return {"status": f"error:{type(e).__name__}",
                 "latency_s": time.monotonic() - t0, "tokens": 0,
-                "ttft_s": None, "prompt_tokens": 0.0, "cached_tokens": 0.0}
+                "ttft_s": None, "prompt_tokens": 0.0, "cached_tokens": 0.0,
+                "trace_id": None, "queue_ms": None, "prefill_ms": None,
+                "decode_ms": None}
 
 
 def group_prefix(group: int, tokens: int) -> str:
@@ -74,7 +94,7 @@ def group_prefix(group: int, tokens: int) -> str:
 def run_load(url: str, concurrency: int, requests: int, prompt: str,
              max_tokens: int, temperature: float, deadline_s: float | None,
              timeout: float, shared_prefix_tokens: int = 0,
-             prefix_groups: int = 1) -> dict:
+             prefix_groups: int = 1, trace_out: str | None = None) -> dict:
     results: list = []
     lock = threading.Lock()
     counter = iter(range(requests))
@@ -162,6 +182,27 @@ def run_load(url: str, concurrency: int, requests: int, prompt: str,
             "ttft_miss_p50_s": pct(miss_t, 0.50),
             "ttft_miss_p95_s": pct(miss_t, 0.95),
         })
+    if trace_out:
+        # One row per request, in completion order. ttft_ms mirrors the
+        # server value; queue/prefill/decode are the server's own
+        # monotonic-stamp breakdown, so the columns sum to ~latency
+        # minus network + client overhead.
+        with open(trace_out, "w", newline="") as fh:
+            w = csv.DictWriter(fh, fieldnames=TRACE_FIELDS,
+                               extrasaction="ignore")
+            w.writeheader()
+            for r in results:
+                row = dict(r)
+                row["latency_s"] = round(r["latency_s"], 4)
+                row["ttft_ms"] = (round(r["ttft_s"] * 1e3, 2)
+                                  if r["ttft_s"] is not None else "")
+                for k in ("trace_id", "queue_ms", "prefill_ms", "decode_ms"):
+                    if row.get(k) is None:
+                        row[k] = ""
+                w.writerow(row)
+        summary["trace_out"] = trace_out
+        summary["traced_requests"] = sum(
+            1 for r in results if r.get("trace_id"))
     try:
         with urllib.request.urlopen(url.rstrip("/") + "/metrics",
                                     timeout=10) as resp:
@@ -190,11 +231,14 @@ def main(argv=None) -> int:
     p.add_argument("--prefix-groups", type=int, default=1,
                    help="number of distinct shared prefixes the requests "
                         "rotate through")
+    p.add_argument("--trace-out", default=None, metavar="FILE",
+                   help="write a per-request CSV (trace_id + server-side "
+                        "queue/prefill/decode breakdown) to FILE")
     a = p.parse_args(argv)
     summary = run_load(a.url, a.concurrency, a.requests, a.prompt,
                        a.max_tokens, a.temperature, a.deadline_s, a.timeout,
                        shared_prefix_tokens=a.shared_prefix_tokens,
-                       prefix_groups=a.prefix_groups)
+                       prefix_groups=a.prefix_groups, trace_out=a.trace_out)
     print(json.dumps(summary))
     return 0
 

@@ -1,0 +1,265 @@
+#!/usr/bin/env python
+"""Merge chrome-trace dumps into per-request span trees and reports.
+
+Input: one or more chrome trace-event JSON files as produced by the
+tracing ring buffers (obs/trace.py) — the router's ``GET /trace``, each
+replica's ``GET /trace``, and the trainer's ``trace_step<N>.json`` /
+``trace.json`` exports. Spans from different processes share a
+wall-clock timeline and are joined by the ``trace_id`` each span
+carries in its args (minted by the router, propagated via the
+``X-Trace-Id`` header), so a single request's ``route`` span on the
+router nests the ``queue_wait`` / ``prefill_chunk`` / ``decode`` spans
+recorded on whichever replica served it:
+
+    python scripts/trace_report.py router_trace.json \
+        replica0_trace.json replica1_trace.json --top 3
+
+Prints, in ``key=value`` form:
+  * an accounting line — how many requests completed, and how many
+    ``route`` spans never matched a replica-side ``request`` span
+    (anything non-zero there means a replica dropped its ring or died);
+  * per-component TTFT breakdown percentiles (queue_wait, prefill,
+    decode, route overhead) across all completed requests;
+  * the top-k slowest requests, each with its indented span tree;
+  * trainer step-time attribution — per-phase totals from the goodput
+    ledger's span mirrors (data_wait / h2d_wait / dispatch / ckpt_save
+    / eval / compile) next to the MFU the ``step_window`` instants
+    reported — when a trainer trace file is among the inputs.
+
+Stdlib-only: runs on dumped JSON anywhere, no repo install needed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Any, Dict, List, Optional
+
+# Trainer phase span names (obs/trace.py complete() mirrors of the
+# goodput ledger components, minus the "_s" suffix).
+TRAIN_PHASES = ("compile", "data_wait", "h2d_wait", "dispatch",
+                "ckpt_save", "eval")
+# Request-path component span names emitted by serve/engine.py +
+# serve/router.py.
+REQUEST_COMPONENTS = ("queue_wait", "prefill_chunk", "decode")
+# Wall-clock slack (µs) tolerated when nesting spans from different
+# processes: their timelines share one wall anchor but not one clock.
+EPS_US = 500.0
+
+
+def load_trace(path: str) -> Dict[str, Any]:
+    with open(path) as fh:
+        doc = json.load(fh)
+    if isinstance(doc, list):  # bare event-array form is also legal
+        doc = {"traceEvents": doc, "metadata": {}}
+    return doc
+
+
+def service_of(doc: Dict[str, Any], fallback: str) -> str:
+    svc = (doc.get("metadata") or {}).get("service")
+    if svc:
+        return str(svc)
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            return str((ev.get("args") or {}).get("name", fallback))
+    return fallback
+
+
+def collect(paths: List[str]):
+    """Flatten files into (spans, instants, per-file stats)."""
+    spans: List[Dict[str, Any]] = []
+    instants: List[Dict[str, Any]] = []
+    stats: List[Dict[str, Any]] = []
+    for path in paths:
+        doc = load_trace(path)
+        svc = service_of(doc, path)
+        meta = doc.get("metadata") or {}
+        stats.append({"file": path, "service": svc,
+                      "dropped": int(meta.get("dropped", 0)),
+                      "events": len(doc.get("traceEvents", []))})
+        for ev in doc.get("traceEvents", []):
+            if ev.get("ph") == "X":
+                spans.append({"name": ev.get("name", "?"),
+                              "ts": float(ev.get("ts", 0.0)),
+                              "dur": float(ev.get("dur", 0.0)),
+                              "service": svc,
+                              "args": ev.get("args") or {}})
+            elif ev.get("ph") == "i":
+                instants.append({"name": ev.get("name", "?"),
+                                 "ts": float(ev.get("ts", 0.0)),
+                                 "service": svc,
+                                 "args": ev.get("args") or {}})
+    return spans, instants, stats
+
+
+def by_trace_id(events: List[Dict[str, Any]]) -> Dict[str, list]:
+    groups: Dict[str, list] = {}
+    for ev in events:
+        tid = ev["args"].get("trace_id")
+        if tid:
+            groups.setdefault(str(tid), []).append(ev)
+    return groups
+
+
+def build_tree(spans: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Nest one request's spans by time containment (stack walk over
+    spans sorted by start, longest-first on ties). Returns roots; each
+    node gains a ``children`` list."""
+    order = sorted(spans, key=lambda s: (s["ts"], -s["dur"]))
+    roots: List[Dict[str, Any]] = []
+    stack: List[Dict[str, Any]] = []
+    for s in order:
+        s = dict(s, children=[])
+        while stack and stack[-1]["ts"] + stack[-1]["dur"] + EPS_US < \
+                s["ts"] + s["dur"]:
+            stack.pop()
+        if stack:
+            stack[-1]["children"].append(s)
+        else:
+            roots.append(s)
+        stack.append(s)
+    return roots
+
+
+def render_tree(node: Dict[str, Any], t0: float, depth: int = 0) -> List[str]:
+    extra = " ".join(
+        f"{k}={v}" for k, v in sorted(node["args"].items())
+        if k != "trace_id" and isinstance(v, (int, float, str)))
+    line = ("  " * (depth + 1)
+            + f"span={node['name']} service={node['service']} "
+            + f"start_ms={round((node['ts'] - t0) / 1e3, 2)} "
+            + f"dur_ms={round(node['dur'] / 1e3, 2)}"
+            + (f" {extra}" if extra else ""))
+    out = [line]
+    for c in node["children"]:
+        out.extend(render_tree(c, t0, depth + 1))
+    return out
+
+
+def pct(vals: List[float], p: float, digits: int = 2) -> Optional[float]:
+    if not vals:
+        return None
+    vals = sorted(vals)
+    return round(vals[min(len(vals) - 1, int(p * len(vals)))], digits)
+
+
+def _fmt(v) -> str:
+    return "unknown" if v is None else str(v)
+
+
+def request_report(spans, top: int) -> List[str]:
+    groups = by_trace_id(spans)
+    # A request is "complete" when the replica recorded its terminal
+    # `request` span; `route` spans with no matching request span mean
+    # the replica side was lost (ring overwrite, crash, still running).
+    complete: Dict[str, Dict[str, Any]] = {}
+    routed_only = 0
+    for tid, evs in groups.items():
+        req = [e for e in evs if e["name"] == "request"]
+        route = [e for e in evs if e["name"] == "route"]
+        if req:
+            complete[tid] = {"evs": evs, "req": req[0],
+                             "route": route[0] if route else None}
+        elif route:
+            routed_only += 1
+    lines = [f"requests_complete={len(complete)} "
+             f"route_unmatched={routed_only} "
+             f"trace_ids_seen={len(groups)}"]
+
+    comp_ms: Dict[str, List[float]] = {}
+    totals: List[tuple] = []
+    for tid, g in complete.items():
+        per = {}
+        for e in g["evs"]:
+            if e["name"] in REQUEST_COMPONENTS:
+                key = ("prefill" if e["name"] == "prefill_chunk"
+                       else e["name"])
+                per[key] = per.get(key, 0.0) + e["dur"] / 1e3
+        if g["route"] is not None:
+            # Router-side time not booked on the replica: network,
+            # header shuffling, stream piping.
+            per["route_overhead"] = max(
+                0.0, (g["route"]["dur"] - g["req"]["dur"]) / 1e3)
+        for k, v in per.items():
+            comp_ms.setdefault(k, []).append(v)
+        ttft = per.get("queue_wait", 0.0) + per.get("prefill", 0.0)
+        comp_ms.setdefault("ttft", []).append(ttft)
+        totals.append((g["req"]["dur"] / 1e3, tid, g))
+    for name in ("ttft", "queue_wait", "prefill", "decode",
+                 "route_overhead"):
+        vals = comp_ms.get(name, [])
+        if not vals:
+            continue
+        lines.append(f"component={name} count={len(vals)} "
+                     f"p50_ms={_fmt(pct(vals, 0.50))} "
+                     f"p95_ms={_fmt(pct(vals, 0.95))} "
+                     f"max_ms={_fmt(round(max(vals), 2))}")
+
+    totals.sort(reverse=True)
+    for rank, (dur_ms, tid, g) in enumerate(totals[:max(top, 0)], 1):
+        root_evs = g["evs"]
+        lines.append(f"slow_rank={rank} trace_id={tid} "
+                     f"total_ms={round(dur_ms, 2)} "
+                     f"replica={g['req']['service']}")
+        t0 = min(e["ts"] for e in root_evs)
+        for root in build_tree(root_evs):
+            lines.extend(render_tree(root, t0))
+    return lines
+
+
+def trainer_report(spans, instants) -> List[str]:
+    phase_s: Dict[str, float] = {}
+    t_min, t_max = None, None
+    for s in spans:
+        if s["name"] in TRAIN_PHASES:
+            phase_s[s["name"]] = phase_s.get(s["name"], 0.0) + s["dur"] / 1e6
+            lo, hi = s["ts"], s["ts"] + s["dur"]
+            t_min = lo if t_min is None else min(t_min, lo)
+            t_max = hi if t_max is None else max(t_max, hi)
+    if not phase_s:
+        return []
+    wall = (t_max - t_min) / 1e6 if t_max is not None else 0.0
+    wins = [i for i in instants if i["name"] == "step_window"]
+    mfus = [float(i["args"]["mfu"]) for i in wins
+            if isinstance(i["args"].get("mfu"), (int, float))]
+    booked = sum(phase_s.values())
+    lines = ["trainer_attribution=1 "
+             f"windows={len(wins)} "
+             f"mfu_mean={_fmt(round(sum(mfus) / len(mfus), 4) if mfus else None)} "
+             f"booked_s={round(booked, 3)} "
+             f"span_wall_s={round(wall, 3)}"]
+    for name in TRAIN_PHASES:
+        if name not in phase_s:
+            continue
+        lines.append(
+            f"phase={name} total_s={round(phase_s[name], 3)} "
+            f"share={round(phase_s[name] / booked, 4) if booked else 0.0}")
+    return lines
+
+
+def report(paths: List[str], top: int = 5) -> List[str]:
+    spans, instants, stats = collect(paths)
+    lines = []
+    for st in stats:
+        lines.append(f"trace_file={st['file']} service={st['service']} "
+                     f"events={st['events']} dropped={st['dropped']}")
+    lines.extend(request_report(spans, top))
+    lines.extend(trainer_report(spans, instants))
+    return lines
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("traces", nargs="+",
+                   help="chrome trace JSON files (/trace dumps, trainer "
+                        "trace_step*.json)")
+    p.add_argument("--top", type=int, default=5,
+                   help="how many slowest requests to print as span trees")
+    a = p.parse_args(argv)
+    for line in report(a.traces, top=a.top):
+        print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

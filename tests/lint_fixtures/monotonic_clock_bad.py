@@ -1,0 +1,15 @@
+"""BAD fixture: monotonic-clock."""
+import time
+
+
+def timed_work(job):
+    t0 = time.time()
+    job()
+    return time.time() - t0  # line 8: wall-clock duration
+
+
+def wall_pair(job):
+    start = time.time()
+    job()
+    end = time.time()
+    return end - start  # line 15: both operands are wall readings

@@ -1,0 +1,19 @@
+"""GOOD fixture: monotonic-clock — durations come from the monotonic
+clock; time.time() appears only as a calendar timestamp (never
+subtracted from another wall reading)."""
+import time
+
+
+def timed_work(job, log):
+    t0 = time.monotonic()
+    stamp = time.time()  # wall timestamp for the log line, fine
+    log(stamp)
+    job()
+    return time.monotonic() - t0
+
+
+def rebound_name(job):
+    t = time.time()
+    t = time.monotonic()  # also bound from a non-wall read: rule disarms
+    job()
+    return time.monotonic() - t

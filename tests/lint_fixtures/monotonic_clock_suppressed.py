@@ -1,0 +1,9 @@
+"""SUPPRESSED fixture: monotonic-clock acknowledged inline (the elapsed
+value is deliberately in calendar time, NTP steps and all)."""
+import time
+
+
+def wall_elapsed(job):
+    t0 = time.time()
+    job()
+    return time.time() - t0  # graftlint: disable=monotonic-clock

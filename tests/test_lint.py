@@ -39,6 +39,7 @@ EXPECTED_RULE_IDS = {
     "jit-in-loop",
     "time-in-jit",
     "legacy-shard-map-import",
+    "monotonic-clock",
 }
 
 
@@ -95,6 +96,7 @@ def test_baseline_entries_all_still_match():
     ("jit_in_loop_bad.py", "jit-in-loop", [7]),
     ("time_in_jit_bad.py", "time-in-jit", [9, 11, 12]),
     ("legacy_shard_map_bad.py", "legacy-shard-map-import", [2, 3, 4]),
+    ("monotonic_clock_bad.py", "monotonic-clock", [8, 15]),
 ])
 def test_bad_fixture_fires_at_exact_lines(fixture, rule, lines):
     active, _ = _hits(fixture)
@@ -112,6 +114,7 @@ def test_bad_fixture_fires_at_exact_lines(fixture, rule, lines):
     "jit_in_loop_good.py",
     "time_in_jit_good.py",
     "legacy_shard_map_good.py",
+    "monotonic_clock_good.py",
 ])
 def test_good_fixture_is_clean(fixture):
     active, suppressed = _hits(fixture)
@@ -128,6 +131,7 @@ def test_good_fixture_is_clean(fixture):
     ("jit_in_loop_suppressed.py", "jit-in-loop", 8),
     ("time_in_jit_suppressed.py", "time-in-jit", 8),
     ("legacy_shard_map_suppressed.py", "legacy-shard-map-import", 3),
+    ("monotonic_clock_suppressed.py", "monotonic-clock", 9),
 ])
 def test_suppression_silences_but_counts(fixture, rule, line):
     active, suppressed = _hits(fixture)
