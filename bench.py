@@ -2000,6 +2000,50 @@ def _lint_gate() -> None:
     sys.exit(1)
 
 
+def _audit_gate() -> None:
+    """graftaudit companion to the lint gate: AOT-lower the sample
+    config's train/serve/decode programs and refuse to bench a tree with
+    unbaselined donation gaps, collective-budget regressions, or fp32
+    creep — those inflate HBM or comm and the benched number would
+    measure the regression. Runs in a subprocess because the audit pins
+    JAX to CPU with 8 virtual devices, which must not leak into this
+    process's (possibly real-device) backend. Shares BENCH_LINT=0 as the
+    escape hatch."""
+    if os.environ.get("BENCH_LINT") == "0":
+        return
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m",
+             "mlx_cuda_distributed_pretraining_tpu.analysis.audit",
+             "--config", "configs/model-config-sample.yaml"],
+            capture_output=True, text=True, cwd=repo, timeout=900,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    except Exception as e:  # noqa: BLE001 - an audit bug must not brick benching
+        log(f"[bench] graftaudit gate errored ({e}); continuing without it")
+        return
+    if proc.returncode == 0:
+        return
+    if proc.returncode != 1:
+        # 2 = bad invocation / missing config; crash tracebacks land here
+        # too. Infrastructure problems don't gate the bench.
+        log(f"[bench] graftaudit gate broken (exit {proc.returncode}); "
+            f"continuing without it: {(proc.stderr or '')[-300:]}")
+        return
+    for line in (proc.stdout or "").splitlines()[:20]:
+        log(f"[bench] graftaudit: {line}")
+    for line in (proc.stderr or "").splitlines()[-5:]:
+        log(f"[bench] graftaudit: {line}")
+    print(json.dumps({
+        "error": "graftaudit found compiled-program regressions — fix, "
+                 "suppress, or baseline them first (BENCH_LINT=0 to force)",
+        "value": 0,
+    }), flush=True)
+    sys.exit(1)
+
+
 def main() -> None:
     global _VOCAB, _DEVICE
     _VOCAB = vocab = int(os.environ.get("BENCH_VOCAB", "32768"))
@@ -2053,6 +2097,7 @@ if __name__ == "__main__":
         probe_child()
     else:
         _lint_gate()  # before the atexit hook: a refusal must emit no doc
+        _audit_gate()
         atexit.register(emit, "atexit")
         signal.signal(signal.SIGTERM, _on_signal)
         signal.signal(signal.SIGINT, _on_signal)

@@ -1,4 +1,4 @@
-"""graftlint: JAX-aware static analysis for this repo's jit-heavy code.
+"""graftlint + graftaudit: static analysis for this repo's jit-heavy code.
 
 The TPU silent killers — jit recompile storms, reused PRNG keys,
 host↔device syncs inside hot loops, use-after-donate — leave no
@@ -8,19 +8,31 @@ backend init), a per-line suppression syntax, and a committed baseline
 for grandfathered findings so the tier-1 gate only ever fails on NEW
 hazards.
 
-    python -m mlx_cuda_distributed_pretraining_tpu.analysis.lint [paths]
+graftaudit applies the same gate one level down: it AOT-lowers the real
+train/serve/decode steps under abstract inputs (CPU-safe, no device
+execution) and audits what XLA actually compiles — buffer donation,
+collective counts/bytes against a committed per-config budget, fp32
+matmuls under a bf16 config, closed-over constants, replicated params
+that the sharding rules say should be sharded.
 
-See ``rules.py`` for the rule catalogue and README "graftlint" for the
-workflow (suppressing, baselining, regenerating the baseline).
+    python -m mlx_cuda_distributed_pretraining_tpu.analysis.lint [paths]
+    python -m mlx_cuda_distributed_pretraining_tpu.analysis.audit \
+        --config configs/model-config-sample.yaml
+
+See ``rules.py``/``audit_rules.py`` for the rule catalogues and README
+"graftlint" for the workflow (suppressing, baselining, budgets).
 """
 
 from .core import (  # noqa: F401
     Finding,
     LintResult,
     all_rules,
+    classify_findings,
     default_baseline_path,
     lint_file,
     load_baseline,
+    result_to_json,
     run_lint,
     write_baseline,
+    write_baseline_entries,
 )

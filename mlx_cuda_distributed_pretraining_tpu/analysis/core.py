@@ -277,6 +277,51 @@ def build_jit_index(tree: ast.Module) -> JitIndex:
 
 # -- module context ---------------------------------------------------------
 
+def decorated_header_spans(tree: ast.Module) -> Dict[int, Tuple[int, int]]:
+    """line -> (start, end) for every line inside the *header* of a
+    decorated def/class: from the first decorator line through the last
+    signature line (the line before the body starts). A suppression
+    comment anywhere in that span covers findings attributed to any line
+    of it — decorators and the ``def`` line are one statement, so a
+    ``# graftlint: disable=...`` on the ``def`` line must also cover a
+    finding the rule pinned to the decorator above it."""
+    spans: Dict[int, Tuple[int, int]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)) \
+                and node.decorator_list and node.body:
+            start = min(d.lineno for d in node.decorator_list)
+            end = node.body[0].lineno - 1
+            for ln in range(start, end + 1):
+                spans.setdefault(ln, (start, end))
+    return spans
+
+
+def suppressed_rules_at(lines: Sequence[str],
+                        header_spans: Dict[int, Tuple[int, int]],
+                        line: int) -> Optional[set]:
+    """Rule ids suppressed for a finding at ``line`` (None when none):
+    the line's own comment, plus — when the line sits in a decorated
+    statement's header — comments on every other line of that header."""
+    def line_tags(ln: int) -> Optional[set]:
+        if 1 <= ln <= len(lines):
+            m = _SUPPRESS_RE.search(lines[ln - 1])
+            if m:
+                return {r.strip() for r in m.group(1).split(",") if r.strip()}
+        return None
+
+    tags = line_tags(line)
+    span = header_spans.get(line)
+    if span is not None:
+        for ln in range(span[0], span[1] + 1):
+            if ln == line:
+                continue
+            extra = line_tags(ln)
+            if extra:
+                tags = (tags or set()) | extra
+    return tags
+
+
 @dataclass
 class ModuleContext:
     path: str          # normalized (package-relative when possible)
@@ -284,13 +329,10 @@ class ModuleContext:
     tree: ast.Module
     lines: List[str]
     jit_index: JitIndex
+    header_spans: Dict[int, Tuple[int, int]] = field(default_factory=dict)
 
     def suppressed_rules(self, line: int) -> Optional[set]:
-        if 1 <= line <= len(self.lines):
-            m = _SUPPRESS_RE.search(self.lines[line - 1])
-            if m:
-                return {r.strip() for r in m.group(1).split(",") if r.strip()}
-        return None
+        return suppressed_rules_at(self.lines, self.header_spans, line)
 
 
 def normalize_path(path: str) -> str:
@@ -338,7 +380,8 @@ def load_baseline(path: Optional[str]) -> List[Dict[str, Any]]:
 
 
 def write_baseline(path: str, findings: Sequence[Finding],
-                   old_entries: Sequence[Dict[str, Any]] = ()) -> None:
+                   old_entries: Sequence[Dict[str, Any]] = (),
+                   tool: str = "graftlint") -> None:
     """Regenerate the baseline from the current findings, preserving the
     reason of any entry that still matches. New entries get a placeholder
     reason the gate test rejects — a human must justify each one."""
@@ -352,8 +395,18 @@ def write_baseline(path: str, findings: Sequence[Finding],
             or "grandfathered by --write-baseline — REPLACE with a one-line justification",
         })
     with open(path, "w") as fh:
-        json.dump({"version": 1, "tool": "graftlint", "findings": entries},
+        json.dump({"version": 1, "tool": tool, "findings": entries},
                   fh, indent=2)
+        fh.write("\n")
+
+
+def write_baseline_entries(path: str, entries: Sequence[Dict[str, Any]],
+                           tool: str = "graftlint") -> None:
+    """Write pre-built baseline entries verbatim (used by --prune-stale,
+    which must keep surviving entries byte-identical, reasons included)."""
+    with open(path, "w") as fh:
+        json.dump({"version": 1, "tool": tool,
+                   "findings": list(entries)}, fh, indent=2)
         fh.write("\n")
 
 
@@ -366,6 +419,60 @@ class LintResult:
     baselined: List[Finding]           # matched a baseline entry
     new: List[Finding]                 # what the gate fails on
     stale_baseline: List[Dict[str, Any]]  # baseline entries nothing matched
+
+
+def classify_findings(findings: Sequence[Finding],
+                      baseline: Optional[Sequence[Dict[str, Any]]]
+                      ) -> Tuple[List[Finding], List[Finding],
+                                 List[Dict[str, Any]]]:
+    """Multiset-match findings against the baseline: N identical entries
+    excuse at most N identical findings. Returns (baselined, new, stale);
+    stale entries matched nothing — the finding they excused was fixed.
+    Shared by graftlint (source findings) and graftaudit (lowered-program
+    findings): both gate the same way."""
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for e in baseline or ():
+        k = (e.get("rule"), e.get("path"), e.get("message"))
+        budget[k] = budget.get(k, 0) + 1
+    baselined: List[Finding] = []
+    new: List[Finding] = []
+    for f in findings:
+        if budget.get(f.key(), 0) > 0:
+            budget[f.key()] -= 1
+            baselined.append(f)
+        else:
+            new.append(f)
+    stale: List[Dict[str, Any]] = []
+    leftover = dict(budget)
+    for e in baseline or ():
+        k = (e.get("rule"), e.get("path"), e.get("message"))
+        if leftover.get(k, 0) > 0:
+            leftover[k] -= 1
+            stale.append(dict(e))
+    return baselined, new, stale
+
+
+def result_to_json(tool: str, result: LintResult) -> Dict[str, Any]:
+    """The stable machine-readable document both CLIs emit under
+    ``--format json`` and bench.py's gate consumes. Top-level keys
+    ``new``/``baselined``/``suppressed``/``stale_baseline`` are kept for
+    existing consumers; ``findings`` is the flat per-finding schema
+    (rule, path, line, col, message, baselined, suppressed)."""
+    def flat(f: Finding, *, baselined: bool = False,
+             suppressed: bool = False) -> Dict[str, Any]:
+        return {**f.to_dict(), "baselined": baselined,
+                "suppressed": suppressed}
+
+    return {
+        "tool": tool,
+        "new": [f.to_dict() for f in result.new],
+        "baselined": [f.to_dict() for f in result.baselined],
+        "suppressed": [f.to_dict() for f in result.suppressed],
+        "stale_baseline": [dict(e) for e in result.stale_baseline],
+        "findings": [flat(f) for f in result.new]
+        + [flat(f, baselined=True) for f in result.baselined]
+        + [flat(f, suppressed=True) for f in result.suppressed],
+    }
 
 
 def lint_file(path: str, rules: Optional[Dict[str, Rule]] = None
@@ -383,7 +490,8 @@ def lint_file(path: str, rules: Optional[Dict[str, Rule]] = None
         return [Finding("parse-error", norm, lineno, 0,
                         f"{type(e).__name__}: {e}")], []
     ctx = ModuleContext(norm, ap, tree, src.splitlines(),
-                        build_jit_index(tree))
+                        build_jit_index(tree),
+                        header_spans=decorated_header_spans(tree))
     active: List[Finding] = []
     suppressed: List[Finding] = []
     for rule in rules.values():
@@ -407,28 +515,6 @@ def run_lint(paths: Sequence[str],
         findings.extend(got)
         suppressed.extend(sup)
 
-    # Multiset match against the baseline: N identical entries excuse at
-    # most N identical findings.
-    budget: Dict[Tuple[str, str, str], int] = {}
-    for e in baseline or ():
-        budget[(e.get("rule"), e.get("path"), e.get("message"))] = \
-            budget.get((e.get("rule"), e.get("path"), e.get("message")), 0) + 1
-    baselined: List[Finding] = []
-    new: List[Finding] = []
-    for f in findings:
-        if budget.get(f.key(), 0) > 0:
-            budget[f.key()] -= 1
-            baselined.append(f)
-        else:
-            new.append(f)
-    # Unmatched baseline entries are stale (the finding was fixed):
-    # reported so the baseline can be pruned, never a gate failure.
-    stale = []
-    leftover = dict(budget)
-    for e in baseline or ():
-        k = (e.get("rule"), e.get("path"), e.get("message"))
-        if leftover.get(k, 0) > 0:
-            leftover[k] -= 1
-            stale.append(dict(e))
+    baselined, new, stale = classify_findings(findings, baseline)
     return LintResult(findings=findings, suppressed=suppressed,
                       baselined=baselined, new=new, stale_baseline=stale)

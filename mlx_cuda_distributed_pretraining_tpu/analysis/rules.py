@@ -73,13 +73,20 @@ def _param_names(fn: ast.AST) -> List[str]:
 def _walk_skip_defs(node: ast.AST, *, skip_root_check: bool = True
                     ) -> Iterable[ast.AST]:
     """ast.walk that does not descend into nested function/lambda bodies
-    (their code does not run as part of the enclosing statement flow)."""
+    (their code does not run as part of the enclosing statement flow).
+    Decorator and default-argument expressions of a skipped def DO run in
+    the enclosing flow (a ``@jax.jit`` decorator inside a loop compiles a
+    fresh wrapper per iteration), so those are still visited."""
     stack = [node]
     first = True
     while stack:
         n = stack.pop()
         if not first and isinstance(
                 n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            if not isinstance(n, ast.Lambda):
+                stack.extend(n.decorator_list)
+                stack.extend(d for d in (*n.args.defaults,
+                                         *n.args.kw_defaults) if d)
             continue
         first = False
         yield n
@@ -113,6 +120,53 @@ def _assigned_names(node: ast.AST) -> Set[str]:
         elif isinstance(n, ast.withitem) and n.optional_vars is not None:
             add_target(n.optional_vars)
     return out
+
+
+# -- module-local call graph ------------------------------------------------
+#
+# Hot-context rules (host-sync-in-hot-loop, time-in-jit) must not stop at
+# a function boundary: a step loop that calls ``self._log(metrics)`` pays
+# the float() inside _log every iteration exactly as if it were inline.
+# The resolution is deliberately conservative — only calls whose terminal
+# identifier names exactly ONE module-local def are followed (ambiguous
+# method names across classes disarm the check), and the chase is
+# depth-capped and cycle-safe.
+
+_CALL_CHASE_DEPTH = 4
+
+
+def _local_defs(tree: ast.AST) -> Dict[str, ast.AST]:
+    """Terminal name -> def node for unambiguously-named module-local
+    functions (top-level defs and methods alike)."""
+    seen: Dict[str, Optional[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            seen[node.name] = None if node.name in seen else node
+    return {k: v for k, v in seen.items() if v is not None}
+
+
+def _is_generator(fn: ast.AST) -> bool:
+    """True when the def is a generator (contains yield outside nested
+    defs): calling it builds an iterator without running the body, so the
+    call-site does not execute its statements."""
+    return any(isinstance(n, (ast.Yield, ast.YieldFrom))
+               for n in _walk_skip_defs(fn))
+
+
+def _resolve_local_call(call: ast.Call, defs: Dict[str, ast.AST]
+                        ) -> Optional[ast.AST]:
+    """The module-local def a call targets: ``helper(...)`` or
+    ``self.helper(...)``/``cls.helper(...)``; None for anything else
+    (external callees, deeper attribute chains, ambiguous names)."""
+    name = dotted_name(call.func)
+    if not name:
+        return None
+    parts = name.split(".")
+    if len(parts) == 1:
+        return defs.get(parts[0])
+    if len(parts) == 2 and parts[0] in ("self", "cls"):
+        return defs.get(parts[1])
+    return None
 
 
 # -- recompile-hazard -------------------------------------------------------
@@ -415,6 +469,7 @@ class HostSyncInHotLoop(Rule):
 
     def check(self, ctx: ModuleContext) -> Iterable[Finding]:
         parents = _build_parents(ctx.tree)
+        defs = _local_defs(ctx.tree)
         reported: Set[int] = set()
         for loop in ast.walk(ctx.tree):
             if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
@@ -435,6 +490,55 @@ class HostSyncInHotLoop(Rule):
                     f"that dispatches jitted `{callee}` — the host blocks on "
                     "the device every iteration; gate it behind an interval, "
                     "hoist it past the loop, or accumulate on device"))
+            # Interprocedural: an ungated call to a module-local helper runs
+            # the helper body once per iteration, so the helper's own
+            # unconditional syncs are loop syncs exactly the same. The
+            # `for`-loop iterable is evaluated once, not per iteration, and
+            # calling a generator function does not run its body at all —
+            # both are excluded.
+            iter_ids = {id(n) for n in ast.walk(loop.iter)} \
+                if isinstance(loop, (ast.For, ast.AsyncFor)) else set()
+            for call in _walk_skip_defs(loop):
+                if not isinstance(call, ast.Call) or id(call) in iter_ids \
+                        or self._gated(call, loop, parents):
+                    continue
+                target = _resolve_local_call(call, defs)
+                if target is None or target in ctx.jit_index.functions \
+                        or _is_generator(target):
+                    continue
+                yield from self._check_helper(
+                    ctx, target, defs, parents, reported, fname, callee,
+                    chain=(target.name,), visited={id(target)},
+                    depth=_CALL_CHASE_DEPTH)
+
+    def _check_helper(self, ctx, helper, defs, parents, reported: Set[int],
+                      loop_fn: str, dispatch_callee, chain, visited,
+                      depth: int) -> Iterable[Finding]:
+        via = " -> ".join(chain)
+        for node, marker in self._sync_calls(helper):
+            if id(node) in reported or self._gated(node, helper, parents):
+                continue
+            reported.add(id(node))
+            yield self.finding(ctx, node, (
+                f"`{marker}` in `{helper.name}` (reached via {via} from a "
+                f"loop in `{loop_fn}` that dispatches jitted "
+                f"`{dispatch_callee}`) runs unconditionally every iteration "
+                "— the host blocks on the device; gate the call or the "
+                "sync behind an interval, or accumulate on device"))
+        if depth <= 1:
+            return
+        for call in _walk_skip_defs(helper):
+            if not isinstance(call, ast.Call) \
+                    or self._gated(call, helper, parents):
+                continue
+            target = _resolve_local_call(call, defs)
+            if target is None or id(target) in visited \
+                    or target in ctx.jit_index.functions:
+                continue
+            yield from self._check_helper(
+                ctx, target, defs, parents, reported, loop_fn,
+                dispatch_callee, chain=chain + (target.name,),
+                visited=visited | {id(target)}, depth=depth - 1)
 
     def _sync_calls(self, loop) -> Iterable[Tuple[ast.AST, str]]:
         for n in _walk_skip_defs(loop):
@@ -682,23 +786,59 @@ class TimeInJit(Rule):
     )
 
     def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        defs = _local_defs(ctx.tree)
+        reported: Set[int] = set()
         for fn, _spec in ctx.jit_index.functions.items():
-            for node in _walk_skip_defs(fn):
-                if not isinstance(node, ast.Call):
-                    continue
-                name = dotted_name(node.func)
-                if name in _TRACE_TIME_CALLS:
-                    yield self.finding(ctx, node, (
-                        f"`{name}(...)` inside jitted `{fn.name}` runs once "
-                        "at trace time — the value is a compile-time "
-                        "constant, not a per-step measurement; time around "
-                        "the dispatch (after block_until_ready) instead"))
-                elif name in _TRACE_IO_CALLS:
-                    yield self.finding(ctx, node, (
-                        f"`{name}(...)` inside jitted `{fn.name}` executes "
-                        "only at trace time — the compiled program never "
-                        "repeats the I/O; use jax.debug.print/"
-                        "jax.debug.callback for per-call output"))
+            yield from self._check_body(ctx, fn, fn.name, reported, via=None)
+            # Interprocedural: a module-local helper called from a jitted
+            # body executes at trace time too — its clock reads and I/O
+            # freeze into the trace exactly like inline ones.
+            yield from self._chase_calls(
+                ctx, fn, fn.name, defs, reported,
+                chain=(), visited={id(fn)}, depth=_CALL_CHASE_DEPTH)
+
+    def _chase_calls(self, ctx, scope, jit_name: str, defs, reported,
+                     chain, visited, depth: int) -> Iterable[Finding]:
+        if depth <= 0:
+            return
+        for call in _walk_skip_defs(scope):
+            if not isinstance(call, ast.Call):
+                continue
+            target = _resolve_local_call(call, defs)
+            if target is None or id(target) in visited \
+                    or target in ctx.jit_index.functions \
+                    or _is_generator(target):
+                continue
+            sub_chain = chain + (target.name,)
+            yield from self._check_body(ctx, target, jit_name, reported,
+                                        via=" -> ".join(sub_chain))
+            yield from self._chase_calls(
+                ctx, target, jit_name, defs, reported, chain=sub_chain,
+                visited=visited | {id(target)}, depth=depth - 1)
+
+    def _check_body(self, ctx, scope, jit_name: str, reported: Set[int],
+                    via: Optional[str]) -> Iterable[Finding]:
+        where = (f"inside jitted `{jit_name}`" if via is None
+                 else f"in `{scope.name}` (reached via {via} from jitted "
+                      f"`{jit_name}`)")
+        for node in _walk_skip_defs(scope):
+            if not isinstance(node, ast.Call) or id(node) in reported:
+                continue
+            name = dotted_name(node.func)
+            if name in _TRACE_TIME_CALLS:
+                reported.add(id(node))
+                yield self.finding(ctx, node, (
+                    f"`{name}(...)` {where} runs once "
+                    "at trace time — the value is a compile-time "
+                    "constant, not a per-step measurement; time around "
+                    "the dispatch (after block_until_ready) instead"))
+            elif name in _TRACE_IO_CALLS:
+                reported.add(id(node))
+                yield self.finding(ctx, node, (
+                    f"`{name}(...)` {where} executes "
+                    "only at trace time — the compiled program never "
+                    "repeats the I/O; use jax.debug.print/"
+                    "jax.debug.callback for per-call output"))
 
 
 # -- legacy-shard-map-import ------------------------------------------------

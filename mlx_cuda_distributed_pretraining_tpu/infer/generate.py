@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import llama
+from ..ops.donation import donate_argnums
 from .samplers import Sampler, greedy, make_sampler
 
 _STEP_CACHE: Dict[Any, Any] = {}
@@ -49,7 +50,11 @@ def _decode_step(args: llama.LlamaArgs, with_processors: bool, attend_len: Optio
     if key in _STEP_CACHE:
         return _STEP_CACHE[key]
 
-    @partial(jax.jit, static_argnames=("sampler", "processors"))
+    # The cache is donated: each decode iteration feeds only the cache the
+    # previous step returned, so the old buffers are dead and XLA reuses
+    # them in place instead of doubling the KV working set.
+    @partial(jax.jit, static_argnames=("sampler", "processors"),
+             donate_argnums=donate_argnums(1))
     def step(params, cache, token, pos, rng, history, sampler, processors):
         logits, cache = llama.forward(params, token[:, None], args, cache=cache, start_pos=pos,
                                       attend_len=attend_len)
@@ -204,7 +209,7 @@ def _verify_step(args: llama.LlamaArgs, chunk: int, attend_len: int):
     if key in _STEP_CACHE:
         return _STEP_CACHE[key]
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=donate_argnums(1))
     def step(params, cache, toks, pos):
         logits, cache = llama.forward(params, toks, args, cache=cache,
                                       start_pos=pos, attend_len=attend_len)
@@ -248,7 +253,7 @@ def _verify_step_sampled(args: llama.LlamaArgs, chunk: int, attend_len: int,
     if key_ in _STEP_CACHE:
         return _STEP_CACHE[key_]
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=donate_argnums(1))
     def step(params, cache, toks, pos, rng):
         logits, cache = llama.forward(params, toks, args, cache=cache,
                                       start_pos=pos, attend_len=attend_len)

@@ -435,7 +435,12 @@ def moe_block(p: Params, x: jnp.ndarray, args) -> Tuple[jnp.ndarray, jnp.ndarray
     E, K = args.num_local_experts, args.num_experts_per_tok
     impl = getattr(args, "moe_impl", "grouped") or "grouped"
 
-    router_logits = x.astype(jnp.float32) @ p["router"]["weight"].astype(jnp.float32)
+    # Project in the activation dtype, then route in fp32: only the tiny
+    # [B, S, E] logits are upcast, not the [B, S, D] activations — under
+    # bf16 compute the old fp32 projection paid an activation-sized
+    # convert plus a 2x-wide matmul for logits that top_k/softmax need at
+    # fp32 anyway (caught by graftaudit's dtype-upcast rule).
+    router_logits = (x @ p["router"]["weight"].astype(x.dtype)).astype(jnp.float32)
     probs = jax.nn.softmax(router_logits, axis=-1)  # [B, S, E] fp32
 
     if impl == "einsum":

@@ -1,4 +1,5 @@
 from .attention import reference_attention
+from .donation import donate_argnums
 from . import masks
 
-__all__ = ["reference_attention", "masks"]
+__all__ = ["reference_attention", "donate_argnums", "masks"]

@@ -1,0 +1,30 @@
+"""Backend-gated buffer donation.
+
+``jax.jit`` donation is an accelerator feature: XLA:CPU has no
+input-output aliasing, so a donated buffer there changes nothing and
+emits a warning per compile. Hot-path jits route their donate_argnums
+through :func:`donate_argnums`, which passes them through on
+accelerators and returns ``()`` on CPU.
+
+graftaudit (analysis/audit.py) lowers the same steps on CPU to check the
+donation pattern the accelerator would see; it sets
+``GRAFTAUDIT_FORCE_DONATE=1`` so the CPU lowering carries the real
+donation intent (lowering is metadata-only — execution is what lacks
+CPU aliasing, and the audit never executes).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import jax
+
+
+def donate_argnums(*argnums: int) -> Tuple[int, ...]:
+    """``argnums`` when donation is real (non-CPU backend), else ``()``."""
+    if os.environ.get("GRAFTAUDIT_FORCE_DONATE") == "1":
+        return argnums
+    if jax.default_backend() == "cpu":
+        return ()
+    return argnums

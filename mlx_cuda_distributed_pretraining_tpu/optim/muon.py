@@ -43,9 +43,12 @@ def newton_schulz5(g: jnp.ndarray, steps: int = 5, eps: float = 1e-7) -> jnp.nda
         x = x.T
     x = x / (jnp.linalg.norm(x) + eps)
     for _ in range(steps):
-        xxt = x @ x.T
-        bxxt = b * xxt + c * (xxt @ xxt)
-        x = a * x + bxxt @ x
+        # graftlint: disable=dtype-upcast — fp32 is the point here: the NS
+        # iteration amplifies rounding error and runs on optimizer state,
+        # not activations, so the bf16 compute dtype does not apply.
+        xxt = x @ x.T  # graftlint: disable=dtype-upcast
+        bxxt = b * xxt + c * (xxt @ xxt)  # graftlint: disable=dtype-upcast
+        x = a * x + bxxt @ x  # graftlint: disable=dtype-upcast
     if transpose:
         x = x.T
     return x

@@ -73,6 +73,7 @@ from jax.sharding import PartitionSpec as P
 from ..infer.generate import _attend_bucket, _round_up, _spec_accept_one
 from ..models import llama
 from ..ops.attention import reference_attention
+from ..ops.donation import donate_argnums
 
 _STEP_CACHE: Dict[Any, Any] = {}
 
@@ -200,9 +201,10 @@ def _project_logits(params, x, args):
 
 def _donate_cache():
     # Donating the pool buffers makes the per-iteration cache update
-    # in-place on accelerators; the CPU backend has no donation support
-    # and would warn once per compile, so skip it there.
-    return () if jax.default_backend() == "cpu" else (1,)
+    # in-place on accelerators; the CPU backend has no donation support,
+    # so ops/donation.py gates it off there (and graftaudit forces it
+    # back on when lowering these steps for the donation audit).
+    return donate_argnums(1)
 
 
 def kv_cache_pspec(mesh: Optional[Mesh], num_kv_heads: int) -> P:

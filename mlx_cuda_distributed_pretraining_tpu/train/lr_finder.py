@@ -11,12 +11,45 @@ from __future__ import annotations
 import csv
 import math
 import os
+from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from ..ops.donation import donate_argnums
 from ..optim.base import apply_updates
+
+
+def _sweep_step(loss_fn: Callable) -> Callable:
+    """Momentum-SGD sweep step (module-level so graftaudit can lower it —
+    analysis/audit.py ``lr_probe`` program). The LR is a traced argument:
+    one compile covers the whole sweep. params/trace are donated — each
+    loop iteration feeds back only the buffers the previous call
+    returned, and the callers copy the incoming params first, so a
+    sweep-sized model stops costing 2x params + trace in HBM."""
+
+    @partial(jax.jit, donate_argnums=donate_argnums(0, 1))
+    def step(params, trace, batch, lr):
+        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+        new_trace = jax.tree_util.tree_map(
+            lambda t, g: 0.9 * t + g.astype(jnp.float32), trace, grads)
+        updates = jax.tree_util.tree_map(lambda t: -lr * t, new_trace)
+        return apply_updates(params, updates), new_trace, loss
+
+    return step
+
+
+def _opt_sweep_step(loss_fn: Callable, opt: Any) -> Callable:
+    """Real-optimizer sweep step; donation contract as ``_sweep_step``."""
+
+    @partial(jax.jit, donate_argnums=donate_argnums(0, 1))
+    def step(params, state, batch):
+        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+        updates, state = opt.update(grads, state, params)
+        return apply_updates(params, updates), state, loss
+
+    return step
 
 
 def run_lr_finder(
@@ -34,19 +67,12 @@ def run_lr_finder(
     reference (:1520). ``batch_iter(i)`` supplies the batch for step i."""
     gamma = (max_lr / min_lr) ** (1.0 / max(num_steps - 1, 1))
 
-    # Inline momentum-SGD so the LR can be a traced jit argument (one
-    # compile for the whole sweep).
-    def opt_init(params):
-        return jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.float32), params)
-
-    @jax.jit
-    def step(params, trace, batch, lr):
-        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
-        new_trace = jax.tree_util.tree_map(lambda t, g: 0.9 * t + g.astype(jnp.float32), trace, grads)
-        updates = jax.tree_util.tree_map(lambda t: -lr * t, new_trace)
-        return apply_updates(params, updates), new_trace, loss
-
-    state = opt_init(params)
+    # The sweep step donates params/trace; work on a copy so the caller's
+    # params survive (the trainer reuses self.state["params"] after the
+    # sweep to rebuild its train state).
+    params = jax.tree_util.tree_map(jnp.array, params)
+    step = _sweep_step(loss_fn)
+    state = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.float32), params)
     lrs: List[float] = []
     losses: List[float] = []
     smooth = None
@@ -113,13 +139,11 @@ def run_lr_finder_for_optimizer(
 
     opt = build_optimizer(training_cfg, num_steps, name=optimizer_name,
                           schedule=sweep_schedule)
+    # Copy before the donated loop — same aliasing contract as
+    # run_lr_finder above.
+    params = jax.tree_util.tree_map(jnp.array, params)
     state = opt.init(params)
-
-    @jax.jit
-    def step(params, state, batch):
-        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
-        updates, state = opt.update(grads, state, params)
-        return apply_updates(params, updates), state, loss
+    step = _opt_sweep_step(loss_fn, opt)
 
     lrs: List[float] = []
     losses: List[float] = []

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Regenerate the committed graftaudit collective budgets.
+
+    python scripts/audit_budget.py                 # both default configs
+    python scripts/audit_budget.py configs/x.yaml  # just one
+    python scripts/audit_budget.py --allow-shrink  # accept comm wins
+
+Lowered on CPU (8 virtual devices) — no accelerator needed. For each
+config the script prints the delta against the committed budget
+(analysis/budgets/<config>.json) and rewrites it. A SHRINK — the fresh
+census below the committed one — is refused without ``--allow-shrink``:
+a smaller budget is either a real comm win (great: rerun with the flag
+so the audit gate rides at the new floor) or a sign this machine lowered
+a different program than CI does (wrong device count, stale tree), and
+silently committing the latter would let a later regression hide inside
+the stale headroom.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_CONFIGS = (
+    "configs/model-config-sample.yaml",
+    "configs/model-config-moe-8x40m.yaml",
+)
+
+
+def diff_budget(old, new):
+    """(lines, grew, shrank) — human delta between two budget docs."""
+    lines, grew, shrank = [], False, False
+    ops = (old or {}).get("programs", {}) if old else {}
+    nps = new.get("programs", {})
+    for prog in sorted(set(ops) | set(nps)):
+        o = (ops.get(prog) or {}).get("collectives", {})
+        n = (nps.get(prog) or {}).get("collectives", {})
+        for op in sorted(set(o) | set(n)):
+            ov = o.get(op, {"count": 0, "bytes": 0})
+            nv = n.get(op, {"count": 0, "bytes": 0})
+            if ov == nv:
+                continue
+            if (nv["count"], nv["bytes"]) > (ov["count"], ov["bytes"]):
+                grew = True
+                tag = "GREW"
+            else:
+                shrank = True
+                tag = "shrank"
+            lines.append(
+                f"  {prog}/{op}: {ov['count']} op(s) / {ov['bytes']} B "
+                f"-> {nv['count']} op(s) / {nv['bytes']} B  [{tag}]")
+        od = (ops.get(prog) or {}).get("donation")
+        nd = (nps.get(prog) or {}).get("donation")
+        if od != nd and nd is not None:
+            lines.append(f"  {prog}/donation: {od} -> {nd}")
+    return lines, grew, shrank
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("configs", nargs="*", default=None)
+    ap.add_argument("--allow-shrink", action="store_true",
+                    help="accept a budget smaller than the committed one")
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--check", action="store_true",
+                    help="compare only; exit 1 on ANY delta, write nothing")
+    args = ap.parse_args(argv)
+    configs = args.configs or [os.path.join(REPO, c) for c in DEFAULT_CONFIGS]
+
+    from mlx_cuda_distributed_pretraining_tpu.analysis import audit
+
+    audit.setup_env(args.devices)
+
+    status = 0
+    for config in configs:
+        if not os.path.isfile(config):
+            print(f"audit_budget: no such config: {config}", file=sys.stderr)
+            return 2
+        name = audit.config_stem(config)
+        path = audit.default_budget_path(name)
+        old = audit.load_budget(path)
+        programs = audit.build_programs(config)
+        doc = audit.build_budget_doc(name, args.devices, programs)
+        lines, grew, shrank = diff_budget(old, doc)
+        if old is None:
+            print(f"{name}: no committed budget yet")
+        elif not lines:
+            print(f"{name}: budget unchanged")
+            continue
+        else:
+            print(f"{name}: budget delta")
+            print("\n".join(lines))
+        if args.check:
+            status = 1
+            continue
+        if shrank and not args.allow_shrink:
+            print(f"{name}: refusing to shrink the committed budget — "
+                  "verify the comm win is real, then rerun with "
+                  "--allow-shrink", file=sys.stderr)
+            status = 1
+            continue
+        audit.write_budget(path, doc)
+        print(f"{name}: wrote {path}")
+    return status
+
+
+if __name__ == "__main__":
+    sys.exit(main())

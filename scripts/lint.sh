@@ -1,14 +1,22 @@
 #!/bin/sh
-# graftlint wrapper: JAX-aware static analysis over the package.
+# graftlint + graftaudit wrapper: static analysis over the package.
 #
-#   scripts/lint.sh                 # lint the package against the baseline
-#   scripts/lint.sh path/to/file.py # lint specific paths
-#   scripts/lint.sh --format json   # machine-readable findings
+#   scripts/lint.sh                 # AST lint + compiled-program audit
+#   scripts/lint.sh path/to/file.py # lint specific paths (audit still runs)
+#   scripts/lint.sh --format json   # machine-readable findings (both tools)
 #
-# Exit codes: 0 clean (modulo baseline), 1 new findings, 2 bad paths.
-# The linter is pure-AST (never imports the code under analysis), but it
-# runs from the package, so pin JAX to CPU in case an import chain wakes
-# a backend.
+# Exit codes: 0 clean (modulo baselines), nonzero otherwise.
+# Stage 1 (graftlint) is pure-AST source analysis; stage 2 (graftaudit)
+# AOT-lowers the real train/serve/decode programs of the sample config on
+# CPU and audits the jaxpr/HLO — donation gaps, collective census vs the
+# committed budget, fp32 creep, captured constants, replicated params.
+# LINT_AUDIT=0 skips stage 2 (e.g. while iterating on a broken model).
 set -eu
 cd "$(dirname "$0")/.."
-JAX_PLATFORMS=cpu exec python -m mlx_cuda_distributed_pretraining_tpu.analysis.lint "$@"
+JAX_PLATFORMS=cpu python -m mlx_cuda_distributed_pretraining_tpu.analysis.lint "$@"
+# Audit flags don't pass through (lint takes paths, audit takes --config);
+# run `python -m mlx_cuda_distributed_pretraining_tpu.analysis.audit` for those.
+if [ "${LINT_AUDIT:-1}" != "0" ]; then
+    JAX_PLATFORMS=cpu python -m mlx_cuda_distributed_pretraining_tpu.analysis.audit \
+        --config configs/model-config-sample.yaml
+fi

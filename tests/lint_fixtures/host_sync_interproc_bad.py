@@ -1,0 +1,25 @@
+"""BAD fixture: host-sync-in-hot-loop, interprocedural — the sync hides
+inside a module-local helper the loop calls unconditionally."""
+import jax
+
+
+@jax.jit
+def step(s, b):
+    return s + b, s * 2
+
+
+def log_metrics(m, rows):
+    rows.append(float(m))  # line 12: sync, reached per iteration via helper
+
+
+class Trainer:
+    def _publish(self, m):
+        self.last = m.item()  # line 17: sync via self.* helper call
+
+    def train(self, s, batches):
+        rows = []
+        for b in batches:
+            s, m = step(s, b)
+            log_metrics(m, rows)
+            self._publish(m)
+        return s, rows

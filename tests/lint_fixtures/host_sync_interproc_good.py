@@ -1,0 +1,33 @@
+"""GOOD fixture: interprocedural host-sync stays quiet when the helper
+call is interval-gated, the helper gates its own sync, or the callee is
+a generator (calling one does not run its body)."""
+import jax
+
+
+@jax.jit
+def step(s, b):
+    return s + b, s * 2
+
+
+def log_metrics(m, rows):
+    rows.append(float(m))  # reached only behind the interval gate below
+
+
+def sample_stream(s):
+    yield float(s)  # generator body: not executed by the bare call
+
+
+class Trainer:
+    def _publish(self, m, i):
+        if i % 10 == 0:
+            self.last = m.item()  # gated inside the helper
+
+    def train(self, s, batches):
+        rows = []
+        for i, b in enumerate(batches):
+            s, m = step(s, b)
+            if i % 10 == 0:
+                log_metrics(m, rows)  # gated call: helper sync is gated too
+            self._publish(m, i)
+            sample_stream(m)
+        return s, rows

@@ -1,0 +1,16 @@
+"""BAD fixture: jit applied as a DECORATOR inside a loop — the decorator
+expression runs per iteration, building a fresh wrapper each time."""
+import functools
+
+import jax
+
+
+def rebuild_per_config(configs, x):
+    outs = []
+    for cfg in configs:
+        @functools.partial(jax.jit, static_argnums=(1,))  # line 11
+        def step(v, scale):
+            return v * scale
+
+        outs.append(step(x, cfg))
+    return outs

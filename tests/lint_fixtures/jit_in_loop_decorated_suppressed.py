@@ -1,0 +1,17 @@
+"""SUPPRESSED fixture: the jit-in-loop finding lands on the DECORATOR
+line, but the acknowledgement sits on the ``def`` line below it — one
+decorated statement, so the suppression must cover the whole span."""
+import functools
+
+import jax
+
+
+def rebuild_per_config(configs, x):
+    outs = []
+    for cfg in configs:
+        @functools.partial(jax.jit, static_argnums=(1,))  # line 12
+        def step(v, scale):  # graftlint: disable=jit-in-loop
+            return v * scale
+
+        outs.append(step(x, cfg))
+    return outs

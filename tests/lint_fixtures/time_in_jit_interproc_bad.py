@@ -1,0 +1,21 @@
+"""BAD fixture: time-in-jit, interprocedural — the wall-clock read and
+the print live in helpers the jitted body calls at trace time."""
+import time
+
+import jax
+
+
+def _stamp(x):
+    t = time.time()  # line 9: trace-time constant via helper
+    return x, t
+
+
+def _banner(x):
+    print("step", x)  # line 14: trace-time I/O via helper
+    return x
+
+
+@jax.jit
+def step(x):
+    x, t = _stamp(x)
+    return _banner(x * 2), t

@@ -1,0 +1,22 @@
+"""GOOD fixture: interprocedural time-in-jit stays quiet — the helper
+with the clock read is only called OUTSIDE the jitted function, and
+in-trace output goes through jax.debug.print."""
+import time
+
+import jax
+
+
+def _stamp():
+    return time.time()  # only reached from un-jitted code
+
+
+@jax.jit
+def step(x):
+    jax.debug.print("x = {}", x)
+    return x * 2
+
+
+def run(x):
+    t0 = _stamp()
+    y = step(x)
+    return y, _stamp() - t0

@@ -94,7 +94,10 @@ def test_baseline_entries_all_still_match():
     ("use_after_donate_bad.py", "use-after-donate", [14, 21]),
     ("tracer_leak_bad.py", "tracer-leak", [10, 17]),
     ("jit_in_loop_bad.py", "jit-in-loop", [7]),
+    ("jit_in_loop_decorated_bad.py", "jit-in-loop", [11]),
     ("time_in_jit_bad.py", "time-in-jit", [9, 11, 12]),
+    ("host_sync_interproc_bad.py", "host-sync-in-hot-loop", [12, 17]),
+    ("time_in_jit_interproc_bad.py", "time-in-jit", [9, 14]),
     ("legacy_shard_map_bad.py", "legacy-shard-map-import", [2, 3, 4]),
     ("monotonic_clock_bad.py", "monotonic-clock", [8, 15]),
 ])
@@ -113,6 +116,8 @@ def test_bad_fixture_fires_at_exact_lines(fixture, rule, lines):
     "tracer_leak_good.py",
     "jit_in_loop_good.py",
     "time_in_jit_good.py",
+    "host_sync_interproc_good.py",
+    "time_in_jit_interproc_good.py",
     "legacy_shard_map_good.py",
     "monotonic_clock_good.py",
 ])
@@ -129,6 +134,7 @@ def test_good_fixture_is_clean(fixture):
     ("use_after_donate_suppressed.py", "use-after-donate", 15),
     ("tracer_leak_suppressed.py", "tracer-leak", 9),
     ("jit_in_loop_suppressed.py", "jit-in-loop", 8),
+    ("jit_in_loop_decorated_suppressed.py", "jit-in-loop", 12),
     ("time_in_jit_suppressed.py", "time-in-jit", 8),
     ("legacy_shard_map_suppressed.py", "legacy-shard-map-import", 3),
     ("monotonic_clock_suppressed.py", "monotonic-clock", 9),
