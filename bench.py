@@ -41,7 +41,8 @@ decode/prefill additionally use a two-point (T(n_hi)-T(n_lo)) difference
 to cancel the fixed overhead.
 
 Env knobs: BENCH_CASES (comma list: 2m,40m,100m,400m,650m,1b,simple,
-decode,serve,pp,moe,longctx,trainer; default all; plus CI-only "tiny"),
+decode,serve,pp,moe,longctx,trainer,elastic; default all; plus CI-only
+"tiny"),
 BENCH_STEPS, BENCH_VOCAB, BENCH_BUDGET_S. The "serve" family compares
 the continuous-batching engine (serve/) against the locked server path
 at occupancy 1/4/8 — a scheduling comparison that is meaningful on CPU.
@@ -1665,6 +1666,144 @@ def bench_trainer_case(vocab, workdir="/tmp/bench_trainer", spd=1):
     }
 
 
+def bench_train_elastic_case(vocab, workdir="/tmp/bench_elastic",
+                             name="train_elastic"):
+    """Elastic multi-host chaos case: a 2-supervisor fleet (2 simulated
+    hosts x 2 CPU devices, fsdp=4) with one mid-run SIGKILL of a random
+    host's trainer child. Reports whether the fleet resumed, the booked
+    restart_lost_s, the ledger goodput fraction, and the final loss —
+    the bench-side mirror of tests/test_elastic_chaos.py."""
+    import shutil
+    import socket
+    import subprocess
+
+    import numpy as np
+    import yaml
+
+    shutil.rmtree(workdir, ignore_errors=True)
+    os.makedirs(workdir, exist_ok=True)
+    batch, seq, iters = 8, 64, 24
+
+    shard_dir = os.path.join(workdir, "shards")
+    os.makedirs(shard_dir)
+    n_tokens = (iters + 8) * batch * (seq + 1)
+    rng = np.random.default_rng(0)
+    arr = rng.integers(1, vocab - 4, size=n_tokens).astype(np.uint16)
+    arr.tofile(os.path.join(shard_dir, "shard_00000.bin"))
+    with open(os.path.join(shard_dir, "index.json"), "w") as f:
+        json.dump({"dtype": "uint16", "shard_tokens": n_tokens,
+                   "total_tokens": n_tokens, "files": ["shard_00000.bin"],
+                   "vocab_size": vocab, "eos_id": 0}, f)
+
+    cfg_dict = {
+        "name": "bench-elastic",
+        "overwrite": False,
+        "data": {"source": "token_shards", "input_file": shard_dir,
+                 "preprocessing": {"max_context_size": seq},
+                 "tokenizer": {"default": "byte"}},
+        "model": {
+            "architecture": "llama",
+            "dimensions": {"hidden_size": 64, "intermediate_size": 128,
+                           "num_layers": 2, "num_heads": 4},
+            "attention": {"num_kv_heads": 4, "head_dim": 16,
+                          "max_position_embeddings": seq,
+                          "attention_type": "simple"},
+            "misc": {"vocab_size": vocab},
+        },
+        "training": {
+            "hyperparameters": {"batch_size": batch, "learning_rate": 1e-3,
+                                "iters": iters, "gradient_clip": 1.0},
+            "scheduler": {"type": "cosine_with_warmup", "warmup_steps": 2},
+            "optimization": {"optimizer": "adamw"},
+        },
+        "logging": {"steps": {"logging_interval": 1,
+                              "checkpoint_interval": 4,
+                              "validation_interval": 0}},
+        "system": {"seed": 0, "compute_dtype": "float32",
+                   "mesh": {"fsdp": 4},
+                   "compilation_cache_dir": os.path.join(workdir, "xla_cache")},
+        "supervisor": {"hang_timeout_s": 60.0, "hang_kill_grace_s": 2.0,
+                       "barrier_timeout_s": 90.0},
+    }
+    cfg_path = os.path.join(workdir, "cfg.yaml")
+    with open(cfg_path, "w") as f:
+        yaml.dump(cfg_dict, f)
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    runs_root = os.path.join(workdir, "runs")
+    run_dir = os.path.join(runs_root, "bench-elastic")
+
+    procs = []
+    for i in range(2):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m",
+             "mlx_cuda_distributed_pretraining_tpu.train.trainer",
+             "--config", cfg_path, "--runs-root", runs_root,
+             "--auto-resume", "--max-crashes", "5",
+             "--backoff-base", "0.2",
+             "--coordinator", f"localhost:{port}",
+             "--num-processes", "2", "--process-id", str(i)],
+            env=env, stdout=open(os.path.join(workdir, f"sup_p{i}.log"), "w"),
+            stderr=subprocess.STDOUT))
+
+    # Chaos: once host 1's trainer has a heartbeat past the first
+    # checkpoint, SIGKILL it (pid comes from the per-host heartbeat).
+    t0 = time.time()
+    killed = False
+    hb_path = os.path.join(run_dir, "heartbeat_p1.json")
+    while time.time() - t0 < 600 and any(p.poll() is None for p in procs):
+        if not killed and os.path.isfile(hb_path):
+            try:
+                with open(hb_path) as f:
+                    hb = json.load(f)
+            except (OSError, ValueError):
+                hb = {}
+            if int(hb.get("step") or 0) >= 5 and hb.get("pid"):
+                os.kill(int(hb["pid"]), signal.SIGKILL)
+                killed = True
+        time.sleep(0.5)
+    rcs = []
+    for p in procs:
+        try:
+            rcs.append(p.wait(timeout=60))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            rcs.append(-9)
+
+    lost = 0.0
+    comp = 0.0
+    restarts = 0
+    final_loss = None
+    ev_path = os.path.join(run_dir, "events.jsonl")
+    if os.path.isfile(ev_path):
+        with open(ev_path) as f:
+            for line in f:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if ev.get("type") == "restart":
+                    restarts += 1
+                    lost += float(ev.get("lost_s") or 0.0)
+                elif ev.get("type") == "step_window":
+                    comp += sum(v for v in (ev.get("goodput") or {}).values()
+                                if isinstance(v, (int, float)))
+                elif ev.get("type") == "run_end":
+                    final_loss = ev.get("final_loss")
+    goodput = (comp / (comp + lost)) if comp > 0 else None
+    return {"case": name, "hosts": 2, "fsdp": 4, "iters": iters,
+            "killed": killed, "exit_codes": rcs, "restarts": restarts,
+            "restart_lost_s": round(lost, 2),
+            "goodput": round(goodput, 4) if goodput is not None else "unknown",
+            "final_loss": final_loss,
+            "resumed_ok": bool(killed and rcs == [0, 0])}
+
+
 def build_plan(vocab, steps):
     """Ordered case plan shared by the parent orchestrator and ``--one``
     children. Cheap-and-diverse first: a budget-truncated run still covers
@@ -1771,6 +1910,11 @@ def build_plan(vocab, steps):
         ("trainer_spd8", "trainer",
          lambda: bench_trainer_case(vocab, workdir="/tmp/bench_trainer8",
                                     spd=8), 260),
+        # train_elastic: 2-supervisor fleet with a mid-run SIGKILL of one
+        # host's trainer — reports resume success, booked restart_lost_s
+        # and ledger goodput (the chaos harness as a bench row).
+        ("train_elastic", "elastic",
+         lambda: bench_train_elastic_case(vocab), 420),
         ("100m_bs64_remat", "100m",
          lambda: bench_train_case("100m_bs64_remat", "100m_bs64", "flash",
                                   vocab, steps), 150),

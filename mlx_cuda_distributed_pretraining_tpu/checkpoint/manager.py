@@ -37,6 +37,7 @@ from __future__ import annotations
 import glob
 import json
 import os
+import re
 import shutil
 import sys
 import time
@@ -483,13 +484,18 @@ class CheckpointManager:
         nested = unflatten_dict(arrays)
         if like is None:
             return nested
-        like_flat = flatten_dict(_to_numpy_tree(like))
+        # Mesh path compares against the LIVE (device-sharded) reference
+        # tree — flatten_dict passes leaves through untouched, so no host
+        # gather; a ``like`` that is already placed on a multi-host mesh
+        # must never round-trip through _to_numpy_tree's allgather.
+        like_flat = (flatten_dict(like) if mesh is not None
+                     else flatten_dict(_to_numpy_tree(like)))
         out = {}
         for k, ref in like_flat.items():
             if k in arrays:
                 v = arrays[k]
                 if mesh is not None:
-                    if v.dtype != ref.dtype or v.shape != ref.shape:
+                    if v.dtype != ref.dtype or tuple(v.shape) != tuple(ref.shape):
                         raise CheckpointIntegrityError(
                             f"reshard-on-load: {k} is {v.dtype}{v.shape} on "
                             f"disk but {ref.dtype}{ref.shape} in the model; "
@@ -615,6 +621,162 @@ class CheckpointManager:
         for k, ref in like_flat.items():
             out[k] = flat_out.get(k, ref)
         return _restructure_like(like_stacked, unflatten_dict(out))
+
+    def load_opt_state_resharded(
+        self, step, like_opt_state: Any, opt_shardings: Any,
+        num_layers: int = 0, interleave: int = 1, strict: bool = False,
+    ) -> Optional[Any]:
+        """Reshard-on-load for the optimizer state: the mesh-agnostic
+        on-disk moments land directly in the live state's shardings
+        (``state_sharding(...)["opt_state"]``) via per-device-slice
+        callbacks — no host gather, no full replica, same contract as
+        :meth:`load_params` with a mesh.
+
+        ``like_opt_state`` is the LIVE (device-placed) optimizer state and
+        gates structure; ``opt_shardings`` is its matching NamedSharding
+        tree. ``num_layers > 0`` (pipeline) additionally maps stacked live
+        ``...layers.<suffix>`` leaves onto the checkpoint's per-layer
+        ``...layers.<i>.<suffix>`` arrays, stacking only each device's own
+        slices (the opt-state analogue of :meth:`load_params_stacked`).
+
+        Missing/unreadable files warn and return None (fresh optimizer)
+        unless ``strict``; a dtype/shape mismatch is always a
+        :class:`CheckpointIntegrityError` — casting would re-materialize
+        the full array on one host.
+        """
+        from jax.sharding import NamedSharding  # noqa: F401 - documented dep
+
+        _, opt_path, _ = self.paths_for_step(step)
+        if not os.path.exists(opt_path):
+            msg = (f"checkpoint step {step}: expected optimizer file "
+                   f"{opt_path} is MISSING — resuming would silently "
+                   f"reset the optimizer")
+            if strict:
+                raise CheckpointIntegrityError(msg)
+            self._notify(f"WARNING: {msg}; continuing with a fresh "
+                         f"optimizer (resume.strict: true to fail instead)")
+            return None
+        try:
+            arrays, meta = load_safetensors(opt_path)
+            scalars = json.loads(meta.get("scalars", "{}"))
+            flat = dict(arrays)
+            flat.update(scalars)
+        except Exception as e:  # noqa: BLE001 - any torn/garbled file
+            msg = (f"checkpoint step {step}: optimizer file {opt_path} is "
+                   f"UNREADABLE ({type(e).__name__}: {e})")
+            if strict:
+                raise CheckpointIntegrityError(msg) from e
+            self._notify(f"WARNING: {msg}; continuing with a fresh "
+                         f"optimizer (resume.strict: true to fail instead)")
+            return None
+
+        L, V = int(num_layers), int(interleave)
+        if L > 0 and (V < 1 or L % max(V, 1) != 0):
+            raise CheckpointIntegrityError(
+                f"load_opt_state_resharded: num_layers={L} not divisible "
+                f"by interleave={V}")
+        Lv = L // V if (L > 0 and V > 1) else L
+
+        like_flat = flatten_dict(like_opt_state)
+        shard_flat = flatten_dict(opt_shardings)
+        rebuilt: Dict[str, Any] = {}
+        missing: List[str] = []
+        for k, ref in like_flat.items():
+            sharding = shard_flat.get(k)
+            ref_shape = tuple(getattr(ref, "shape", ()) or ())
+            if k in flat:
+                v = flat[k]
+                if isinstance(v, np.ndarray) and sharding is not None \
+                        and hasattr(ref, "shape"):
+                    if v.dtype != ref.dtype or tuple(v.shape) != ref_shape:
+                        raise CheckpointIntegrityError(
+                            f"reshard-on-load: opt leaf {k} is "
+                            f"{v.dtype}{tuple(v.shape)} on disk but "
+                            f"{ref.dtype}{ref_shape} live; cast/reshape "
+                            f"would re-materialize the full array on one "
+                            f"host")
+                    rebuilt[k] = jax.make_array_from_callback(
+                        tuple(v.shape), sharding,
+                        lambda idx, a=v: np.asarray(a[idx]))
+                elif ref is None or v is None or isinstance(v, np.ndarray):
+                    rebuilt[k] = v
+                else:
+                    rebuilt[k] = type(ref)(v)
+                continue
+            parts = k.split(".")
+            if L > 0 and "layers" in parts and sharding is not None:
+                j = parts.index("layers")
+
+                def layer_key(i: int, parts=parts, j=j) -> str:
+                    return ".".join(parts[:j + 1] + [str(i)] + parts[j + 1:])
+
+                per = {i: flat[layer_key(i)] for i in range(L)
+                       if isinstance(flat.get(layer_key(i)), np.ndarray)}
+                if per and len(per) < L:
+                    raise CheckpointIntegrityError(
+                        f"load_opt_state_resharded: {k} has only "
+                        f"{len(per)}/{L} per-layer arrays on disk "
+                        f"(e.g. layer "
+                        f"{next(i for i in range(L) if i not in per)} "
+                        f"missing)")
+                if per:
+                    base = per[0]
+                    shape = ((V, Lv, *base.shape) if V > 1
+                             else (L, *base.shape))
+                    if base.dtype != getattr(ref, "dtype", base.dtype) \
+                            or shape != ref_shape:
+                        raise CheckpointIntegrityError(
+                            f"reshard-on-load: opt leaf {k} stacks to "
+                            f"{base.dtype}{shape} from disk but is "
+                            f"{getattr(ref, 'dtype', '?')}{ref_shape} "
+                            f"live; cast/reshape would re-materialize "
+                            f"the full array on one host")
+
+                    def cb(idx, per=per, V=V, Lv=Lv, L=L):
+                        if V > 1:
+                            vs = range(*idx[0].indices(V))
+                            js = range(*idx[1].indices(Lv))
+                            rest = tuple(idx[2:])
+                            return np.stack([
+                                np.stack([per[v * Lv + j][rest] for j in js])
+                                for v in vs])
+                        ls = range(*idx[0].indices(L))
+                        return np.stack([per[i][tuple(idx[1:])] for i in ls])
+
+                    rebuilt[k] = jax.make_array_from_callback(
+                        shape, sharding, cb)
+                    continue
+            missing.append(k)
+            rebuilt[k] = ref
+        if missing:
+            msg = (f"checkpoint step {step}: optimizer file lacks "
+                   f"{len(missing)}/{len(like_flat)} expected leaves "
+                   f"(e.g. {missing[0]!r}) — those keep fresh values")
+            if strict:
+                raise CheckpointIntegrityError(msg)
+            self._notify(f"WARNING: {msg}")
+        return _restructure_like(like_opt_state, unflatten_dict(rebuilt))
+
+    def data_sidecar_states(self, step) -> Dict[int, Dict[str, Any]]:
+        """All per-host data-loader sidecars of a step, keyed by the
+        process index that wrote them — the input to
+        ``data.streaming.remap_data_states`` when the resuming world
+        differs from the writing one."""
+        out: Dict[int, Dict[str, Any]] = {}
+        for path in self._sidecar_paths(step):
+            m = re.search(r"_data_p(\d+)\.json$", path)
+            if not m:
+                continue
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    obj = json.load(f)
+            except (OSError, json.JSONDecodeError, ValueError) as e:
+                self._notify(f"WARNING: unreadable data sidecar {path} "
+                             f"({type(e).__name__}: {e}); skipping it")
+                continue
+            if isinstance(obj, dict):
+                out[int(m.group(1))] = obj
+        return out
 
     def latest_step(self) -> Optional[str]:
         """Highest numeric step with a model file, or "final" if present."""

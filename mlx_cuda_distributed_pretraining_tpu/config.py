@@ -226,14 +226,23 @@ class LoggingConfig:
 class SystemConfig:
     """Section ``system`` (reference: core/training.py:108-122).
 
-    ``distributed/devices/cuda_devices`` are accepted for config compatibility
-    but the execution model is SPMD over ``mesh`` — there is no thread-queue
+    ``devices/cuda_devices`` are accepted for config compatibility but the
+    execution model is SPMD over ``mesh`` — there is no thread-queue
     device manager to configure.
+
+    ``distributed`` accepts the legacy boolean (compatibility, ignored) or
+    a mapping configuring the multi-host rendezvous
+    (parallel/elastic.py)::
+
+        distributed:
+          coordinator_address: host:port   # of process 0; null = auto-detect
+          num_processes: 2
+          rendezvous_timeout_s: 120
     """
 
     seed: int = 42
     device: str = "tpu"
-    distributed: bool = False
+    distributed: Any = False
     devices: Optional[List[str]] = None
     cuda_devices: Optional[List[int]] = None
     memory_limit: Optional[int] = None
@@ -313,6 +322,24 @@ class SystemConfig:
                     f"unknown system.compute_dtype: {self.compute_dtype!r} "
                     "(expected bfloat16/float16/float32)")
 
+    def _distributed_map(self) -> Dict[str, Any]:
+        return self.distributed if isinstance(self.distributed, dict) else {}
+
+    @property
+    def distributed_coordinator(self) -> Optional[str]:
+        v = self._distributed_map().get("coordinator_address")
+        return str(v) if v else None
+
+    @property
+    def distributed_num_processes(self) -> Optional[int]:
+        v = self._distributed_map().get("num_processes")
+        return int(v) if v is not None else None
+
+    @property
+    def distributed_rendezvous_timeout_s(self) -> float:
+        v = self._distributed_map().get("rendezvous_timeout_s")
+        return float(v) if v is not None else 120.0
+
 
 @dataclass
 class SupervisorConfig:
@@ -324,10 +351,16 @@ class SupervisorConfig:
     SIGTERMed (then SIGKILLed after ``hang_kill_grace_s``) and restarted
     from the newest verified checkpoint, with the lost wall clock booked
     into the goodput ledger via a ``restart`` event. 0 disables the
-    watchdog."""
+    watchdog.
+
+    ``barrier_timeout_s`` bounds the multi-host generation barrier
+    (parallel/elastic.py): how long one host's supervisor waits for its
+    peers before every fleet (re)launch — on timeout it fails loudly
+    rather than hanging forever on a dead peer."""
 
     hang_timeout_s: float = 0.0
     hang_kill_grace_s: float = 20.0
+    barrier_timeout_s: float = 300.0
 
 
 @dataclass

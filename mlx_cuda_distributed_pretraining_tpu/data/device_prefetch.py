@@ -274,12 +274,22 @@ class DevicePrefetcher:
         """Loader position as CONSUMED by the trainer (see module
         docstring). Stream-stateful loaders get the snapshot taken right
         after the last consumed batch's fetch; pure-function-of-step
-        loaders delegate live."""
+        loaders delegate live. Snapshots are stamped with the world they
+        were taken under (``process_count``/``process_index``) so an
+        elastic resume can detect and remap a mismatched world instead of
+        silently double-consuming documents."""
         if self._stateful:
-            if self._consumed_state is not None:
-                return dict(self._consumed_state)
-            return dict(self._initial_state)
-        return self.loader.state_dict()
+            state = (dict(self._consumed_state)
+                     if self._consumed_state is not None
+                     else dict(self._initial_state))
+        else:
+            state = self.loader.state_dict()
+        if isinstance(state, dict):
+            for key in ("process_count", "process_index"):
+                stamp = getattr(self.loader, key, None)
+                if stamp is not None:
+                    state.setdefault(key, int(stamp))
+        return state
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         self.loader.load_state_dict(state)

@@ -184,7 +184,21 @@ class SeekableShuffledSource:
     recomputes the permutations, reloads ONE shard, and continues from the
     exact document (VERDICT r1 weak #7: the old path replayed the whole
     stream). Per-host sharding (``emitted % process_count``) is folded into
-    the same counters so multi-host resume is exact too."""
+    the same counters so multi-host resume is exact too.
+
+    **Elastic (N → M host) resume.** A snapshot taken at
+    ``process_count=N`` can resume at ``process_count=M`` with zero
+    skipped and zero replayed documents. The mechanism is an *exclusion
+    table* per past world (``remap_seekable_states``): the old hosts'
+    ``emitted`` positions plus a running assignment ordinal ``taken``.
+    Replaying the old world's round-robin rule (``taken % N``) against
+    each document ordinal tells every new host — identically, with no
+    communication — whether the old world already consumed that document
+    (its ordinal is below the consuming host's recorded position).
+    Unconsumed stragglers are re-dealt round-robin over the new world by
+    a fresh ``taken % M`` counter. Tables chain, so repeated reshapes
+    (4 → 2 → 3 hosts) stay exact; a table is dropped once the stream
+    passes its maximum recorded position (it can never exclude again)."""
 
     def __init__(
         self,
@@ -208,20 +222,82 @@ class SeekableShuffledSource:
         self.shard_ptr = 0
         self.doc_ptr = 0
         self.emitted = 0  # global counter driving the host filter
+        # Assignment ordinal: count of documents not excluded by a past
+        # world's table. Equal to ``emitted`` on fresh (non-remapped)
+        # runs, so the fresh-run take rule is bit-identical to before.
+        self.taken = 0
+        # Exclusion tables from past worlds (see class docstring); each is
+        # {"world": N, "positions": [emitted_i], "taken": ordinal}.
+        self._tables: List[Dict[str, Any]] = []
 
-    def state_dict(self) -> Dict[str, int]:
-        return {
+    def state_dict(self) -> Dict[str, Any]:
+        state: Dict[str, Any] = {
             "epoch": self.epoch,
             "shard_ptr": self.shard_ptr,
             "doc_ptr": self.doc_ptr,
             "emitted": self.emitted,
+            "taken": self.taken,
+            "process_count": self.process_count,
+            "process_index": self.process_index,
         }
+        if self._tables:
+            state["tables"] = [dict(t) for t in self._tables]
+        return state
 
-    def load_state_dict(self, state: Dict[str, int]) -> None:
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        snap_count = state.get("process_count")
+        snap_index = state.get("process_index")
+        if snap_count is not None and int(snap_count) != self.process_count:
+            raise ValueError(
+                f"data snapshot world mismatch: snapshot has "
+                f"process_count={int(snap_count)} but this source runs with "
+                f"process_count={self.process_count}; remap it with "
+                f"data.streaming.remap_seekable_states (or "
+                f"remap_data_states) instead of loading it directly — a "
+                f"direct load would skip or double-consume documents")
+        if (snap_index is not None and snap_count is not None
+                and int(snap_count) == self.process_count
+                and int(snap_index) != self.process_index):
+            raise ValueError(
+                f"data snapshot host mismatch: snapshot process_index="
+                f"{int(snap_index)} loaded on process_index="
+                f"{self.process_index} (process_count={self.process_count})")
         self.epoch = int(state.get("epoch", 0))
         self.shard_ptr = int(state.get("shard_ptr", 0))
         self.doc_ptr = int(state.get("doc_ptr", 0))
         self.emitted = int(state.get("emitted", 0))
+        self.taken = int(state.get("taken", self.emitted))
+        self._tables = [
+            {"world": int(t["world"]),
+             "positions": [int(p) for p in t["positions"]],
+             "taken": int(t["taken"])}
+            for t in (state.get("tables") or [])
+        ]
+
+    def _take_next(self) -> bool:
+        """Advance the stream by one document ordinal; True when this host
+        consumes it. Pure counter arithmetic — every host of the new world
+        evaluates the exclusion tables identically, so the partition of
+        surviving documents over hosts is deterministic and disjoint."""
+        x = self.emitted
+        consumed = False
+        for t in self._tables:
+            if consumed:
+                break
+            i = t["taken"] % t["world"]
+            t["taken"] += 1
+            if x < t["positions"][i]:
+                consumed = True  # the old world already trained on doc x
+        take = False
+        if not consumed:
+            take = self.taken % self.process_count == self.process_index
+            self.taken += 1
+        self.emitted += 1
+        if self._tables and all(
+                x >= max(t["positions"]) for t in self._tables):
+            # Past every recorded position: no table can exclude again.
+            self._tables = []
+        return take
 
     def _shard_order(self, epoch: int) -> np.ndarray:
         return np.random.default_rng((self.seed, epoch)).permutation(len(self.shards))
@@ -238,9 +314,8 @@ class SeekableShuffledSource:
                 order = self._doc_order(self.epoch, self.shard_ptr, len(docs))
                 while self.doc_ptr < len(docs):
                     idx = int(order[self.doc_ptr])
-                    take = self.emitted % self.process_count == self.process_index
+                    take = self._take_next()
                     self.doc_ptr += 1
-                    self.emitted += 1
                     if take:
                         yield docs[idx]
                 self.doc_ptr = 0
@@ -671,15 +746,30 @@ class StreamingDataManager:
     # -- checkpoint state ----------------------------------------------------
     def state_dict(self) -> Dict[str, Any]:
         """Snapshot of the state as of the last *served* batch (not the last
-        produced one — prefetched batches in the queue don't count)."""
+        produced one — prefetched batches in the queue don't count). The
+        snapshot is stamped with the world it was taken under
+        (``process_count``/``process_index``) so a resume under a different
+        world is detected instead of silently double-consuming documents."""
         if self._last_snapshot is not None:
             out = dict(self._last_snapshot)
             if isinstance(out.get("buf"), np.ndarray):
                 out["buf"] = out["buf"].tolist()
-            return out
-        return {"docs_consumed": self.docs_consumed}
+        else:
+            out = {"docs_consumed": self.docs_consumed}
+        out.setdefault("process_count", self.process_count)
+        out.setdefault("process_index", self.process_index)
+        return out
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
+        snap_count = state.get("process_count")
+        if snap_count is not None and int(snap_count) != self.process_count:
+            raise ValueError(
+                f"data snapshot world mismatch: snapshot keys "
+                f"process_count={int(snap_count)}/process_index="
+                f"{state.get('process_index')} vs this manager's "
+                f"process_count={self.process_count}/process_index="
+                f"{self.process_index}; pass all hosts' snapshots through "
+                f"data.streaming.remap_data_states first")
         if "source" in state or "hf" in state:
             self._resume_state = dict(state)
             # If the hf source turns out not to support the state API
@@ -688,6 +778,133 @@ class StreamingDataManager:
             self._skip_docs = int(state.get("docs_consumed", 0)) if "hf" in state else 0
         else:
             self._skip_docs = int(state.get("docs_consumed", 0))
+
+
+# -- elastic world remapping ----------------------------------------------
+
+
+def _check_world_states(states: List[Dict[str, Any]], what: str,
+                        count_key: str = "process_count",
+                        index_key: str = "process_index") -> List[Dict[str, Any]]:
+    """Validate that ``states`` is one complete world: every snapshot
+    stamped, stamps agree, indices exactly 0..N-1. Returns them sorted by
+    process index; raises ValueError naming the offending keys."""
+    if not states:
+        raise ValueError(f"remap needs at least one {what} snapshot")
+    n = len(states)
+    for s in states:
+        if count_key not in s or index_key not in s:
+            raise ValueError(
+                f"{what} snapshot lacks '{count_key}'/'{index_key}' keys — "
+                f"it predates world stamping and cannot be remapped safely")
+        if int(s[count_key]) != n:
+            raise ValueError(
+                f"{what} snapshots disagree with the set size: "
+                f"'{count_key}'={int(s[count_key])} but {n} snapshot(s) "
+                f"were provided — pass every host's snapshot of ONE world")
+    ordered = sorted(states, key=lambda s: int(s[index_key]))
+    indices = [int(s[index_key]) for s in ordered]
+    if indices != list(range(n)):
+        raise ValueError(
+            f"{what} snapshots are not one complete world: "
+            f"'{index_key}' values {indices} != {list(range(n))}")
+    return ordered
+
+
+def remap_seekable_states(
+    states: List[Dict[str, Any]], new_index: int, new_count: int,
+) -> Dict[str, Any]:
+    """Remap one complete old world's :class:`SeekableShuffledSource`
+    snapshots (``process_count=N``) to the state for host ``new_index`` of
+    a ``new_count=M`` world, with zero skipped and zero replayed
+    documents.
+
+    The new stream restarts at the *least advanced* old host's position;
+    everything any old host consumed beyond that point is encoded as an
+    exclusion table (see :class:`SeekableShuffledSource`) that the new
+    world's take rule replays deterministically. Chained reshapes stay
+    exact because the base host's own tables ride along.
+    """
+    ordered = _check_world_states(states, "SeekableShuffledSource")
+    n = len(ordered)
+    if not (0 <= int(new_index) < int(new_count)):
+        raise ValueError(
+            f"new_index {new_index} out of range for new_count {new_count}")
+    if n == int(new_count):
+        out = dict(ordered[int(new_index)])
+        return out
+    base = min(ordered, key=lambda s: int(s["emitted"]))
+    positions = [int(s["emitted"]) for s in ordered]
+    tables = [
+        {"world": int(t["world"]),
+         "positions": [int(p) for p in t["positions"]],
+         "taken": int(t["taken"])}
+        for t in (base.get("tables") or [])
+    ]
+    tables.append({
+        "world": n,
+        "positions": positions,
+        "taken": int(base.get("taken", base["emitted"])),
+    })
+    return {
+        "epoch": int(base.get("epoch", 0)),
+        "shard_ptr": int(base.get("shard_ptr", 0)),
+        "doc_ptr": int(base.get("doc_ptr", 0)),
+        "emitted": int(base["emitted"]),
+        "taken": 0,
+        "tables": tables,
+        "process_count": int(new_count),
+        "process_index": int(new_index),
+    }
+
+
+def remap_data_states(
+    states: List[Dict[str, Any]], new_index: int, new_count: int,
+) -> Dict[str, Any]:
+    """Remap one complete old world's :class:`StreamingDataManager`
+    snapshots to host ``new_index`` of a ``new_count`` world.
+
+    Only seekable-source snapshots (``"source"`` key) are remappable: the
+    take rule is replayed via exclusion tables and the leftover token
+    buffers are re-dealt round-robin (old host ``i``'s buffer goes to new
+    host ``i % new_count`` — deterministic and disjoint; buffers hold
+    token remainders of documents the old world already consumed, so no
+    document is skipped or replayed). HF-streaming snapshots (``"hf"``)
+    carry datasets-library-native state bound to the world that wrote
+    them and are refused with a named-key error.
+    """
+    ordered = _check_world_states(states, "StreamingDataManager")
+    n = len(ordered)
+    if n == int(new_count):
+        return dict(ordered[int(new_index)])
+    for s in ordered:
+        if "hf" in s:
+            raise ValueError(
+                f"cannot remap 'hf' data snapshot (process_index="
+                f"{s.get('process_index')}) from process_count={n} to "
+                f"{new_count}: datasets-native stream state is bound to "
+                f"the world that wrote it; restart the stream or resume "
+                f"with the original process count")
+        if "source" not in s:
+            raise ValueError(
+                f"cannot remap data snapshot (process_index="
+                f"{s.get('process_index')}) without a 'source' key from "
+                f"process_count={n} to {new_count}: only seekable-source "
+                f"snapshots support exact cross-world resume")
+    source = remap_seekable_states(
+        [s["source"] for s in ordered], new_index, new_count)
+    buf: List[int] = []
+    for i, s in enumerate(ordered):
+        if i % int(new_count) == int(new_index):
+            buf.extend(int(v) for v in (s.get("buf") or []))
+    total_docs = sum(int(s.get("docs_consumed", 0)) for s in ordered)
+    return {
+        "docs_consumed": total_docs // int(new_count),
+        "buf": buf,
+        "source": source,
+        "process_count": int(new_count),
+        "process_index": int(new_index),
+    }
 
 
 def build_data_manager(

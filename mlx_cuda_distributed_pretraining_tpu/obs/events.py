@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import time
 from typing import Any, Callable, Dict, Iterator, Optional
 
@@ -48,8 +49,35 @@ def events_path(run_dir: str) -> str:
     return os.path.join(run_dir, EVENTS_FILENAME)
 
 
-def heartbeat_path(run_dir: str) -> str:
+def heartbeat_path(run_dir: str, process_index: int = 0) -> str:
+    """Per-host heartbeat file. Process 0 keeps the legacy
+    ``heartbeat.json`` name (single-host tooling and the PR 3 supervisor
+    already watch it); other hosts get ``heartbeat_p<idx>.json``."""
+    if process_index:
+        return os.path.join(run_dir, f"heartbeat_p{int(process_index)}.json")
     return os.path.join(run_dir, HEARTBEAT_FILENAME)
+
+
+def read_fleet_heartbeats(run_dir: str) -> Dict[int, Dict[str, Any]]:
+    """All per-host heartbeats of a run dir, keyed by process index
+    (``heartbeat.json`` maps to 0) — lets a watchdog attribute a fleet
+    stall to the host that stopped beating."""
+    out: Dict[int, Dict[str, Any]] = {}
+    hb = read_heartbeat(os.path.join(run_dir, HEARTBEAT_FILENAME))
+    if hb is not None:
+        out[0] = hb
+    try:
+        names = os.listdir(run_dir)
+    except OSError:
+        names = []
+    for name in names:
+        m = re.match(r"heartbeat_p(\d+)\.json$", name)
+        if not m:
+            continue
+        hb = read_heartbeat(os.path.join(run_dir, name))
+        if hb is not None:
+            out[int(m.group(1))] = hb
+    return out
 
 
 class EventLog:
@@ -182,13 +210,16 @@ def tally(path: str) -> Dict[str, float]:
 # -- heartbeat ------------------------------------------------------------
 
 
-def write_heartbeat(path: str, step: int, pid: Optional[int] = None) -> None:
-    """Atomically replace the heartbeat file: {t, step, pid}. The watchdog
-    must never read a torn heartbeat, hence temp + os.replace (same
-    pattern as checkpoint/manager._atomic_json)."""
+def write_heartbeat(path: str, step: int, pid: Optional[int] = None,
+                    process_index: Optional[int] = None) -> None:
+    """Atomically replace the heartbeat file: {t, step, pid[,
+    process_index]}. The watchdog must never read a torn heartbeat, hence
+    temp + os.replace (same pattern as checkpoint/manager._atomic_json)."""
     tmp = path + ".tmp"
     payload = {"t": time.time(), "step": int(step),
                "pid": int(pid if pid is not None else os.getpid())}
+    if process_index is not None:
+        payload["process_index"] = int(process_index)
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump(payload, f)
         f.flush()

@@ -14,7 +14,26 @@ import os
 import time
 from typing import Callable, Dict, List, Optional
 
+from .events import read_fleet_heartbeats
 from .plotting import STEP_RE, VAL_RE, KV_RE, parse_value, plot_run
+
+
+def fleet_status(run_dir: str, now: Optional[float] = None) -> str:
+    """One-line per-host heartbeat summary for a multi-host run:
+    ``hosts p0:s12(0.4s) p1:s12(0.6s)`` — step and heartbeat age per
+    process index. Empty string when the run writes no per-host
+    heartbeats (single-host runs keep the plain status line)."""
+    fleet = read_fleet_heartbeats(run_dir)
+    if len(fleet) < 2:
+        return ""
+    now = time.time() if now is None else now
+    bits = []
+    for idx in sorted(fleet):
+        hb = fleet[idx]
+        age = max(0.0, now - float(hb.get("t", 0.0) or 0.0))
+        step = hb.get("step")
+        bits.append(f"p{idx}:s{step if step is not None else '?'}({age:.1f}s)")
+    return "hosts " + " ".join(bits)
 
 
 def find_latest_run(runs_root: str = "runs") -> Optional[str]:
@@ -116,7 +135,9 @@ def monitor(
     try:
         while max_iters is None or i < max_iters:
             if tailer.poll():
-                emit(tailer.status_line())
+                line = tailer.status_line()
+                fleet = fleet_status(run_dir)
+                emit(f"{line} | {fleet}" if fleet else line)
                 if plot_every and len(tailer.steps) % plot_every == 0:
                     try:
                         plot_run(run_dir)

@@ -1,7 +1,21 @@
+from .elastic import (
+    BarrierTimeoutError,
+    RendezvousError,
+    fleet_restart_requested,
+    generation_barrier,
+    latest_generation,
+    process_barrier,
+    record_membership,
+    rendezvous,
+    request_fleet_restart,
+)
 from .mesh import build_mesh, build_serve_mesh, mesh_axis_sizes, parse_mesh_spec
 from .sharding_rules import batch_pspec, param_pspec, state_sharding, tree_pspecs
 
 __all__ = [
     "build_mesh", "build_serve_mesh", "mesh_axis_sizes", "parse_mesh_spec",
     "batch_pspec", "param_pspec", "state_sharding", "tree_pspecs",
+    "BarrierTimeoutError", "RendezvousError", "fleet_restart_requested",
+    "generation_barrier", "latest_generation", "process_barrier",
+    "record_membership", "rendezvous", "request_fleet_restart",
 ]

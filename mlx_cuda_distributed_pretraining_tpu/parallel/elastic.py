@@ -1,0 +1,445 @@
+"""Elastic multi-host coordination: rendezvous, generations, barriers.
+
+The reference paper's multi-node story is a hand-rolled coordinator /
+worker heartbeat plane (reference: distributed/worker.py /register,
+/get_task, /heartbeat polling). The JAX-native equivalent has two
+halves, and this module is the glue between them:
+
+1. **Rendezvous** — :func:`rendezvous` wraps
+   ``jax.distributed.initialize`` with the semantics a preemptible fleet
+   actually needs: per-attempt timeout, bounded retry with exponential
+   backoff under an overall deadline, loud logging of every failed
+   attempt, and a hard :class:`RendezvousError` when a coordinator was
+   explicitly configured — a half-initialized world must never fall
+   through to N independent single-host runs clobbering one run dir.
+
+2. **Generations** — every (re)launch of the fleet is a *generation*:
+   a monotonically increasing epoch of the world stamped into
+   ``<run_dir>/.elastic/``. Hosts record membership
+   (:func:`record_membership`), synchronize restarts through a
+   file-based :func:`generation_barrier` (bounded by a timeout so a
+   surviving host never hangs forever on a dead peer), and signal each
+   other through restart markers (:func:`request_fleet_restart`) so one
+   host's crash turns into a coordinated fleet restart within one
+   supervisor poll interval instead of a hang-watchdog timeout.
+
+Everything here is plain files under the shared run dir — the same
+durability substrate the checkpoint manifests and events.jsonl already
+rely on — so it works identically for N processes on one machine
+(tests, chaos harness) and N hosts on NFS/GCS-fuse.
+
+Deadlines use ``time.monotonic``; ``time.time`` appears only in record
+timestamps (calendar metadata, never subtracted).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import socket
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+ELASTIC_DIRNAME = ".elastic"
+ELASTIC_GENERATION_ENV = "ELASTIC_GENERATION"
+
+_GEN_FILE_RE = re.compile(r"gen_(\d+)_p(\d+)\.json$")
+
+
+class RendezvousError(RuntimeError):
+    """Explicitly configured multi-host rendezvous failed for good."""
+
+
+class BarrierTimeoutError(RuntimeError):
+    """A generation barrier timed out waiting on missing peers."""
+
+
+# -- rendezvous ------------------------------------------------------------
+
+
+def _already_initialized() -> bool:
+    """True when jax.distributed.initialize already ran in this process
+    (calling it twice raises)."""
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+    except Exception:
+        return False
+
+
+def _enable_cpu_collectives(log: Callable[[str], None]) -> None:
+    """Give the CPU backend a cross-process collectives implementation.
+
+    jax's CPU backend defaults to ``jax_cpu_collectives_implementation
+    = "none"``: the rendezvous itself succeeds, but the first computation
+    (or ``device_put``) touching a process-spanning sharding dies with
+    "Multiprocess computations aren't implemented on the CPU backend".
+    Switch it to gloo BEFORE the backend initializes. Respects an explicit
+    user choice (env var or a non-default config value); no-op on TPU/GPU
+    platforms and on jax builds without the option.
+    """
+    import jax
+
+    platforms = (os.environ.get("JAX_PLATFORMS")
+                 or getattr(jax.config, "jax_platforms", None) or "")
+    if str(platforms).split(",")[0].strip().lower() != "cpu":
+        return
+    if os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION"):
+        return
+    try:
+        if jax.config._read("jax_cpu_collectives_implementation") != "none":
+            return  # explicit user setting: keep it
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        log("[elastic] CPU backend: enabled gloo cross-process collectives")
+    except Exception as e:  # option renamed/gone: rendezvous still works
+        log(f"[elastic] could not enable gloo CPU collectives "
+            f"({type(e).__name__}: {e}); multi-process CPU computations "
+            f"may fail")
+
+
+def rendezvous(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    *,
+    timeout_s: float = 120.0,
+    attempt_timeout_s: float = 30.0,
+    backoff_base: float = 1.0,
+    backoff_max: float = 15.0,
+    log: Callable[[str], None] = print,
+    _initialize: Optional[Callable[..., None]] = None,
+) -> bool:
+    """Join the multi-host world; returns True when multi-process.
+
+    Explicit mode (a coordinator address was given, as an argument or via
+    ``JAX_COORDINATOR_ADDRESS``): retry failed attempts with exponential
+    backoff until ``timeout_s`` elapses, logging each failure, then raise
+    :class:`RendezvousError`. Each attempt gets at most
+    ``attempt_timeout_s`` (capped by the remaining deadline) so one stuck
+    attempt cannot eat the whole budget.
+
+    Auto mode (no coordinator anywhere): a single best-effort attempt —
+    on TPU pods ``jax.distributed.initialize()`` auto-detects everything
+    from the metadata server; anywhere else it fails, which is logged
+    (not swallowed) and means single-process.
+    """
+    import jax
+
+    if _initialize is None:
+        _initialize = jax.distributed.initialize
+
+    coordinator = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None:
+        env_n = os.environ.get("JAX_NUM_PROCESSES")
+        num_processes = int(env_n) if env_n else None
+    if process_id is None:
+        env_p = os.environ.get("JAX_PROCESS_ID")
+        process_id = int(env_p) if env_p else None
+
+    if _already_initialized():
+        return jax.process_count() > 1
+
+    if coordinator and int(num_processes or 1) > 1:
+        # Only when actually joining a multi-process world: a gloo CPU
+        # backend without a distributed client fails to initialize, so a
+        # single-process run must never flip the switch.
+        _enable_cpu_collectives(log)
+
+    if not coordinator:
+        try:
+            _initialize()  # TPU pod auto-detection
+        except (ValueError, RuntimeError, TimeoutError, OSError) as e:
+            log(f"[elastic] no coordinator configured and auto-detection "
+                f"failed ({type(e).__name__}: {e}); continuing single-process")
+            return False
+        return jax.process_count() > 1
+
+    kwargs: Dict[str, Any] = {
+        "coordinator_address": coordinator,
+        "num_processes": int(num_processes if num_processes is not None else 1),
+        "process_id": int(process_id if process_id is not None else 0),
+    }
+    deadline = time.monotonic() + max(0.0, float(timeout_s))
+    attempt = 0
+    last_exc: Optional[BaseException] = None
+    while True:
+        attempt += 1
+        remaining = deadline - time.monotonic()
+        per_attempt = max(1, int(min(attempt_timeout_s,
+                                     max(1.0, remaining))))
+        try:
+            try:
+                _initialize(initialization_timeout=per_attempt, **kwargs)
+            except TypeError:
+                # Older jax / test stubs without the timeout kwarg.
+                _initialize(**kwargs)
+            log(f"[elastic] rendezvous ok: process "
+                f"{kwargs['process_id']}/{kwargs['num_processes']} via "
+                f"{coordinator} (attempt {attempt})")
+            return True
+        except (ValueError, RuntimeError, TimeoutError, OSError) as e:
+            last_exc = e
+            remaining = deadline - time.monotonic()
+            log(f"[elastic] rendezvous attempt {attempt} failed "
+                f"({type(e).__name__}: {e}); "
+                f"{max(0.0, remaining):.1f}s left of {timeout_s:g}s budget")
+            if remaining <= 0:
+                break
+            delay = min(float(backoff_max),
+                        float(backoff_base) * (2.0 ** (attempt - 1)),
+                        max(0.0, remaining))
+            time.sleep(delay)
+            if time.monotonic() >= deadline:
+                break
+    raise RendezvousError(
+        f"could not rendezvous with coordinator {coordinator} as process "
+        f"{kwargs['process_id']}/{kwargs['num_processes']} after {attempt} "
+        f"attempt(s) over {timeout_s:g}s: "
+        f"{type(last_exc).__name__}: {last_exc}") from last_exc
+
+
+def process_barrier(
+    name: str,
+    timeout_s: float = 120.0,
+    log: Callable[[str], None] = print,
+) -> bool:
+    """Block until every process in the jax.distributed world reaches the
+    barrier ``name``, via the coordination service (plain RPC — no device
+    collectives, so it is safe before any backend or mesh work, e.g. to
+    order the chief's destructive run-dir setup before peer writes).
+    No-op returning True outside a multi-process world; returns False
+    (after logging) if the coordination service rejects the wait, leaving
+    the caller to proceed unsynchronized rather than crash.
+    """
+    try:
+        from jax._src import distributed as _dist
+
+        state = _dist.global_state
+        client = getattr(state, "client", None)
+        if client is None or int(getattr(state, "num_processes", 1) or 1) <= 1:
+            return True
+        client.wait_at_barrier(name, timeout_in_ms=int(timeout_s * 1000))
+        return True
+    except Exception as e:
+        log(f"[elastic] process barrier {name!r} failed "
+            f"({type(e).__name__}: {e}); continuing without sync")
+        return False
+
+
+# -- generation bookkeeping ------------------------------------------------
+
+
+def elastic_dir(run_dir: str) -> str:
+    return os.path.join(run_dir, ELASTIC_DIRNAME)
+
+
+def _atomic_write_json(path: str, obj: Dict[str, Any]) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(obj, f, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _read_json(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            obj = json.load(f)
+        return obj if isinstance(obj, dict) else None
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None
+
+
+def latest_generation(run_dir: str) -> int:
+    """Highest generation number stamped anywhere under ``.elastic/``
+    (membership files, barrier files, the membership record, restart
+    markers); 0 when the run has never had one."""
+    root = elastic_dir(run_dir)
+    best = 0
+    for sub in ("members", "barrier"):
+        try:
+            names = os.listdir(os.path.join(root, sub))
+        except OSError:
+            names = []
+        for name in names:
+            m = _GEN_FILE_RE.search(name)
+            if m:
+                best = max(best, int(m.group(1)))
+    rec = _read_json(os.path.join(root, "membership.json"))
+    if rec and isinstance(rec.get("generation"), int):
+        best = max(best, rec["generation"])
+    for path in glob.glob(os.path.join(root, "restart_gen*.json")):
+        m = re.search(r"restart_gen(\d+)\.json$", path)
+        if m:
+            best = max(best, int(m.group(1)))
+    return best
+
+
+def record_membership(
+    run_dir: str,
+    generation: Optional[int] = None,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+    timeout_s: float = 60.0,
+    log: Optional[Callable[[str], None]] = None,
+) -> Dict[str, Any]:
+    """Stamp this process into the generation's membership record.
+
+    Every process atomically writes
+    ``.elastic/members/gen_<g>_p<idx>.json``; the chief then waits (up to
+    ``timeout_s``) for all ``process_count`` files and writes the
+    consolidated ``membership.json`` so every host — and every post-run
+    reader — agrees which epoch of the world this launch was.
+
+    The generation comes from the ``ELASTIC_GENERATION`` env var (set by
+    the multi-host supervisor for its children) when present, else
+    ``latest_generation + 1``; when the world is live the candidates are
+    max-reduced over hosts via ``process_allgather`` so clock/scan skew
+    cannot split the fleet across two generations.
+    """
+    import jax
+
+    emit = log or (lambda m: None)
+    if process_index is None:
+        process_index = jax.process_index()
+    if process_count is None:
+        process_count = jax.process_count()
+
+    if generation is None:
+        env_gen = os.environ.get(ELASTIC_GENERATION_ENV)
+        candidate = int(env_gen) if env_gen else latest_generation(run_dir) + 1
+        if process_count > 1:
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            agreed = multihost_utils.process_allgather(np.int64(candidate))
+            generation = int(np.max(agreed))
+        else:
+            generation = candidate
+
+    local = {
+        "generation": int(generation),
+        "process_index": int(process_index),
+        "process_count": int(process_count),
+        "host": socket.gethostname(),
+        "pid": os.getpid(),
+        "local_devices": jax.local_device_count(),
+        "t": time.time(),
+    }
+    members_dir = os.path.join(elastic_dir(run_dir), "members")
+    _atomic_write_json(
+        os.path.join(members_dir, f"gen_{generation}_p{process_index}.json"),
+        local)
+
+    if process_index == 0:
+        deadline = time.monotonic() + max(0.0, float(timeout_s))
+        members: List[Dict[str, Any]] = []
+        while True:
+            members = []
+            for i in range(process_count):
+                rec = _read_json(os.path.join(
+                    members_dir, f"gen_{generation}_p{i}.json"))
+                if rec is not None:
+                    members.append(rec)
+            if len(members) >= process_count or time.monotonic() >= deadline:
+                break
+            time.sleep(0.1)
+        if len(members) < process_count:
+            emit(f"[elastic] membership gen {generation}: only "
+                 f"{len(members)}/{process_count} hosts recorded within "
+                 f"{timeout_s:g}s; writing partial record")
+        record = {
+            "generation": int(generation),
+            "process_count": int(process_count),
+            "recorded_at": time.time(),
+            "members": sorted(members, key=lambda m: m["process_index"]),
+        }
+        _atomic_write_json(
+            os.path.join(elastic_dir(run_dir), "membership.json"), record)
+        return record
+    return {"generation": int(generation), "process_count": int(process_count),
+            "members": [local]}
+
+
+def read_membership(run_dir: str) -> Optional[Dict[str, Any]]:
+    return _read_json(os.path.join(elastic_dir(run_dir), "membership.json"))
+
+
+# -- generation barrier ----------------------------------------------------
+
+
+def generation_barrier(
+    run_dir: str,
+    generation: int,
+    process_index: int,
+    process_count: int,
+    timeout_s: float = 300.0,
+    poll_s: float = 0.25,
+    log: Optional[Callable[[str], None]] = None,
+) -> None:
+    """File-based barrier: block until every process of ``generation`` has
+    arrived, or raise :class:`BarrierTimeoutError` naming the missing
+    process indices. The barrier must be *bounded*: a host that survived a
+    peer's death would otherwise wait forever on a file that will never
+    appear."""
+    emit = log or (lambda m: None)
+    barrier_dir = os.path.join(elastic_dir(run_dir), "barrier")
+    _atomic_write_json(
+        os.path.join(barrier_dir, f"gen_{generation}_p{process_index}.json"),
+        {"generation": int(generation), "process_index": int(process_index),
+         "pid": os.getpid(), "t": time.time()})
+    deadline = time.monotonic() + max(0.0, float(timeout_s))
+    while True:
+        missing = [
+            i for i in range(process_count)
+            if not os.path.isfile(os.path.join(
+                barrier_dir, f"gen_{generation}_p{i}.json"))
+        ]
+        if not missing:
+            emit(f"[elastic] barrier gen {generation}: all "
+                 f"{process_count} processes arrived")
+            return
+        if time.monotonic() >= deadline:
+            raise BarrierTimeoutError(
+                f"generation {generation} barrier timed out after "
+                f"{timeout_s:g}s: missing process(es) {missing} of "
+                f"{process_count}")
+        time.sleep(max(0.02, float(poll_s)))
+
+
+# -- fleet restart markers -------------------------------------------------
+
+
+def restart_marker_path(run_dir: str, generation: int) -> str:
+    return os.path.join(elastic_dir(run_dir), f"restart_gen{generation}.json")
+
+
+def request_fleet_restart(
+    run_dir: str, generation: int, process_index: int, reason: str,
+) -> None:
+    """Signal peers that generation ``generation`` is over (this host's
+    child died / was preempted) so their supervisors stop their own
+    children and meet at the next generation barrier. Idempotent: the
+    first writer wins, later requests for the same generation are
+    no-ops."""
+    path = restart_marker_path(run_dir, generation)
+    if os.path.isfile(path):
+        return
+    _atomic_write_json(path, {
+        "generation": int(generation),
+        "process_index": int(process_index),
+        "reason": str(reason),
+        "t": time.time(),
+    })
+
+
+def fleet_restart_requested(
+    run_dir: str, generation: int,
+) -> Optional[Dict[str, Any]]:
+    """The restart marker for ``generation``, or None."""
+    return _read_json(restart_marker_path(run_dir, generation))

@@ -7,6 +7,11 @@ TPU-native model: every host runs THE SAME program;
 ``jax.distributed.initialize`` performs the DCN rendezvous; data is sharded
 per host by ``process_index``; XLA moves all tensor traffic over ICI.
 
+The rendezvous itself lives in :mod:`.elastic` — bounded retry with
+backoff, loud failure when a coordinator was explicitly configured, and
+a logged (never silently swallowed) fallback to single-process when
+auto-detection finds no pod environment.
+
 Usage on each host of a pod (or with TPU env auto-detection, no args):
 
     python -m mlx_cuda_distributed_pretraining_tpu.parallel.launch \
@@ -17,37 +22,36 @@ Usage on each host of a pod (or with TPU env auto-detection, no args):
 from __future__ import annotations
 
 import argparse
-import os
+import sys
 from typing import Optional
+
+from .elastic import RendezvousError, rendezvous
 
 
 def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    rendezvous_timeout_s: float = 120.0,
+    log=lambda m: print(m, file=sys.stderr),
 ) -> bool:
-    """Best-effort ``jax.distributed.initialize``. On TPU pods all arguments
-    auto-detect from the metadata server; explicit args support CPU/GPU
-    clusters and tests. Returns True when multi-process mode is active."""
-    import jax
+    """``jax.distributed.initialize`` with real rendezvous semantics.
 
-    explicit = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
-    if explicit:
-        # An explicitly requested multi-process rendezvous must fail FAST on
-        # error — falling back to N independent single-host runs would have
-        # every host train solo and clobber the same run dir.
-        jax.distributed.initialize(
-            coordinator_address=explicit,
-            num_processes=num_processes or int(os.environ.get("JAX_NUM_PROCESSES", "1")),
-            process_id=process_id if process_id is not None
-            else int(os.environ.get("JAX_PROCESS_ID", "0")),
-        )
-        return jax.process_count() > 1
-    try:
-        jax.distributed.initialize()  # TPU pod auto-detection
-    except (ValueError, RuntimeError):
-        return False  # single-host fallback: not an error for 1-process runs
-    return jax.process_count() > 1
+    An explicitly requested multi-process rendezvous (argument or
+    ``JAX_COORDINATOR_ADDRESS``) retries with backoff under
+    ``rendezvous_timeout_s`` and then raises :class:`RendezvousError` —
+    falling back to N independent single-host runs would have every host
+    train solo and clobber the same run dir. Auto-detection failures are
+    logged and return False (single-host is not an error for 1-process
+    runs). Returns True when multi-process mode is active.
+    """
+    return rendezvous(
+        coordinator_address,
+        num_processes,
+        process_id,
+        timeout_s=rendezvous_timeout_s,
+        log=log,
+    )
 
 
 def main(argv=None):
@@ -57,9 +61,13 @@ def main(argv=None):
     parser.add_argument("--coordinator", default=None, help="host:port of process 0")
     parser.add_argument("--num-processes", type=int, default=None)
     parser.add_argument("--process-id", type=int, default=None)
+    parser.add_argument("--rendezvous-timeout-s", type=float, default=120.0,
+                        help="overall deadline for the coordinator rendezvous "
+                             "(retries with backoff inside it)")
     args, extra = parser.parse_known_args(argv)
 
-    initialize_distributed(args.coordinator, args.num_processes, args.process_id)
+    initialize_distributed(args.coordinator, args.num_processes,
+                           args.process_id, args.rendezvous_timeout_s)
 
     import jax
 

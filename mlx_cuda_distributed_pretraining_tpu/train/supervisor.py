@@ -32,10 +32,24 @@ and the normal restart loop resumes from the newest verified
 checkpoint. A hang is treated as a crash even when the SIGTERM lets the
 child save-and-exit-0: returning "completed cleanly" for a run that
 stalled mid-training would end supervision with the job unfinished.
+
+Multi-host mode (``process_count > 1``): each host runs ONE supervisor
+over its own trainer process; the fleet coordinates restarts through the
+shared run dir (parallel/elastic.py). Every fleet (re)launch is a
+*generation*: supervisors meet at a bounded file barrier before
+spawning (a surviving host never hangs forever on a dead peer — the
+barrier raises after ``barrier_timeout_s``), children rendezvous via
+``jax.distributed`` on a per-generation coordinator port, and a crashed
+host drops a restart marker so its peers SIGTERM their own (soon to be
+collective-stuck) children within one watchdog poll instead of waiting
+out a hang timeout — that marker path is what keeps ``restart_lost_s``
+in seconds. Only the chief (process 0) appends ``restart`` events, so
+the goodput ledger books each generation's lost wall clock once.
 """
 
 from __future__ import annotations
 
+import inspect
 import os
 import signal
 import subprocess
@@ -45,11 +59,39 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from ..checkpoint.manager import CheckpointManager
-from ..obs.events import append_event, events_path, heartbeat_path, read_heartbeat
+from ..obs.events import (
+    append_event,
+    events_path,
+    heartbeat_path,
+    read_fleet_heartbeats,
+    read_heartbeat,
+)
+from ..parallel.elastic import (
+    BarrierTimeoutError,
+    ELASTIC_GENERATION_ENV,
+    fleet_restart_requested,
+    generation_barrier,
+    latest_generation,
+    request_fleet_restart,
+)
 
 
 class CrashLoopError(RuntimeError):
     """The child kept crashing without making checkpoint progress."""
+
+
+def _wants_generation(build_cmd: Callable[..., List[str]]) -> bool:
+    """True when ``build_cmd`` accepts a second (generation) argument.
+    Single-parameter builders — every pre-elastic caller and most tests —
+    keep working unchanged."""
+    try:
+        params = [p for p in inspect.signature(build_cmd).parameters.values()
+                  if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+        return len(params) >= 2 or any(
+            p.kind == p.VAR_POSITIONAL
+            for p in inspect.signature(build_cmd).parameters.values())
+    except (TypeError, ValueError):
+        return False
 
 
 class Supervisor:
@@ -58,12 +100,15 @@ class Supervisor:
     ``build_cmd(resume_tag)`` returns the child argv for a launch that
     should resume from ``resume_tag`` (a verified step tag, or None for a
     fresh start) — injected so tests can drive the loop with stub
-    children and so the CLI glue below owns the real trainer command.
+    children and so the CLI glue below owns the real trainer command. A
+    two-parameter builder (``build_cmd(resume_tag, generation)``) also
+    receives the fleet generation of the launch (multi-host mode needs it
+    to pick a fresh per-generation coordinator port).
     """
 
     def __init__(
         self,
-        build_cmd: Callable[[Optional[str]], List[str]],
+        build_cmd: Callable[..., List[str]],
         run_dir: str,
         max_crashes_per_step: int = 3,
         backoff_base: float = 2.0,
@@ -73,6 +118,9 @@ class Supervisor:
         env: Optional[Dict[str, str]] = None,
         hang_timeout_s: float = 0.0,
         hang_kill_grace_s: float = 20.0,
+        process_index: int = 0,
+        process_count: int = 1,
+        barrier_timeout_s: float = 300.0,
     ):
         self.build_cmd = build_cmd
         self.run_dir = run_dir
@@ -84,16 +132,29 @@ class Supervisor:
         self.env = env
         self.hang_timeout_s = float(hang_timeout_s or 0.0)
         self.hang_kill_grace_s = float(hang_kill_grace_s)
-        self.heartbeat_file = heartbeat_path(run_dir)
+        self.process_index = int(process_index)
+        self.process_count = max(1, int(process_count))
+        self.barrier_timeout_s = float(barrier_timeout_s)
+        self.heartbeat_file = heartbeat_path(run_dir, self.process_index)
         self.events_file = events_path(run_dir)
         self.restarts = 0
         self.hangs = 0
+        # Fleet generation of the CURRENT launch. 0 = not launched yet;
+        # the run loop converges on the real number before every spawn
+        # (joining an in-flight generation on the first pass, bumping past
+        # its own on restarts).
+        self.generation = 0
         self._child: Optional[subprocess.Popen] = None
         self._shutdown_signal: Optional[int] = None
         self._hang_fired = False
+        self._peer_restart_fired = False
         # Wall clock of the last known step progress of a dead child —
         # the anchor for the restart-lost goodput booked at relaunch.
         self._restart_anchor: Optional[float] = None
+
+    @property
+    def _is_chief(self) -> bool:
+        return self.process_index == 0
 
     def _append_event(self, type: str, **fields) -> None:
         """Event-log appends must never take the supervisor down."""
@@ -111,14 +172,63 @@ class Supervisor:
             return max(float(floor), float(hb["t"]))
         return float(floor)
 
+    def _stop_child(self, child: subprocess.Popen, why: str) -> None:
+        """SIGTERM then (after ``hang_kill_grace_s``) SIGKILL. The grace
+        escalation is load-bearing in multi-host mode: a child whose peer
+        died is usually stuck in a collective, so its preemption-save
+        SIGTERM handler will itself hang and only the SIGKILL lands."""
+        try:
+            child.terminate()
+            try:
+                child.wait(timeout=self.hang_kill_grace_s)
+            except subprocess.TimeoutExpired:
+                self.log(f"supervisor: {why} child ignored SIGTERM; killing")
+                child.kill()
+        except OSError:
+            pass
+
+    def _stalest_peer(self) -> Optional[Dict[str, Any]]:
+        """Attribution for a fleet stall: the per-host heartbeat with the
+        oldest timestamp — i.e. the host that stopped beating first."""
+        fleet = read_fleet_heartbeats(self.run_dir)
+        if not fleet:
+            return None
+        idx = min(fleet, key=lambda i: float(fleet[i].get("t", 0.0)))
+        hb = fleet[idx]
+        return {"process_index": idx, "step": hb.get("step"),
+                "age_s": round(max(0.0, time.time() - float(hb.get("t", 0.0))), 3)}
+
     def _watch_child(self, child: subprocess.Popen, spawned_at: float,
                      stop_evt: threading.Event) -> None:
-        """Poll the heartbeat; SIGTERM-then-SIGKILL the child once it has
-        made no step progress for ``hang_timeout_s``."""
-        poll = max(0.2, min(self.hang_timeout_s / 4.0, 10.0))
+        """Poll the heartbeat and (multi-host) the fleet restart marker;
+        SIGTERM-then-SIGKILL the child once it has made no step progress
+        for ``hang_timeout_s``, or as soon as a peer declared this
+        generation over."""
+        poll = max(0.2, min(self.hang_timeout_s / 4.0, 10.0)
+                   if self.hang_timeout_s > 0 else 0.5)
         while not stop_evt.wait(poll):
             if child.poll() is not None:
                 return
+            if self.process_count > 1:
+                marker = fleet_restart_requested(self.run_dir, self.generation)
+                if marker is not None and int(
+                        marker.get("process_index", -1)) != self.process_index:
+                    self._peer_restart_fired = True
+                    self.log(
+                        f"supervisor: peer p{marker.get('process_index')} "
+                        f"requested a fleet restart of generation "
+                        f"{self.generation} ({marker.get('reason')}); "
+                        f"stopping child pid {child.pid}")
+                    self._append_event(
+                        "fault", kind="peer_restart",
+                        generation=self.generation,
+                        process_index=self.process_index,
+                        peer=marker.get("process_index"),
+                        reason=marker.get("reason"), pid=child.pid)
+                    self._stop_child(child, "peer-restarted")
+                    return
+            if self.hang_timeout_s <= 0:
+                continue
             stalled = time.time() - self._last_progress(spawned_at)
             if stalled <= self.hang_timeout_s:
                 continue
@@ -128,18 +238,13 @@ class Supervisor:
             self.log(f"supervisor: watchdog — no step progress for "
                      f"{stalled:.1f}s (hang_timeout_s={self.hang_timeout_s:g}); "
                      f"terminating hung child pid {child.pid}")
+            culprit = self._stalest_peer() if self.process_count > 1 else None
             self._append_event(
                 "fault", kind="hang", stalled_s=round(stalled, 3),
-                step=(hb or {}).get("step"), pid=child.pid)
-            try:
-                child.terminate()
-                try:
-                    child.wait(timeout=self.hang_kill_grace_s)
-                except subprocess.TimeoutExpired:
-                    self.log("supervisor: hung child ignored SIGTERM; killing")
-                    child.kill()
-            except OSError:
-                pass
+                step=(hb or {}).get("step"), pid=child.pid,
+                **({"process_index": self.process_index,
+                    "stalest": culprit} if culprit is not None else {}))
+            self._stop_child(child, "hung")
             return
 
     def latest_resumable(self) -> Optional[str]:
@@ -189,27 +294,71 @@ class Supervisor:
         tag_after_last_crash: Optional[str] = None
         try:
             while True:
+                # Converge on the fleet generation of this launch. First
+                # pass: JOIN whatever generation is already in flight (a
+                # peer that started first has stamped its barrier file —
+                # bumping past it would split the fleet across two
+                # generations and deadlock both barriers). Restarts: one
+                # past our own, or whatever a faster-restarting peer has
+                # already stamped (max-rule — a supervisor that slept
+                # through a backoff jumps forward instead of barriering on
+                # a generation its peers left).
+                if self.generation == 0:
+                    self.generation = max(1, latest_generation(self.run_dir))
+                    if self.process_count > 1 and fleet_restart_requested(
+                            self.run_dir, self.generation):
+                        # The generation we'd join already crashed (stale
+                        # run dir): start its successor instead.
+                        self.generation += 1
+                else:
+                    self.generation = max(self.generation + 1,
+                                          latest_generation(self.run_dir))
+                if self.process_count > 1:
+                    try:
+                        generation_barrier(
+                            self.run_dir, self.generation,
+                            self.process_index, self.process_count,
+                            timeout_s=self.barrier_timeout_s, log=self.log)
+                    except BarrierTimeoutError as e:
+                        self._append_event(
+                            "fault", kind="barrier_timeout",
+                            generation=self.generation,
+                            process_index=self.process_index, error=str(e))
+                        raise
+                # Scan for the resume tag AFTER the barrier: every host must
+                # see the checkpoints the previous generation finished
+                # writing, or the fleet would disagree on the resume step.
                 tag = self.latest_resumable()
-                cmd = self.build_cmd(tag)
-                self.log(f"supervisor: launching child "
+                cmd = (self.build_cmd(tag, self.generation)
+                       if _wants_generation(self.build_cmd)
+                       else self.build_cmd(tag))
+                self.log(f"supervisor: launching child gen={self.generation} "
                          f"(resume={tag if tag is not None else 'fresh'})")
                 if self._restart_anchor is not None:
                     # Restart-lost wall clock: everything between the dead
                     # child's last step progress and this relaunch. Replay
-                    # books it into goodput as restart_lost_s.
+                    # books it into goodput as restart_lost_s. Chief-only
+                    # in multi-host mode so each generation's loss is
+                    # booked once, not once per host.
                     lost = max(0.0, time.time() - self._restart_anchor)
-                    self._append_event(
-                        "restart", lost_s=round(lost, 3),
-                        resume=tag, restarts=self.restarts)
+                    if self._is_chief:
+                        self._append_event(
+                            "restart", lost_s=round(lost, 3),
+                            resume=tag, restarts=self.restarts,
+                            generation=self.generation)
                     self._restart_anchor = None
                 self._hang_fired = False
-                self._child = subprocess.Popen(cmd, env=self.env)
+                self._peer_restart_fired = False
+                child_env = dict(self.env if self.env is not None
+                                 else os.environ)
+                child_env[ELASTIC_GENERATION_ENV] = str(self.generation)
+                self._child = subprocess.Popen(cmd, env=child_env)
                 spawned_at = time.time()
                 if self.on_spawn is not None:
                     self.on_spawn(self._child)
                 watchdog = None
                 stop_evt = threading.Event()
-                if self.hang_timeout_s > 0:
+                if self.hang_timeout_s > 0 or self.process_count > 1:
                     watchdog = threading.Thread(
                         target=self._watch_child,
                         args=(self._child, spawned_at, stop_evt),
@@ -218,24 +367,38 @@ class Supervisor:
                 rc = self._child.wait()
                 stop_evt.set()
                 if watchdog is not None:
-                    # Settle _hang_fired: wait() may return while the
-                    # watchdog is mid-termination.
+                    # Settle _hang_fired / _peer_restart_fired: wait() may
+                    # return while the watchdog is mid-termination.
                     watchdog.join(timeout=self.hang_kill_grace_s + 10.0)
                 hang = self._hang_fired
-                if rc == 0 and not hang:
+                peer_fired = self._peer_restart_fired
+                if rc == 0 and not hang and not peer_fired:
                     self.log("supervisor: child completed cleanly")
                     return 0
-                if self._shutdown_signal is not None and not hang:
+                if self._shutdown_signal is not None and not hang \
+                        and not peer_fired:
                     # Forwarded preemption: the child saved and exited; a
                     # restart would defeat the point of the signal.
                     self.log(f"supervisor: shutdown signal "
                              f"{self._shutdown_signal} forwarded; not restarting")
                     return rc
-                # Crash path (a watchdog hang counts as a crash even on
-                # rc==0 — the SIGTERM let the child save-and-exit cleanly,
-                # but the run is NOT done). Anchor the lost-time clock at
-                # the child's last step progress before backoff eats more.
+                # Crash path (a watchdog hang — or a peer-requested stop —
+                # counts as a crash even on rc==0: the SIGTERM let the
+                # child save-and-exit cleanly, but the run is NOT done).
+                # Anchor the lost-time clock at the child's last step
+                # progress before backoff eats more.
                 self._restart_anchor = self._last_progress(spawned_at)
+                if self.process_count > 1 and not peer_fired:
+                    # OUR child died first: tell the fleet so peers stop
+                    # their (collective-stuck) children within one watchdog
+                    # poll instead of waiting out a hang timeout.
+                    try:
+                        request_fleet_restart(
+                            self.run_dir, self.generation, self.process_index,
+                            reason="hang" if hang else f"rc={rc}")
+                    except OSError as e:
+                        self.log(f"supervisor: could not write fleet restart "
+                                 f"marker ({e})")
                 new_tag = self.latest_resumable()
                 if new_tag is not None and new_tag != tag_after_last_crash:
                     crashes = 1  # progress since the last crash — reset
@@ -278,7 +441,7 @@ def _checkpoints_present(run_dir: str) -> bool:
         return False
 
 
-def _trainer_cmd_builder(args, run_dir: str) -> Callable[[Optional[str]], List[str]]:
+def _trainer_cmd_builder(args, run_dir: str) -> Callable[..., List[str]]:
     """Child argv for the real trainer, rebuilt from the parsed supervisor
     args (so ``--auto-resume`` and the supervisor knobs never leak into
     the child)."""
@@ -296,8 +459,30 @@ def _trainer_cmd_builder(args, run_dir: str) -> Callable[[Optional[str]], List[s
     if args.run_name:
         base += ["--run-name", args.run_name]
 
-    def build(resume_tag: Optional[str]) -> List[str]:
+    coordinator = getattr(args, "coordinator", None)
+    num_processes = getattr(args, "num_processes", None)
+    process_id = getattr(args, "process_id", None)
+    rdv_timeout = getattr(args, "rendezvous_timeout_s", None)
+
+    def _coordinator_for(generation: int) -> str:
+        """Per-generation coordinator port: generation N rendezvouses on
+        ``base_port + N - 1``, so a restarted fleet never races the dead
+        generation's coordinator socket lingering in TIME_WAIT."""
+        host, _, port = coordinator.rpartition(":")
+        if not host or not port.isdigit():
+            return coordinator
+        return f"{host}:{int(port) + max(0, int(generation) - 1)}"
+
+    def build(resume_tag: Optional[str], generation: int = 1) -> List[str]:
         cmd = list(base)
+        if coordinator:
+            cmd += ["--coordinator", _coordinator_for(generation)]
+            if num_processes is not None:
+                cmd += ["--num-processes", str(num_processes)]
+            if process_id is not None:
+                cmd += ["--process-id", str(process_id)]
+            if rdv_timeout is not None:
+                cmd += ["--rendezvous-timeout-s", str(rdv_timeout)]
         if resume_tag is not None:
             # Resume from the tag the SUPERVISOR verified (not "latest"):
             # deterministic even if files change between scan and launch.
@@ -340,6 +525,10 @@ def supervise_from_args(args) -> Dict[str, Any]:
     cli_timeout = getattr(args, "hang_timeout_s", None)
     if cli_timeout is not None:
         hang_timeout = float(cli_timeout)
+    barrier_timeout = float(sup_cfg.get("barrier_timeout_s") or 300.0)
+    cli_barrier = getattr(args, "barrier_timeout_s", None)
+    if cli_barrier is not None:
+        barrier_timeout = float(cli_barrier)
 
     sup = Supervisor(
         _trainer_cmd_builder(args, run_dir),
@@ -349,6 +538,9 @@ def supervise_from_args(args) -> Dict[str, Any]:
         backoff_max=args.backoff_max,
         hang_timeout_s=hang_timeout,
         hang_kill_grace_s=float(sup_cfg.get("hang_kill_grace_s") or 20.0),
+        process_index=int(getattr(args, "process_id", None) or 0),
+        process_count=int(getattr(args, "num_processes", None) or 1),
+        barrier_timeout_s=barrier_timeout,
     )
     rc = sup.run()
     return {"supervised": True, "exit_code": rc, "restarts": sup.restarts,

@@ -56,6 +56,41 @@ from .lr_finder import run_lr_finder
 from .train_step import init_train_state, make_eval_step, make_train_step
 
 
+def _put_tree(tree: Any, shardings: Any) -> Any:
+    """Place ``tree`` onto ``shardings`` without cross-process transfers.
+
+    ``jax.device_put`` of a committed process-local array onto a sharding
+    that spans processes issues eager per-buffer collectives; on the CPU
+    (gloo) backend their issue order is not synchronized across processes,
+    which intermittently aborts the transport (preamble-size mismatches)
+    or silently corrupts state after an elastic restart. Every caller here
+    holds the full value on every process — init replicates it (same seed)
+    and resume loads it from disk — so multi-process placement can always
+    go through ``make_array_from_callback``, which only uploads the
+    addressable shards and never communicates.
+    """
+    if jax.process_count() <= 1:
+        return jax.device_put(tree, shardings)
+
+    def put(x, s):
+        if s is None:
+            return x
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            # Already a global array (the resharding loaders build these
+            # straight onto the target placement); only move it if the
+            # placement actually differs.
+            try:
+                same = x.sharding.is_equivalent_to(s, x.ndim)
+            except Exception:
+                same = x.sharding == s
+            return x if same else jax.device_put(x, s)
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(
+            arr.shape, s, lambda idx, _a=arr: _a[idx])
+
+    return jax.tree_util.tree_map(put, tree, shardings)
+
+
 class Trainer:
     def __init__(
         self,
@@ -90,8 +125,20 @@ class Trainer:
         # -- run dir ---------------------------------------------------------
         resume = cfg.resume is not None and bool(cfg.resume.checkpoint)
         run_dir = os.path.join(runs_root, cfg.name)
-        if for_training and not resume and jax.process_index() == 0:
+        # Destructive setup (overwrite rmtree) happens exactly once: on the
+        # chief, in the fleet's FIRST generation. Supervisor restarts
+        # (ELASTIC_GENERATION > 1) continue into the existing dir — wiping
+        # it again would destroy events.jsonl and race against peers. The
+        # barrier orders the chief's rmtree+mkdir before any peer writes
+        # (heartbeats, tokenizer cache) land in the same tree.
+        from ..parallel.elastic import ELASTIC_GENERATION_ENV, process_barrier
+
+        elastic_gen = int(os.environ.get(ELASTIC_GENERATION_ENV) or 1)
+        if (for_training and not resume and elastic_gen <= 1
+                and jax.process_index() == 0):
             run_dir = CheckpointManager.setup_run_directory(runs_root, cfg.name, cfg.overwrite)
+        if for_training:
+            process_barrier("run_dir_setup")
         self.run_dir = run_dir
         os.makedirs(run_dir, exist_ok=True)
         # Telemetry substrate (obs/metrics.py): one registry per Trainer —
@@ -111,9 +158,20 @@ class Trainer:
         # Persistent XLA compilation cache: enabled BEFORE the first jit
         # compile (model init below) so crash-restarts under the auto-resume
         # supervisor reload executables instead of recompiling everything.
+        # Not on multi-process CPU: executables deserialized from the cache
+        # lose their gloo collective state and corrupt the heap on first
+        # dispatch (reproducible: a cold fleet populates and trains fine,
+        # the next fleet sharing the cache aborts in glibc after step 1).
         if for_training and getattr(cfg.system, "compilation_cache_dir", None):
-            self.logger.log(
-                _enable_compilation_cache(cfg.system.compilation_cache_dir))
+            if (jax.process_count() > 1
+                    and jax.default_backend() == "cpu"):
+                self.logger.log(
+                    "compilation cache: disabled on multi-process CPU "
+                    "(cached executables do not survive gloo collective "
+                    "re-initialization)")
+            else:
+                self.logger.log(
+                    _enable_compilation_cache(cfg.system.compilation_cache_dir))
 
         # -- tokenizer -------------------------------------------------------
         self.tokenizer = TokenizerManager(cfg.data, run_dir=run_dir if for_training else None)
@@ -302,7 +360,7 @@ class Trainer:
             self.state = init_train_state(
                 stack_layers(self.params, interleave=self.pipeline_interleave),
                 self.optimizer)
-            self.state = jax.device_put(self.state, self.state_shardings)
+            self.state = _put_tree(self.state, self.state_shardings)
         else:
             self.train_step, self.state_shardings = make_train_step(
                 self.loss_fn, self.optimizer,
@@ -329,7 +387,7 @@ class Trainer:
 
             self.state = init_train_state(self.params, self.optimizer)
             if self.mesh is not None and self.state_shardings is not None:
-                self.state = jax.device_put(self.state, self.state_shardings)
+                self.state = _put_tree(self.state, self.state_shardings)
 
         # optional live stats publishing (obs/stats_server.py hub)
         self.stats_client = None
@@ -388,7 +446,33 @@ class Trainer:
                     f"telemetry: registry rebuilt from {replayed} events "
                     f"in {events_path(run_dir)}")
             self.events = EventLog(events_path(run_dir))
-            self._hb_path = heartbeat_path(run_dir)
+        if for_training:
+            # Per-host heartbeat: process 0 keeps the legacy heartbeat.json
+            # name; peers write heartbeat_p<idx>.json — so a supervisor
+            # watchdog can attribute a fleet stall to the host that
+            # stopped beating, not just "somewhere".
+            self._hb_path = heartbeat_path(run_dir, jax.process_index())
+        if for_training and jax.process_count() > 1:
+            # Generation-stamped membership record (parallel/elastic.py):
+            # every host agrees which epoch of the world it joined. The
+            # device barrier first makes sure no peer records into a run
+            # dir the chief is still (re)creating. Best-effort: telemetry
+            # must never kill training.
+            try:
+                from jax.experimental import multihost_utils
+
+                from ..parallel.elastic import record_membership
+
+                multihost_utils.sync_global_devices("elastic_membership")
+                rec = record_membership(run_dir, log=self.logger.log)
+                self.logger.log(
+                    f"elastic: recorded membership generation "
+                    f"{rec['generation']} as process "
+                    f"{jax.process_index()}/{jax.process_count()}")
+            except Exception as e:  # noqa: BLE001 - advisory record only
+                self.logger.log(
+                    f"WARNING: elastic membership record failed "
+                    f"({type(e).__name__}: {e}); continuing")
         # Handles for the hot-path counters (idempotent re-declaration —
         # replay_into already registered them).
         self._m_steps = self.metrics.counter(
@@ -495,7 +579,9 @@ class Trainer:
         if step is not None:
             self._hb_step = int(step)
         try:
-            write_heartbeat(self._hb_path, getattr(self, "_hb_step", self.start_step))
+            write_heartbeat(self._hb_path,
+                            getattr(self, "_hb_step", self.start_step),
+                            process_index=jax.process_index())
         except OSError:
             pass  # heartbeat is advisory; never kill training over it
 
@@ -599,6 +685,40 @@ class Trainer:
         self.checkpoints.quarantine_step(tag, reason)
         return self.checkpoints.latest_complete_step()
 
+    def _resume_data_state(self, tag, tstate: Dict[str, Any]) -> Dict[str, Any]:
+        """Data-loader position for THIS host. Same-world resume reads the
+        host's own sidecar (or the chief's training_state snapshot for
+        single-process runs); a world-size change routes every old host's
+        snapshot through ``data.streaming.remap_data_states`` so the new
+        fleet resumes with zero skipped and zero replayed documents."""
+        from ..data.streaming import remap_data_states
+
+        pindex, pcount = jax.process_index(), jax.process_count()
+        sidecars = self.checkpoints.data_sidecar_states(tag)
+        if sidecars:
+            old_world = len(sidecars)
+            if old_world == pcount and pindex in sidecars:
+                return sidecars[pindex]
+            states = [sidecars[i] for i in sorted(sidecars)]
+            remapped = remap_data_states(states, pindex, pcount)
+            self.logger.log(
+                f"elastic: remapped data position from a {old_world}-host "
+                f"snapshot to {pcount} host(s); this is process {pindex}")
+            return remapped
+        old_world = int(tstate.get("process_count", 1) or 1)
+        if old_world == pcount:
+            return tstate
+        snap = {k: tstate[k]
+                for k in ("docs_consumed", "buf", "source", "hf")
+                if k in tstate}
+        snap["process_count"] = old_world
+        snap["process_index"] = int(tstate.get("process_index", 0) or 0)
+        remapped = remap_data_states([snap], pindex, pcount)
+        self.logger.log(
+            f"elastic: remapped data position from a {old_world}-host "
+            f"snapshot to {pcount} host(s); this is process {pindex}")
+        return remapped
+
     def _resume(self) -> None:
         """Resume from ``resume.checkpoint`` (reference: :1545-1564 with
         reset_optimizer / reset_training_state flags :124-127), but only
@@ -612,19 +732,31 @@ class Trainer:
         # The resume source must survive retention GC for the whole run:
         # until the first NEW checkpoint lands it is the only good state.
         self.checkpoints.protect_steps.add(str(tag))
-        # Pipeline + mesh: params reshard straight from disk into the
-        # stacked pp×fsdp placement (load_params_stacked) — no host-side
-        # ``like`` gather of the live state and no device ever holding a
-        # full replica. The optimizer state still takes the host path (its
-        # moment trees are rebuilt leaf-by-leaf against the live structure).
+        # Mesh runs reshard straight from disk into the live placement —
+        # params through load_params(mesh=) / load_params_stacked and the
+        # optimizer moments through load_opt_state_resharded — so the
+        # on-disk mesh shape is irrelevant: an fsdp4 checkpoint resumes on
+        # fsdp2×pp2 (and vice versa) via per-device-slice callbacks with
+        # no host gather and no device ever holding a full replica.
         pp_direct = self.pipeline and self.mesh is not None
+        mesh_direct = self.mesh is not None and self.state_shardings is not None
+        host_like = not (pp_direct or mesh_direct)
         params, opt_state, tstate = self.checkpoints.load(
             tag,
-            like_params=None if pp_direct else self._host_params(),
-            like_opt_state=None if rc.reset_optimizer else self._host_opt_state(),
+            like_params=self._host_params() if host_like else None,
+            like_opt_state=(self._host_opt_state()
+                            if not rc.reset_optimizer and not mesh_direct
+                            else None),
             strict=bool(rc.strict),
-            with_params=not pp_direct,
+            with_params=host_like,
         )
+        if mesh_direct and not rc.reset_optimizer:
+            opt_state = self.checkpoints.load_opt_state_resharded(
+                tag, self.state["opt_state"],
+                self.state_shardings["opt_state"],
+                num_layers=self.model_args.num_layers if self.pipeline else 0,
+                interleave=self.pipeline_interleave if self.pipeline else 1,
+                strict=bool(rc.strict))
         if opt_state is None and not rc.reset_optimizer:
             self.logger.log(
                 f"WARNING: resuming step {tag} WITHOUT optimizer state "
@@ -637,9 +769,13 @@ class Trainer:
                 model_path, self.mesh, self.model_args.num_layers,
                 interleave=self.pipeline_interleave,
                 like_stacked=self.state["params"])
+        elif mesh_direct:
+            model_path, _, _ = self.checkpoints.paths_for_step(tag)
+            params = self.checkpoints.load_params(
+                model_path, like=self.state["params"], mesh=self.mesh)
         else:
             params = jax.tree_util.tree_map(jnp.asarray, params)
-        if opt_state is not None:
+        if opt_state is not None and not mesh_direct:
             opt_state = jax.tree_util.tree_map(jnp.asarray, opt_state)
         if self.pipeline:
             from ..parallel.pipeline import stack_layers, stack_opt_state
@@ -647,7 +783,7 @@ class Trainer:
             if not pp_direct:
                 params = stack_layers(
                     params, interleave=self.pipeline_interleave)
-            if opt_state is not None:
+            if opt_state is not None and not mesh_direct:
                 opt_state = stack_opt_state(
                     opt_state, self.model_args.num_layers,
                     interleave=self.pipeline_interleave)
@@ -658,20 +794,13 @@ class Trainer:
             "step": jnp.asarray(step, jnp.int32),
         }
         if self.mesh is not None and self.state_shardings is not None:
-            self.state = jax.device_put(self.state, self.state_shardings)
+            self.state = _put_tree(self.state, self.state_shardings)
         if not rc.reset_training_state:
             self.start_step = step
             self.total_tokens = int(tstate.get("total_tokens", 0))
             self.val_history = tstate.get("validation", self.val_history)
             if self.data:
-                data_state = tstate
-                sidecar = os.path.join(
-                    self.checkpoints.checkpoint_dir,
-                    f"step_{tag}_data_p{jax.process_index()}.json")
-                if jax.process_count() > 1 and os.path.isfile(sidecar):
-                    with open(sidecar) as f:
-                        data_state = json.load(f)
-                self.data.load_state_dict(data_state)
+                self.data.load_state_dict(self._resume_data_state(tag, tstate))
             self.early_stopping.load_state_dict(tstate.get("early_stopping", {}))
         self.logger.log(f"Resumed from checkpoint {tag} at step {self.start_step}")
         if self.events is not None:
@@ -794,7 +923,7 @@ class Trainer:
             )
         self.state = init_train_state(self.state["params"], self.optimizer)
         if self.mesh is not None and self.state_shardings is not None:
-            self.state = jax.device_put(self.state, self.state_shardings)
+            self.state = _put_tree(self.state, self.state_shardings)
         return suggested
 
     # -- the loop -----------------------------------------------------------
@@ -1452,6 +1581,25 @@ def build_parser() -> argparse.ArgumentParser:
                              "trainer when its heartbeat makes no progress "
                              "for this many seconds (overrides "
                              "supervisor.hang_timeout_s; 0 disables)")
+    # Multi-host rendezvous (parallel/elastic.py). With --auto-resume these
+    # configure the multi-host supervisor instead: each host runs one
+    # supervisor, children rendezvous per generation.
+    parser.add_argument("--coordinator", default=None,
+                        help="host:port of process 0 for the "
+                             "jax.distributed rendezvous (also "
+                             "JAX_COORDINATOR_ADDRESS / config "
+                             "system.distributed.coordinator_address)")
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
+    parser.add_argument("--rendezvous-timeout-s", type=float, default=None,
+                        help="overall rendezvous deadline; retries with "
+                             "backoff inside it (default 120, or config "
+                             "system.distributed.rendezvous_timeout_s)")
+    parser.add_argument("--barrier-timeout-s", type=float, default=None,
+                        help="with --auto-resume on a multi-host world: how "
+                             "long each host's supervisor waits for peers "
+                             "at a generation barrier (overrides "
+                             "supervisor.barrier_timeout_s)")
     return parser
 
 
@@ -1471,6 +1619,25 @@ def main(argv=None) -> Dict[str, Any]:
     with open(args.config) as f:
         raw = yaml.safe_load(f)
     cfg = Config.from_dict(apply_overrides(raw, collect_overrides(args)))
+    # Multi-host rendezvous BEFORE the Trainer touches any device state.
+    # Explicitly configured coordination fails loudly (RendezvousError) —
+    # never N solo runs clobbering one run dir.
+    coordinator = (args.coordinator
+                   or os.environ.get("JAX_COORDINATOR_ADDRESS")
+                   or cfg.system.distributed_coordinator)
+    if coordinator:
+        from ..parallel.launch import initialize_distributed
+
+        timeout = (args.rendezvous_timeout_s
+                   if args.rendezvous_timeout_s is not None
+                   else cfg.system.distributed_rendezvous_timeout_s)
+        initialize_distributed(
+            coordinator,
+            (args.num_processes if args.num_processes is not None
+             else cfg.system.distributed_num_processes),
+            args.process_id,
+            rendezvous_timeout_s=timeout,
+        )
     trainer = Trainer(cfg, runs_root=args.runs_root)
     return trainer.train()
 

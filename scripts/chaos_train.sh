@@ -6,12 +6,30 @@
 # but the PID is the supervisor's — kill -TERM it for a clean,
 # checkpointed shutdown of the whole tree.
 #
+# Multi-host mode (HOSTS=N): launches N supervisors on this machine, one
+# per simulated host, that coordinate restarts through the shared run
+# dir (parallel/elastic.py): per-generation file barrier, fleet restart
+# markers, children rendezvousing over jax.distributed on a
+# per-generation localhost coordinator port. Chaos injection
+# (CHAOS_KILL_AFTER_S=K): after K seconds, SIGKILL one random host's
+# TRAINER child (pid read from its heartbeat_p<idx>.json) — the fleet
+# must barrier, resume from the newest verified checkpoint, and finish
+# with goodput >= ~95% on the ledger. This is the manual form of
+# tests/test_elastic_chaos.py.
+#
 # Usage: scripts/chaos_train.sh <config.yaml> [runs_root] [max_crashes]
+# Env:   HOSTS=N              simulated hosts (default 1: single-host mode)
+#        COORD_PORT=P         base coordinator port (default 12435)
+#        CHAOS_KILL_AFTER_S=K SIGKILL a random host's trainer after K s
+#        HOST_DEVICES=D       CPU devices per simulated host (default 2)
 set -euo pipefail
 
 CONFIG="${1:?usage: chaos_train.sh <config.yaml> [runs_root] [max_crashes]}"
 RUNS_ROOT="${2:-runs}"
 MAX_CRASHES="${3:-3}"
+HOSTS="${HOSTS:-1}"
+COORD_PORT="${COORD_PORT:-12435}"
+HOST_DEVICES="${HOST_DEVICES:-2}"
 NAME="$(python - "$CONFIG" <<'EOF'
 import sys, yaml
 print(yaml.safe_load(open(sys.argv[1]))["name"])
@@ -19,13 +37,85 @@ EOF
 )"
 
 mkdir -p "$RUNS_ROOT"
-LOG="$RUNS_ROOT/$NAME.supervisor.log"
+RUN_DIR="$RUNS_ROOT/$NAME"
 
-nohup python -m mlx_cuda_distributed_pretraining_tpu.train.trainer \
-  --config "$CONFIG" --runs-root "$RUNS_ROOT" \
-  --auto-resume --max-crashes "$MAX_CRASHES" >"$LOG" 2>&1 &
-PID=$!
-echo "$PID" > "$RUNS_ROOT/$NAME.supervisor.pid"
-echo "supervised training started: pid=$PID config=$CONFIG log=$LOG"
-echo "stop cleanly with: kill -TERM $PID   (forwards to the trainer, which checkpoints and exits)"
-echo "monitor with: python -m mlx_cuda_distributed_pretraining_tpu.obs.monitor $NAME --runs-root $RUNS_ROOT"
+if [ "$HOSTS" -le 1 ]; then
+  LOG="$RUNS_ROOT/$NAME.supervisor.log"
+  nohup python -m mlx_cuda_distributed_pretraining_tpu.train.trainer \
+    --config "$CONFIG" --runs-root "$RUNS_ROOT" \
+    --auto-resume --max-crashes "$MAX_CRASHES" >"$LOG" 2>&1 &
+  PID=$!
+  echo "$PID" > "$RUNS_ROOT/$NAME.supervisor.pid"
+  echo "supervised training started: pid=$PID config=$CONFIG log=$LOG"
+  echo "stop cleanly with: kill -TERM $PID   (forwards to the trainer, which checkpoints and exits)"
+  echo "monitor with: python -m mlx_cuda_distributed_pretraining_tpu.obs.monitor $NAME --runs-root $RUNS_ROOT"
+  exit 0
+fi
+
+# --- multi-host fleet -------------------------------------------------
+PIDS=()
+for ((i = 0; i < HOSTS; i++)); do
+  LOG="$RUNS_ROOT/$NAME.supervisor_p$i.log"
+  # Simulated hosts share one machine: force CPU devices so each
+  # "host" owns HOST_DEVICES of the global mesh, as real pods would.
+  nohup env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=$HOST_DEVICES" \
+    python -m mlx_cuda_distributed_pretraining_tpu.train.trainer \
+    --config "$CONFIG" --runs-root "$RUNS_ROOT" \
+    --auto-resume --max-crashes "$MAX_CRASHES" \
+    --coordinator "localhost:$COORD_PORT" \
+    --num-processes "$HOSTS" --process-id "$i" >"$LOG" 2>&1 &
+  PIDS[$i]=$!
+  echo "${PIDS[$i]}" > "$RUNS_ROOT/$NAME.supervisor_p$i.pid"
+  echo "host $i supervisor: pid=${PIDS[$i]} log=$LOG"
+done
+
+if [ -n "${CHAOS_KILL_AFTER_S:-}" ]; then
+  VICTIM=$((RANDOM % HOSTS))
+  (
+    sleep "$CHAOS_KILL_AFTER_S"
+    HB="$RUN_DIR/heartbeat_p$VICTIM.json"
+    [ "$VICTIM" -eq 0 ] && HB="$RUN_DIR/heartbeat.json"
+    TPID="$(python - "$HB" <<'EOF'
+import json, sys
+try:
+    print(json.load(open(sys.argv[1])).get("pid") or "")
+except OSError:
+    print("")
+EOF
+)"
+    if [ -n "$TPID" ]; then
+      echo "chaos: SIGKILL host $VICTIM trainer pid=$TPID" >&2
+      kill -KILL "$TPID" 2>/dev/null || true
+    else
+      echo "chaos: no heartbeat pid for host $VICTIM yet; skipping kill" >&2
+    fi
+  ) &
+  echo "chaos: will SIGKILL host $VICTIM's trainer after ${CHAOS_KILL_AFTER_S}s"
+fi
+
+echo "fleet of $HOSTS supervisors launched (coordinator localhost:$COORD_PORT)"
+echo "stop cleanly with: kill -TERM ${PIDS[*]}"
+
+RC=0
+for ((i = 0; i < HOSTS; i++)); do
+  wait "${PIDS[$i]}" || RC=$?
+done
+
+echo "fleet done rc=$RC"
+if [ -f "$RUN_DIR/events.jsonl" ]; then
+  python - "$RUN_DIR" <<'EOF'
+import json, os, sys
+run = sys.argv[1]
+lost = 0.0
+for line in open(os.path.join(run, "events.jsonl")):
+    try:
+        ev = json.loads(line)
+    except ValueError:
+        continue
+    if ev.get("type") == "restart":
+        lost += float(ev.get("lost_s") or 0.0)
+print(f"ledger: restart_lost_s={lost:.1f}")
+EOF
+fi
+exit "$RC"

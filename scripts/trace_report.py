@@ -208,32 +208,45 @@ def request_report(spans, top: int) -> List[str]:
 
 
 def trainer_report(spans, instants) -> List[str]:
-    phase_s: Dict[str, float] = {}
-    t_min, t_max = None, None
+    # Group by service: a multi-host run exports one trace per host
+    # (heartbeat_p<idx> naming on the run dir side), and summing phase
+    # time across hosts would double-book wall clock that elapsed in
+    # parallel. Single-host traces produce one group and no service= key.
+    svc_spans: Dict[str, List[Dict[str, Any]]] = {}
     for s in spans:
         if s["name"] in TRAIN_PHASES:
+            svc_spans.setdefault(s["service"], []).append(s)
+    if not svc_spans:
+        return []
+    multi = len(svc_spans) > 1
+    lines: List[str] = []
+    for svc in sorted(svc_spans):
+        phase_s: Dict[str, float] = {}
+        t_min, t_max = None, None
+        for s in svc_spans[svc]:
             phase_s[s["name"]] = phase_s.get(s["name"], 0.0) + s["dur"] / 1e6
             lo, hi = s["ts"], s["ts"] + s["dur"]
             t_min = lo if t_min is None else min(t_min, lo)
             t_max = hi if t_max is None else max(t_max, hi)
-    if not phase_s:
-        return []
-    wall = (t_max - t_min) / 1e6 if t_max is not None else 0.0
-    wins = [i for i in instants if i["name"] == "step_window"]
-    mfus = [float(i["args"]["mfu"]) for i in wins
-            if isinstance(i["args"].get("mfu"), (int, float))]
-    booked = sum(phase_s.values())
-    lines = ["trainer_attribution=1 "
-             f"windows={len(wins)} "
-             f"mfu_mean={_fmt(round(sum(mfus) / len(mfus), 4) if mfus else None)} "
-             f"booked_s={round(booked, 3)} "
-             f"span_wall_s={round(wall, 3)}"]
-    for name in TRAIN_PHASES:
-        if name not in phase_s:
-            continue
+        wall = (t_max - t_min) / 1e6 if t_max is not None else 0.0
+        wins = [i for i in instants if i["name"] == "step_window"
+                and (not multi or i["service"] == svc)]
+        mfus = [float(i["args"]["mfu"]) for i in wins
+                if isinstance(i["args"].get("mfu"), (int, float))]
+        booked = sum(phase_s.values())
+        tag = f"service={svc} " if multi else ""
         lines.append(
-            f"phase={name} total_s={round(phase_s[name], 3)} "
-            f"share={round(phase_s[name] / booked, 4) if booked else 0.0}")
+            f"trainer_attribution=1 {tag}"
+            f"windows={len(wins)} "
+            f"mfu_mean={_fmt(round(sum(mfus) / len(mfus), 4) if mfus else None)} "
+            f"booked_s={round(booked, 3)} "
+            f"span_wall_s={round(wall, 3)}")
+        for name in TRAIN_PHASES:
+            if name not in phase_s:
+                continue
+            lines.append(
+                f"phase={name} {tag}total_s={round(phase_s[name], 3)} "
+                f"share={round(phase_s[name] / booked, 4) if booked else 0.0}")
     return lines
 
 

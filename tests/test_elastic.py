@@ -1,0 +1,510 @@
+"""Fast-tier elastic tests: rendezvous retry semantics, generation
+bookkeeping (membership, barrier, restart markers), exact N->M data
+remapping, and reshard-on-load of optimizer state onto an in-process
+mesh. The multi-process halves (real jax.distributed fleets, SIGKILL
+chaos) live in the slow tier (test_cross_mesh_resume.py,
+test_elastic_chaos.py)."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from mlx_cuda_distributed_pretraining_tpu.parallel.elastic import (
+    ELASTIC_GENERATION_ENV,
+    BarrierTimeoutError,
+    RendezvousError,
+    fleet_restart_requested,
+    generation_barrier,
+    latest_generation,
+    read_membership,
+    record_membership,
+    rendezvous,
+    request_fleet_restart,
+)
+
+import mlx_cuda_distributed_pretraining_tpu.parallel.elastic as elastic_mod
+
+# Captured before the autouse no-op fixture below replaces the attribute,
+# so the helper's own tests can still exercise the real implementation.
+_REAL_ENABLE_CPU_COLLECTIVES = elastic_mod._enable_cpu_collectives
+
+# -- rendezvous ------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _no_gloo_flip(monkeypatch):
+    # rendezvous() flips the CPU backend's collectives impl to gloo when
+    # joining a real multi-process world; inside this single-process pytest
+    # runtime a gloo backend (no distributed client) would fail every later
+    # backend creation, so the stub-driven tests must never flip it.
+    monkeypatch.setattr(
+        elastic_mod, "_enable_cpu_collectives", lambda log: None)
+
+
+def test_enable_cpu_collectives_flips_default_to_gloo(monkeypatch):
+    import jax
+
+    calls = []
+    monkeypatch.delenv("JAX_CPU_COLLECTIVES_IMPLEMENTATION", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setattr(jax.config, "_read", lambda name: "none")
+    monkeypatch.setattr(jax.config, "update",
+                        lambda *a: calls.append(a))
+    _REAL_ENABLE_CPU_COLLECTIVES(lambda m: None)
+    assert calls == [("jax_cpu_collectives_implementation", "gloo")]
+
+
+def test_enable_cpu_collectives_respects_user_choice(monkeypatch):
+    import jax
+
+    calls = []
+    monkeypatch.setattr(jax.config, "update", lambda *a: calls.append(a))
+    # explicit env var wins
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "mpi")
+    _REAL_ENABLE_CPU_COLLECTIVES(lambda m: None)
+    assert not calls
+    # non-cpu platform: never touched
+    monkeypatch.delenv("JAX_CPU_COLLECTIVES_IMPLEMENTATION", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    _REAL_ENABLE_CPU_COLLECTIVES(lambda m: None)
+    assert not calls
+    # explicit non-default config value: kept
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setattr(jax.config, "_read", lambda name: "mpi")
+    _REAL_ENABLE_CPU_COLLECTIVES(lambda m: None)
+    assert not calls
+
+
+def test_rendezvous_explicit_retries_then_raises():
+    calls = []
+    logs = []
+
+    def stub(**kw):
+        calls.append(kw)
+        raise RuntimeError("connection refused")
+
+    with pytest.raises(RendezvousError) as ei:
+        rendezvous("badhost:1", 2, 0, timeout_s=0.3, attempt_timeout_s=1.0,
+                   backoff_base=0.05, backoff_max=0.1,
+                   log=logs.append, _initialize=stub)
+    assert len(calls) >= 2, "explicit coordinator must be retried"
+    assert "badhost:1" in str(ei.value)
+    assert "connection refused" in str(ei.value)
+    failed = [m for m in logs if "failed" in m]
+    assert len(failed) >= 2
+    # every attempt was handed a bounded per-attempt timeout
+    assert all("initialization_timeout" in kw for kw in calls)
+
+
+def test_rendezvous_success_after_retry():
+    calls = []
+
+    def stub(**kw):
+        calls.append(kw)
+        if len(calls) == 1:
+            raise TimeoutError("coordinator not up yet")
+
+    logs = []
+    assert rendezvous("h:9", 2, 1, timeout_s=5.0, backoff_base=0.01,
+                      log=logs.append, _initialize=stub) is True
+    assert len(calls) == 2
+    assert calls[1]["coordinator_address"] == "h:9"
+    assert calls[1]["num_processes"] == 2
+    assert calls[1]["process_id"] == 1
+    assert any("rendezvous ok" in m for m in logs)
+
+
+def test_rendezvous_auto_failure_is_logged_not_raised(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    logs = []
+
+    def stub(**kw):
+        raise RuntimeError("no TPU metadata server")
+
+    assert rendezvous(log=logs.append, _initialize=stub) is False
+    assert any("no TPU metadata server" in m for m in logs), logs
+
+
+def test_rendezvous_stub_without_timeout_kwarg():
+    # Older-jax compatibility: a stub rejecting initialization_timeout
+    # gets the plain call instead of an eternal TypeError loop.
+    calls = []
+
+    def stub(coordinator_address, num_processes, process_id):
+        calls.append((coordinator_address, num_processes, process_id))
+
+    assert rendezvous("h:1", 2, 0, _initialize=stub, log=lambda m: None)
+    assert calls == [("h:1", 2, 0)]
+
+
+# -- generations -----------------------------------------------------------
+
+
+def test_membership_single_process(tmp_path):
+    run = str(tmp_path)
+    assert latest_generation(run) == 0
+    rec = record_membership(run, process_index=0, process_count=1)
+    assert rec["generation"] == 1
+    assert latest_generation(run) == 1
+    on_disk = read_membership(run)
+    assert on_disk["generation"] == 1
+    assert on_disk["process_count"] == 1
+    assert [m["process_index"] for m in on_disk["members"]] == [0]
+    # next incarnation bumps
+    rec2 = record_membership(run, process_index=0, process_count=1)
+    assert rec2["generation"] == 2
+
+
+def test_membership_generation_from_env(tmp_path, monkeypatch):
+    monkeypatch.setenv(ELASTIC_GENERATION_ENV, "7")
+    rec = record_membership(str(tmp_path), process_index=0, process_count=1)
+    assert rec["generation"] == 7
+    assert latest_generation(str(tmp_path)) == 7
+
+
+def test_latest_generation_sees_restart_markers(tmp_path):
+    run = str(tmp_path)
+    request_fleet_restart(run, 5, 1, "rc=1")
+    assert latest_generation(run) == 5
+
+
+def test_generation_barrier_two_arrivals(tmp_path):
+    run = str(tmp_path)
+    done = []
+    t = threading.Thread(target=lambda: (
+        generation_barrier(run, 3, 0, 2, timeout_s=10.0), done.append(0)))
+    t.start()
+    generation_barrier(run, 3, 1, 2, timeout_s=10.0)
+    t.join(timeout=10.0)
+    assert done == [0]
+
+
+def test_generation_barrier_timeout_names_missing(tmp_path):
+    with pytest.raises(BarrierTimeoutError) as ei:
+        generation_barrier(str(tmp_path), 4, 0, 2, timeout_s=0.4, poll_s=0.05)
+    assert "[1]" in str(ei.value)
+    assert "generation 4" in str(ei.value)
+
+
+def test_restart_marker_first_writer_wins(tmp_path):
+    run = str(tmp_path)
+    assert fleet_restart_requested(run, 2) is None
+    request_fleet_restart(run, 2, 1, "rc=-9")
+    request_fleet_restart(run, 2, 0, "hang")  # later request: no-op
+    marker = fleet_restart_requested(run, 2)
+    assert marker["process_index"] == 1
+    assert marker["reason"] == "rc=-9"
+    # markers are per-generation
+    assert fleet_restart_requested(run, 3) is None
+
+
+# -- exact N -> M data remapping -------------------------------------------
+
+
+def _mk_shards(tmp_path, n_docs=30, n_shards=2):
+    per = n_docs // n_shards
+    paths, k = [], 0
+    for s in range(n_shards):
+        p = tmp_path / f"shard_{s}.jsonl"
+        with open(p, "w") as f:
+            for _ in range(per):
+                f.write(json.dumps({"text": f"doc-{k}"}) + "\n")
+                k += 1
+        paths.append(str(p))
+    return paths
+
+
+def _world(shards, count, seed=3, repeat=True):
+    from mlx_cuda_distributed_pretraining_tpu.data.streaming import (
+        SeekableShuffledSource,
+    )
+
+    return [SeekableShuffledSource(shards, seed=seed, repeat=repeat,
+                                   process_index=i, process_count=count)
+            for i in range(count)]
+
+
+def _consume(src, n):
+    it = iter(src)
+    return [next(it) for _ in range(n)]
+
+
+@pytest.mark.parametrize("new_count", [1, 3])
+def test_remap_world2_exact_complement(tmp_path, new_count):
+    from mlx_cuda_distributed_pretraining_tpu.data.streaming import (
+        remap_seekable_states,
+    )
+
+    shards = _mk_shards(tmp_path)
+    old = _world(shards, 2)
+    consumed = _consume(old[0], 6) + _consume(old[1], 9)
+    assert len(set(consumed)) == 15, "old world must be disjoint"
+    states = [s.state_dict() for s in old]
+
+    remainder = []
+    for j in range(new_count):
+        src = _world(shards, new_count, repeat=False)[j]
+        src.load_state_dict(remap_seekable_states(states, j, new_count))
+        part = list(iter(src))
+        assert not (set(part) & set(remainder)), "new hosts must be disjoint"
+        remainder.extend(part)
+
+    every = {f"doc-{i}" for i in range(30)}
+    assert not (set(consumed) & set(remainder)), "replayed documents"
+    assert set(consumed) | set(remainder) == every, "skipped documents"
+    assert len(consumed) + len(remainder) == 30
+
+
+def test_remap_chained_2_to_3_to_2(tmp_path):
+    from mlx_cuda_distributed_pretraining_tpu.data.streaming import (
+        remap_seekable_states,
+    )
+
+    shards = _mk_shards(tmp_path)
+    old = _world(shards, 2)
+    consumed = _consume(old[0], 6) + _consume(old[1], 9)
+    states2 = [s.state_dict() for s in old]
+
+    mid = _world(shards, 3)
+    for j, s in enumerate(mid):
+        s.load_state_dict(remap_seekable_states(states2, j, 3))
+    consumed += [d for s in mid for d in _consume(s, 2)]
+    states3 = [s.state_dict() for s in mid]
+
+    remainder = []
+    for j in range(2):
+        src = _world(shards, 2, repeat=False)[j]
+        src.load_state_dict(remap_seekable_states(states3, j, 2))
+        remainder.extend(iter(src))
+
+    every = {f"doc-{i}" for i in range(30)}
+    assert len(consumed) == len(set(consumed)) == 21
+    assert not (set(consumed) & set(remainder))
+    assert set(consumed) | set(remainder) == every
+    assert len(consumed) + len(remainder) == 30
+
+
+def test_remap_same_world_is_identity(tmp_path):
+    from mlx_cuda_distributed_pretraining_tpu.data.streaming import (
+        remap_seekable_states,
+    )
+
+    shards = _mk_shards(tmp_path)
+    old = _world(shards, 2)
+    _consume(old[0], 4), _consume(old[1], 5)
+    states = [s.state_dict() for s in old]
+    assert remap_seekable_states(states, 1, 2) == states[1]
+
+
+def test_source_load_refuses_world_mismatch(tmp_path):
+    shards = _mk_shards(tmp_path)
+    state = _world(shards, 2)[0].state_dict()
+    with pytest.raises(ValueError, match="remap_seekable_states"):
+        _world(shards, 3)[0].load_state_dict(state)
+    with pytest.raises(ValueError, match="host mismatch"):
+        _world(shards, 2)[1].load_state_dict(state)
+
+
+def test_remap_data_states_partitions_buffers():
+    from mlx_cuda_distributed_pretraining_tpu.data.streaming import (
+        remap_data_states,
+    )
+
+    def src_state(i):
+        return {"epoch": 0, "shard_ptr": 0, "doc_ptr": 0, "emitted": 4 + i,
+                "taken": 4 + i, "process_count": 2, "process_index": i}
+
+    states = [
+        {"docs_consumed": 10, "buf": [1, 2], "source": src_state(0),
+         "process_count": 2, "process_index": 0},
+        {"docs_consumed": 12, "buf": [3], "source": src_state(1),
+         "process_count": 2, "process_index": 1},
+    ]
+    out = remap_data_states(states, 0, 1)
+    assert out["buf"] == [1, 2, 3]
+    assert out["docs_consumed"] == 22
+    assert out["process_count"] == 1 and out["process_index"] == 0
+    assert out["source"]["process_count"] == 1
+    assert out["source"]["tables"][-1]["world"] == 2
+    assert out["source"]["tables"][-1]["positions"] == [4, 5]
+
+
+def test_remap_data_states_refusals():
+    from mlx_cuda_distributed_pretraining_tpu.data.streaming import (
+        remap_data_states,
+    )
+
+    base = {"docs_consumed": 1, "buf": [], "process_count": 2}
+    with pytest.raises(ValueError, match="predates world stamping"):
+        remap_data_states([{"docs_consumed": 1}, {"docs_consumed": 2}], 0, 1)
+    with pytest.raises(ValueError, match="'hf'"):
+        remap_data_states(
+            [dict(base, process_index=0, hf={}),
+             dict(base, process_index=1, hf={})], 0, 1)
+    with pytest.raises(ValueError, match="'source'"):
+        remap_data_states(
+            [dict(base, process_index=0), dict(base, process_index=1)], 0, 1)
+    with pytest.raises(ValueError, match="one complete world"):
+        remap_data_states(
+            [dict(base, process_index=0, source={}),
+             dict(base, process_index=0, source={})], 0, 1)
+    with pytest.raises(ValueError, match="disagree"):
+        remap_data_states(
+            [dict(base, process_index=0, source={})], 0, 1)
+
+
+# -- reshard-on-load of optimizer state ------------------------------------
+
+
+def test_load_opt_state_resharded_per_device_slices(tmp_path):
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from mlx_cuda_distributed_pretraining_tpu.checkpoint.manager import (
+        CheckpointManager,
+        CheckpointIntegrityError,
+    )
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("fsdp",))
+    params = {"w": np.arange(32, dtype=np.float32).reshape(8, 4)}
+    opt_state = {
+        "mu": {"w": np.full((8, 4), 2.0, dtype=np.float32)},
+        "nu": {"w": np.full((8, 4), 3.0, dtype=np.float32)},
+        "count": 11,
+    }
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, params, opt_state=opt_state, training_state={"step": 1})
+
+    sh = NamedSharding(mesh, P("fsdp", None))
+    shardings = {"mu": {"w": sh}, "nu": {"w": sh}, "count": None}
+    live = {
+        "mu": {"w": jax.device_put(np.zeros((8, 4), np.float32), sh)},
+        "nu": {"w": jax.device_put(np.zeros((8, 4), np.float32), sh)},
+        "count": 0,
+    }
+    out = mgr.load_opt_state_resharded(1, live, shardings)
+    assert out is not None
+    np.testing.assert_array_equal(np.asarray(out["mu"]["w"]),
+                                  opt_state["mu"]["w"])
+    np.testing.assert_array_equal(np.asarray(out["nu"]["w"]),
+                                  opt_state["nu"]["w"])
+    assert int(out["count"]) == 11
+    # landed in the requested sharding: each device holds a (4, 4) slice
+    for leaf in (out["mu"]["w"], out["nu"]["w"]):
+        assert leaf.sharding.is_equivalent_to(sh, leaf.ndim)
+        assert sorted(s.data.shape for s in leaf.addressable_shards) \
+            == [(4, 4), (4, 4)]
+
+    # dtype/shape drift must refuse, not silently re-materialize
+    bad_live = {
+        "mu": {"w": jax.device_put(np.zeros((4, 8), np.float32), sh)},
+        "nu": live["nu"], "count": 0,
+    }
+    with pytest.raises(CheckpointIntegrityError, match="re-materialize"):
+        mgr.load_opt_state_resharded(1, bad_live, shardings)
+
+
+def test_load_opt_state_resharded_stacks_layers(tmp_path):
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from mlx_cuda_distributed_pretraining_tpu.checkpoint.manager import (
+        CheckpointManager,
+    )
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("fsdp",))
+    layer = lambda i: np.full((4, 2), float(i + 1), dtype=np.float32)  # noqa: E731
+    opt_state = {"mu": {"layers": {"0": {"w": layer(0)}, "1": {"w": layer(1)}}}}
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(2, {"w": np.zeros(2, np.float32)}, opt_state=opt_state,
+             training_state={"step": 2})
+
+    sh = NamedSharding(mesh, P(None, "fsdp", None))
+    stacked = jax.device_put(np.zeros((2, 4, 2), np.float32), sh)
+    out = mgr.load_opt_state_resharded(
+        2, {"mu": {"layers": {"w": stacked}}},
+        {"mu": {"layers": {"w": sh}}}, num_layers=2, interleave=1)
+    got = np.asarray(out["mu"]["layers"]["w"])
+    np.testing.assert_array_equal(got, np.stack([layer(0), layer(1)]))
+    assert out["mu"]["layers"]["w"].sharding.is_equivalent_to(sh, 3)
+
+
+def test_load_opt_state_resharded_missing_file(tmp_path):
+    from mlx_cuda_distributed_pretraining_tpu.checkpoint.manager import (
+        CheckpointManager,
+        CheckpointIntegrityError,
+    )
+
+    mgr = CheckpointManager(str(tmp_path))
+    assert mgr.load_opt_state_resharded(9, {"x": 0}, {"x": None}) is None
+    with pytest.raises(CheckpointIntegrityError, match="MISSING"):
+        mgr.load_opt_state_resharded(9, {"x": 0}, {"x": None}, strict=True)
+
+
+# -- config plumbing -------------------------------------------------------
+
+
+def test_system_distributed_config():
+    from mlx_cuda_distributed_pretraining_tpu.config import (
+        SupervisorConfig,
+        SystemConfig,
+    )
+
+    legacy = SystemConfig(distributed=False)
+    assert legacy.distributed_coordinator is None
+    assert legacy.distributed_num_processes is None
+    assert legacy.distributed_rendezvous_timeout_s == 120.0
+
+    sc = SystemConfig(distributed={"coordinator_address": "h:12345",
+                                   "num_processes": 4,
+                                   "rendezvous_timeout_s": 60})
+    assert sc.distributed_coordinator == "h:12345"
+    assert sc.distributed_num_processes == 4
+    assert sc.distributed_rendezvous_timeout_s == 60.0
+
+    assert SupervisorConfig().barrier_timeout_s == 300.0
+
+
+def test_sample_config_parses_distributed():
+    import os
+
+    from mlx_cuda_distributed_pretraining_tpu.config import Config
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg = Config.from_yaml(
+        os.path.join(repo, "configs", "model-config-sample.yaml"))
+    assert cfg.system.distributed_coordinator is None
+    assert cfg.system.distributed_rendezvous_timeout_s == 120.0
+    assert cfg.supervisor.barrier_timeout_s == 300.0
+
+
+# -- supervisor glue -------------------------------------------------------
+
+
+def test_supervisor_cmd_builder_per_generation_port():
+    import argparse
+
+    from mlx_cuda_distributed_pretraining_tpu.train.supervisor import (
+        _trainer_cmd_builder,
+        _wants_generation,
+    )
+
+    args = argparse.Namespace(
+        config="c.yaml", runs_root="runs", set=[], iters=None,
+        batch_size=None, learning_rate=None, run_name=None,
+        coordinator="localhost:4000", num_processes=2, process_id=1,
+        rendezvous_timeout_s=30.0)
+    build = _trainer_cmd_builder(args, "/nonexistent-run-dir")
+    assert _wants_generation(build)
+    assert not _wants_generation(lambda tag: [])
+
+    cmd1 = build(None, 1)
+    cmd3 = build("12", 3)
+    assert "localhost:4000" in cmd1
+    assert "localhost:4002" in cmd3
+    assert cmd3[cmd3.index("--num-processes") + 1] == "2"
+    assert cmd3[cmd3.index("--process-id") + 1] == "1"
+    assert "resume.checkpoint=12" in " ".join(cmd3)

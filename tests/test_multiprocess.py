@@ -23,12 +23,16 @@ _WORKER = textwrap.dedent(
     """
     import json, os, sys
     sys.path.insert(0, {repo!r})
-    import jax
-    jax.distributed.initialize(
+    # Real rendezvous (parallel/elastic.py): also enables gloo CPU
+    # collectives — without them every computation over a process-spanning
+    # sharding fails on the CPU backend.
+    from mlx_cuda_distributed_pretraining_tpu.parallel.elastic import rendezvous
+    rendezvous(
         coordinator_address={coord!r},
         num_processes=2,
         process_id=int(sys.argv[1]),
     )
+    import jax
     import jax.numpy as jnp
     import numpy as np
     import yaml
