@@ -383,6 +383,45 @@ def flops_per_token(n_params, num_layers, seq, d_attn):
     return 6.0 * n_params + 6.0 * num_layers * seq * d_attn
 
 
+def _profile_step_fractions(run_one, state, n_steps=2):
+    """graftprof columns for a train row: capture a short jax.profiler
+    window around ``n_steps`` re-dispatches of the already-compiled step
+    and attribute it (obs/profile_report.py), so every bench row carries
+    prof_compute_frac/prof_comm_frac/prof_overlap_frac/prof_idle_frac
+    next to mfu. BENCH_PROF=0 skips; any failure (profiler busy, tunnel
+    hiccup, unparseable dump) logs and returns {} — the timed numbers
+    above are already banked and must not be lost to attribution."""
+    if os.environ.get("BENCH_PROF") == "0":
+        return {}
+    import shutil
+    import tempfile
+
+    import jax
+
+    from mlx_cuda_distributed_pretraining_tpu.obs.profile_report import (
+        generate_report, prof_fields)
+
+    tmp = tempfile.mkdtemp(prefix="bench-prof-")
+    try:
+        import jax.profiler as _prof
+
+        _prof.start_trace(tmp)
+        try:
+            for i in range(n_steps):
+                with jax.profiler.StepTraceAnnotation("train", step_num=i):
+                    state = run_one(state)
+            jax.block_until_ready(jax.tree_util.tree_leaves(state)[:1])
+        finally:
+            _prof.stop_trace()
+        rep = generate_report(tmp)
+        return prof_fields(rep) if rep else {}
+    except Exception as e:  # noqa: BLE001 - attribution is best-effort
+        log(f"[bench] prof capture failed ({e}); prof columns omitted")
+        return {}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_train_case(name, scale_key, attn, vocab, steps, fused_ce=True,
                      optimizer="adamw", megastep=0):
     import jax
@@ -478,6 +517,8 @@ def bench_train_case(name, scale_key, attn, vocab, steps, fused_ce=True,
         final_loss = float(last_loss)  # host fetch syncs the chain
         dt = time.perf_counter() - t0
         steps = n_disp * mega
+        prof_cols = _profile_step_fractions(
+            lambda st: timed_exec(st)[0], state)
     else:
         timed_exec = step.lower(state, b).compile()  # one compile total
         state, metrics = timed_exec(state, b)  # warm
@@ -488,6 +529,8 @@ def bench_train_case(name, scale_key, attn, vocab, steps, fused_ce=True,
             state, metrics = timed_exec(state, b)
         final_loss = float(metrics["loss"])  # host fetch syncs the whole chain
         dt = time.perf_counter() - t0
+        prof_cols = _profile_step_fractions(
+            lambda st: timed_exec(st, b)[0], state)
 
     toks = steps * batch * seq
     tok_s = toks / dt
@@ -528,6 +571,7 @@ def bench_train_case(name, scale_key, attn, vocab, steps, fused_ce=True,
         "step_ms": round(1000 * dt / steps, 1),
         "flops_per_token": round(ft, 0),
         "mfu": mfu_or_unknown(ft, tok_s),
+        **prof_cols,
         "final_loss": round(final_loss, 3),
         "hbm_peak_gb": hbm_peak_gb,
         "hbm_src": hbm_src,
@@ -1617,7 +1661,13 @@ def bench_trainer_case(vocab, workdir="/tmp/bench_trainer", spd=1):
         },
         "logging": {"steps": {"logging_interval": 10,
                               "checkpoint_interval": 0,
-                              "validation_interval": 0}},
+                              "validation_interval": 0},
+                    # Short jax.profiler window past warmup: the trainer
+                    # auto-runs graftprof on stop and the row below reads
+                    # prof_summary.json, so the e2e case carries the same
+                    # prof_* columns as the bare-step rows.
+                    **({"profile_start": 25, "profile_stop": 28}
+                       if os.environ.get("BENCH_PROF") != "0" else {})},
         # scan_layers: the one live r4 window died in this case's compile
         # of an unscanned 12-layer stack (TUNNEL_NOTE_r4); scan shrinks the
         # XLA program ~12x here for identical math (parity-tested).
@@ -1650,12 +1700,26 @@ def bench_trainer_case(vocab, workdir="/tmp/bench_trainer", spd=1):
                         breakdown[key] = float(
                             line.split(f"{key}=")[1].split()[0].rstrip("|"))
     ft = t.flops_per_token  # analytic 6N + attention (obs/flops.py)
+    prof_cols = {}
+    summary_path = os.path.join(workdir, "runs", "bench-trainer",
+                                "prof_summary.json")
+    if os.path.isfile(summary_path):
+        # Written by the trainer's own graftprof auto-report when the
+        # profile window above closed.
+        try:
+            from mlx_cuda_distributed_pretraining_tpu.obs.profile_report import (
+                prof_fields)
+            with open(summary_path) as f:
+                prof_cols = prof_fields(json.load(f))
+        except Exception as e:  # noqa: BLE001 - columns are best-effort
+            log(f"[bench] trainer prof summary unreadable ({e})")
     return {
         "case": "trainer_40m_flash_e2e" + (f"_spd{spd}" if spd > 1 else ""),
         "batch": batch, "seq": seq,
         "vocab": vocab, "tok_s": tok_s, "wall_s": round(dt, 1),
         "flops_per_token": round(ft, 0),
         "mfu": mfu_or_unknown(ft, tok_s),
+        **prof_cols,
         **breakdown,
         **({"steps_per_dispatch": spd} if spd > 1 else {}),
         # The Trainer's own SIGTERM handler consumed a kill signal (it
@@ -2188,6 +2252,49 @@ def _audit_gate() -> None:
     sys.exit(1)
 
 
+def _perf_gate() -> None:
+    """Perf companion to the lint/audit gates, run AFTER the bench so it
+    scores the matrix this run just measured: scripts/perf_gate.py
+    compares the rows against the committed bench_baseline.json
+    (tok_s, mfu, prof_* columns) with a noise tolerance. A confirmed
+    regression exits nonzero so CI notices; exit 2 (no doc / no baseline
+    / nothing comparable) and crashes never gate — infrastructure
+    problems are not regressions. BENCH_PERF=0 is the escape hatch."""
+    if os.environ.get("BENCH_PERF") == "0":
+        return
+    import subprocess
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    gate = os.path.join(repo, "scripts", "perf_gate.py")
+    try:
+        # Hand the gate THIS run's matrix (the driver archives stdout to
+        # BENCH_*.json only after exit, so "newest on disk" would be the
+        # previous round's doc).
+        doc = build_doc(_MATRIX, _DEVICE, _VOCAB, "perf_gate", elapsed())
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".json", prefix="BENCH_gate_",
+                delete=False) as f:
+            json.dump(doc, f)
+            tmp_doc = f.name
+        proc = subprocess.run(
+            [sys.executable, gate, "--bench", tmp_doc],
+            capture_output=True, text=True, cwd=repo, timeout=120)
+        os.unlink(tmp_doc)
+    except Exception as e:  # noqa: BLE001 - the gate must not brick benching
+        log(f"[bench] perf gate errored ({e}); continuing without it")
+        return
+    for line in (proc.stdout or "").splitlines()[:40]:
+        log(f"[bench] {line}")
+    if proc.returncode == 1:
+        log("[bench] perf gate: REGRESSION vs bench_baseline.json "
+            "(BENCH_PERF=0 to skip)")
+        sys.exit(1)
+    if proc.returncode not in (0, 1):
+        log(f"[bench] perf gate inconclusive (exit {proc.returncode}): "
+            f"{(proc.stderr or '')[-200:]}")
+
+
 def main() -> None:
     global _VOCAB, _DEVICE
     _VOCAB = vocab = int(os.environ.get("BENCH_VOCAB", "32768"))
@@ -2232,6 +2339,7 @@ def main() -> None:
         run_case(case_id, reserve, inproc_thunk=thunk if inproc else None)
 
     emit(reason="final")
+    _perf_gate()  # after emit: the gate scores the doc this run produced
 
 
 if __name__ == "__main__":

@@ -1,0 +1,206 @@
+"""graftprof CLI: step-time attribution from a jax.profiler dump.
+
+    python -m mlx_cuda_distributed_pretraining_tpu.analysis.prof <path>
+
+``<path>`` is a run dir (containing ``profile/``), a profiler dump dir
+(containing ``plugins/profile/<session>/``), a session dir, or a single
+``*.trace.json(.gz)`` file. Prints the per-step attribution table
+(obs/profile_report.format_report key=value lines) and writes
+``prof_summary.json`` next to the dump.
+
+Analytic joins are best-effort and stdlib-only:
+
+- run dirs: ``events.jsonl`` ``run_start`` (n_params, flops_per_token)
+  and ``step_window`` (toks per window / steps) recover
+  tokens-per-step and the 6N matmul term; ``config.yaml`` recovers the
+  attention split (6 * L * S * num_heads * head_dim).
+- ``--budgets <file>`` joins collective bytes from a PR 12
+  collective-census budget (analysis/budgets/<config>.json), giving
+  achieved bytes/s per collective kind. When ``<path>`` is a run dir
+  whose config name matches a committed budget, the join is automatic.
+
+Missing inputs degrade to a time-only table — never an error; a perf
+investigation should not require a pristine run dir.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, Optional
+
+from ..obs.events import iter_events
+from ..obs.profile_report import (
+    SUMMARY_FILENAME,
+    find_trace_files,
+    format_report,
+    generate_report,
+    write_summary,
+)
+from .core import PACKAGE_NAME
+
+
+def _load_yaml_config(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        import yaml
+        with open(path, "r", encoding="utf-8") as f:
+            doc = yaml.safe_load(f)
+        return doc if isinstance(doc, dict) else None
+    except Exception:
+        return None
+
+
+def analytic_from_run_dir(run_dir: str) -> Dict[str, Any]:
+    """Recover the analytic cost model from a run dir's artifacts.
+
+    Returns a (possibly empty) dict with any of: tokens_per_step,
+    matmul_flops_per_token, attn_flops_per_token,
+    collective_bytes_per_step, config_name.
+    """
+    out: Dict[str, Any] = {}
+    ev_path = os.path.join(run_dir, "events.jsonl")
+    if os.path.isfile(ev_path):
+        n_params = flops_tok = None
+        toks = steps = 0.0
+        for ev in iter_events(ev_path):
+            et = ev.get("type")
+            if et == "run_start":
+                n_params = ev.get("n_params")
+                flops_tok = ev.get("flops_per_token")
+                if ev.get("name"):
+                    out["config_name"] = str(ev["name"])
+            elif et == "step_window":
+                toks += float(ev.get("toks") or 0.0)
+                steps += float(ev.get("steps") or 1.0)
+        if steps > 0 and toks > 0:
+            out["tokens_per_step"] = toks / steps
+        if n_params:
+            out["matmul_flops_per_token"] = 6.0 * float(n_params)
+            if flops_tok:
+                # run_start's flops_per_token is 6N + attn term; the
+                # residual is the attention split, exactly.
+                out["attn_flops_per_token"] = max(
+                    0.0, float(flops_tok) - 6.0 * float(n_params))
+    cfg = _load_yaml_config(os.path.join(run_dir, "config.yaml"))
+    if cfg and "attn_flops_per_token" not in out:
+        try:
+            model = cfg.get("model") or {}
+            dims = model.get("dimensions") or {}
+            attn = model.get("attention") or {}
+            prep = (cfg.get("data") or {}).get("preprocessing") or {}
+            layers = int(dims.get("num_layers") or 0)
+            heads = int(attn.get("num_heads") or 0)
+            head_dim = attn.get("head_dim")
+            if head_dim is None and heads:
+                head_dim = int(dims.get("hidden_size") or 0) // heads
+            seq = int(prep.get("max_context_size") or 0)
+            if layers and heads and head_dim and seq:
+                out["attn_flops_per_token"] = (
+                    6.0 * layers * seq * heads * int(head_dim))
+        except (TypeError, ValueError):
+            pass
+    if cfg and "config_name" not in out and cfg.get("name"):
+        out["config_name"] = str(cfg["name"])
+    return out
+
+
+def _default_budget_path(config_name: str) -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    slug = config_name.strip().lower()
+    # Budget files are keyed by config file stem ("model-config-sample"),
+    # not display name ("Llama (2M)") — try the stem-ish slug only.
+    return os.path.join(here, "budgets", slug + ".json")
+
+
+def load_budget_bytes(path: str) -> Optional[Dict[str, float]]:
+    """``{collective kind: bytes per train_step}`` from a graftaudit
+    budget file; None when unreadable."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        coll = (doc.get("programs") or {}).get("train_step", {}) \
+            .get("collectives") or {}
+        out = {}
+        for kind, row in coll.items():
+            b = row.get("bytes") if isinstance(row, dict) else None
+            if b:
+                out[str(kind)] = float(b)
+        return out or None
+    except Exception:
+        return None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog=f"python -m {PACKAGE_NAME}.analysis.prof",
+        description="graftprof: per-step compute/comm/host/idle "
+                    "attribution from a jax.profiler chrome-trace dump")
+    ap.add_argument("path",
+                    help="run dir, profiler dump dir, session dir, or "
+                         "a *.trace.json(.gz) file")
+    ap.add_argument("--budgets", default=None,
+                    help="graftaudit budget JSON for collective-bytes "
+                         "joins (default: analysis/budgets/ match on "
+                         "the run's config stem, when present)")
+    ap.add_argument("--top", type=int, default=12,
+                    help="rows in the op table (default 12)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="summary path (default: <run-or-dump "
+                         "dir>/prof_summary.json; '-' to skip)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+
+    path = args.path
+    analytic: Dict[str, Any] = {}
+    if os.path.isdir(path):
+        analytic = analytic_from_run_dir(path)
+    budget_path = args.budgets
+    if budget_path is None:
+        # configs/ stem match: a run dir config.yaml has no stem, so the
+        # auto-join only fires when the budget filename matches the
+        # config display name slug — explicit --budgets otherwise.
+        name = str(analytic.get("config_name") or "")
+        cand = _default_budget_path(name) if name else ""
+        budget_path = cand if cand and os.path.isfile(cand) else None
+    if budget_path:
+        b = load_budget_bytes(budget_path)
+        if b:
+            analytic["collective_bytes_per_step"] = b
+            analytic["budget_file"] = os.path.basename(budget_path)
+
+    report = generate_report(path, analytic=analytic or None,
+                             top_k=args.top)
+    if report is None:
+        hint = ""
+        if os.path.isdir(path) and not find_trace_files(path):
+            hint = (" (no plugins/profile/*/\\*.trace.json[.gz] found — "
+                    "set logging.profile_start/profile_stop or SIGUSR2 "
+                    "the trainer to capture one)")
+        print(f"graftprof: no profiler trace under {path}{hint}",
+              file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        for line in format_report(report):
+            print(line)
+
+    json_out = args.json_out
+    if json_out != "-":
+        if json_out is None:
+            base = path if os.path.isdir(path) else os.path.dirname(path)
+            json_out = os.path.join(base or ".", SUMMARY_FILENAME)
+        try:
+            write_summary(report, json_out)
+            print(f"summary={json_out}")
+        except OSError as e:
+            print(f"graftprof: could not write {json_out}: {e}",
+                  file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

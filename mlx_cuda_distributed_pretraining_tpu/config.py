@@ -186,13 +186,20 @@ class LoggingConfig:
     # keep_last: 0 disables GC (keep everything).
     retention: Dict[str, Any] = field(default_factory=dict)
     # Prometheus text exposition of the in-process metrics registry
-    # (obs/prometheus.py) on this port; 0 disables. Chief process only.
+    # (obs/prometheus.py) on this port; 0 disables. Every process serves:
+    # process i binds metrics_port + i and stamps process_index into the
+    # exposition, so multi-host scrapes stay disambiguated.
     metrics_port: int = 0
     # Span tracer (obs/trace.py): {enabled: bool, sample: float,
     # capacity: int, capture_steps: int}. capture_steps sizes the
     # SIGUSR2 on-demand window (spans + jax.profiler for the next N
     # steps without restarting the run).
     trace: Dict[str, Any] = field(default_factory=dict)
+    # graftprof auto-attribution (obs/profile_report.py) whenever a
+    # jax.profiler capture stops: {enabled: bool, top_k: int}. enabled
+    # defaults on — a captured trace that nobody attributes is the
+    # status quo this knob exists to end; top_k sizes the op table.
+    profile_report: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def logging_interval(self) -> int:
@@ -220,6 +227,14 @@ class LoggingConfig:
     @property
     def keep_every(self) -> int:
         return int(_get(self.retention, "keep_every", 0))
+
+    @property
+    def profile_report_enabled(self) -> bool:
+        return bool(_get(self.profile_report, "enabled", True))
+
+    @property
+    def profile_report_top_k(self) -> int:
+        return int(_get(self.profile_report, "top_k", 12))
 
 
 @dataclass

@@ -38,9 +38,15 @@ def _fmt_value(v: float) -> str:
     return repr(f)
 
 
-def render_prometheus(snapshot: Dict[str, Any]) -> str:
+def render_prometheus(snapshot: Dict[str, Any],
+                      process_index: Optional[int] = None) -> str:
     """Registry snapshot (obs/metrics.py::MetricsRegistry.snapshot) →
-    Prometheus text exposition format 0.0.4."""
+    Prometheus text exposition format 0.0.4.
+
+    ``process_index`` stamps a ``process_index`` gauge into the output so
+    multi-host scrapes (one exporter per process on
+    ``metrics_port + process_index``) disambiguate which host answered
+    even when the scraper only recorded the target address."""
     lines = []
     for name in sorted(snapshot):
         if name.startswith("_"):
@@ -66,6 +72,11 @@ def render_prometheus(snapshot: Dict[str, Any]) -> str:
                  "refused by the per-metric series bound")
     lines.append("# TYPE telemetry_dropped_series_total counter")
     lines.append(f"telemetry_dropped_series_total {dropped}")
+    if process_index is not None:
+        lines.append("# HELP process_index jax process index serving "
+                     "this exposition")
+        lines.append("# TYPE process_index gauge")
+        lines.append(f"process_index {int(process_index)}")
     return "\n".join(lines) + "\n"
 
 
@@ -73,14 +84,18 @@ class MetricsServer:
     """Background HTTP server bound to one registry. ``port`` is the bound
     port (useful when constructed with port 0 in tests)."""
 
-    def __init__(self, registry, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, registry, host: str = "127.0.0.1", port: int = 0,
+                 process_index: Optional[int] = None):
         self.registry = registry
+        self.process_index = process_index
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — http.server API
                 if self.path.split("?")[0] == "/metrics":
-                    body = render_prometheus(outer.registry.snapshot()).encode()
+                    body = render_prometheus(
+                        outer.registry.snapshot(),
+                        process_index=outer.process_index).encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif self.path.split("?")[0] in ("/healthz", "/health"):
                     body = b"ok\n"
@@ -116,11 +131,17 @@ class MetricsServer:
             pass
 
 
-def start_metrics_server(registry, port: int,
-                         host: str = "0.0.0.0") -> Optional[MetricsServer]:
+def start_metrics_server(registry, port: int, host: str = "0.0.0.0",
+                         process_index: Optional[int] = None,
+                         ) -> Optional[MetricsServer]:
     """Start the exporter, or return None (with no exception escaping) when
-    the port is taken — telemetry must never kill training."""
+    the port is taken — telemetry must never kill training.
+
+    Multi-host fleets start one exporter per process (the trainer offsets
+    the configured port by ``jax.process_index()``) and pass that index
+    so the exposition self-identifies."""
     try:
-        return MetricsServer(registry, host=host, port=int(port))
+        return MetricsServer(registry, host=host, port=int(port),
+                             process_index=process_index)
     except OSError:
         return None

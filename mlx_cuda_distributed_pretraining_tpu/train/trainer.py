@@ -432,6 +432,24 @@ class Trainer:
         self._trace_until = 0     # on-demand window end step (exclusive)
         self._trace_owns_prof = False
         self._trace_prev_enabled = self.tracer.enabled
+        # Single owner of jax.profiler start/stop (obs/profiler.py): the
+        # profile window, SIGUSR2 capture, and end-of-run finally all go
+        # through it, and every stop runs the graftprof attribution over
+        # the fresh dump (logging.profile_report.enabled gates it).
+        from ..obs.profiler import ProfileCapture
+
+        self.profiler = ProfileCapture(
+            os.path.join(run_dir, "profile"),
+            log=self.logger.log,
+            sync=lambda: jax.block_until_ready(self.state["step"]),
+            analytic_fn=self._prof_analytic,
+            summary_path=os.path.join(run_dir, "prof_summary.json"),
+            report=cfg.logging.profile_report_enabled,
+            top_k=cfg.logging.profile_report_top_k)
+        # Last attribution's headline fractions: exported as gauges and
+        # merged into subsequent step_window events so the profile's
+        # breakdown rides the same durable stream as tok/s and MFU.
+        self._prof_fields: Dict[str, float] = {}
         self._compiled = False  # first dispatch books into compile_s
         self._metrics_server = None
         # events.jsonl is the durable telemetry source: replay it FIRST so
@@ -491,6 +509,23 @@ class Trainer:
             "train_tok_s", "global tokens/second over the last window")
         self._g_mfu = self.metrics.gauge(
             "train_mfu", "model FLOPs utilization over the last window")
+        self._g_prof = {
+            "prof_compute_frac": self.metrics.gauge(
+                "prof_compute_frac",
+                "step time in compute ops (last graftprof attribution)"),
+            "prof_comm_frac": self.metrics.gauge(
+                "prof_comm_frac",
+                "step time in EXPOSED collectives (not hidden under "
+                "compute) from the last graftprof attribution"),
+            "prof_overlap_frac": self.metrics.gauge(
+                "prof_overlap_frac",
+                "fraction of collective time overlapped with compute "
+                "(1.0 = fully hidden) from the last graftprof attribution"),
+            "prof_idle_frac": self.metrics.gauge(
+                "prof_idle_frac",
+                "step time with no device op running (last graftprof "
+                "attribution)"),
+        }
         if self.moe_stats_experts:
             self._m_moe_dropped = self.metrics.counter(
                 "moe_dropped_tokens_total",
@@ -584,6 +619,45 @@ class Trainer:
                             process_index=jax.process_index())
         except OSError:
             pass  # heartbeat is advisory; never kill training over it
+
+    def _prof_analytic(self) -> Dict[str, Any]:
+        """Analytic joins for the graftprof report: the exact numbers the
+        trainer already holds for MFU, split into the 6N matmul term and
+        the attention residual (obs/flops.py convention)."""
+        cfg = self.config
+        matmul = 6.0 * float(self.n_params)
+        return {
+            "tokens_per_step": float(cfg.training.batch_size)
+            * float(cfg.data.max_context_size),
+            "matmul_flops_per_token": matmul,
+            "attn_flops_per_token": max(
+                0.0, float(self.flops_per_token) - matmul),
+        }
+
+    def _apply_profile_report(self, report, step: Optional[int]) -> None:
+        """Fan one graftprof attribution out to gauges, the event log,
+        and the run log. No-op on None (capture yielded nothing)."""
+        if not report:
+            return
+        from ..obs.profile_report import prof_fields
+
+        fields = prof_fields(report)
+        self._prof_fields = fields
+        for name, val in fields.items():
+            self._g_prof[name].set(val)
+        agg = report["aggregate"]
+        self.logger.log(
+            f"graftprof: steps={agg['n_steps']} "
+            f"compute={fields['prof_compute_frac']:.3f} "
+            f"comm_exposed={fields['prof_comm_frac']:.3f} "
+            f"overlap={fields['prof_overlap_frac']:.3f} "
+            f"idle={fields['prof_idle_frac']:.3f} "
+            f"(summary: {self.profiler.summary_path})")
+        if self.events is not None:
+            ev = dict(fields)
+            if step is not None:
+                ev["step"] = int(step)
+            self.events.append("profile_report", **ev)
 
     def _save_checkpoint_inner(self, step, blocking: bool = True) -> None:
         # The host gather is a COLLECTIVE when state is sharded across
@@ -963,7 +1037,6 @@ class Trainer:
         # Optional jax.profiler trace window [profile_start, profile_stop).
         prof_start = int(cfg.logging.profile_start or 0)
         prof_stop = int(cfg.logging.profile_stop or 0)
-        prof_active = False
 
         if self.start_step == 0 and val_int:
             v = self.validate()
@@ -1008,23 +1081,28 @@ class Trainer:
         )
 
         # Telemetry endpoints for the run: Prometheus exposition behind
-        # logging.metrics_port (chief only; stays up after train() returns
-        # — daemon thread — so late scrapes see the final counters), the
-        # run_start event, and the first heartbeat so the supervisor's
-        # hang watchdog has a baseline that covers the initial compile.
-        if (cfg.logging.metrics_port and self._metrics_server is None
-                and jax.process_index() == 0):
+        # logging.metrics_port (EVERY process serves — process i binds
+        # metrics_port + i and stamps process_index into the exposition,
+        # so multi-host fleets expose all hosts, not just the chief; the
+        # server stays up after train() returns — daemon thread — so late
+        # scrapes see the final counters), the run_start event, and the
+        # first heartbeat so the supervisor's hang watchdog has a
+        # baseline that covers the initial compile.
+        if cfg.logging.metrics_port and self._metrics_server is None:
             from ..obs.prometheus import start_metrics_server
 
+            pidx = jax.process_index()
+            port = int(cfg.logging.metrics_port) + pidx
             self._metrics_server = start_metrics_server(
-                self.metrics, cfg.logging.metrics_port)
+                self.metrics, port, process_index=pidx)
             if self._metrics_server is not None:
                 self.logger.log(
                     f"telemetry: serving Prometheus metrics on "
-                    f":{self._metrics_server.port}/metrics")
+                    f":{self._metrics_server.port}/metrics "
+                    f"(process {pidx})")
             else:
                 self.logger.log(
-                    f"telemetry: metrics port {cfg.logging.metrics_port} "
+                    f"telemetry: metrics port {port} "
                     f"unavailable; exporter disabled")
         self._touch_heartbeat(self.start_step)
 
@@ -1075,24 +1153,15 @@ class Trainer:
         try:
             for step in range(self.start_step + 1, self.total_steps + 1):
                 if prof_stop > prof_start:
-                    if step >= prof_stop and prof_active:
-                        import jax.profiler as _prof
-
-                        jax.block_until_ready(self.state["step"])
-                        _prof.stop_trace()
-                        prof_active = False
-                        self.logger.log(
-                            f"profiler: trace written to {os.path.join(self.run_dir, 'profile')}"
-                        )
+                    if step >= prof_stop and self.profiler.active:
+                        report = self.profiler.stop(step)
+                        self._apply_profile_report(report, step)
                         if self.events is not None:
                             self.events.append("profiler", action="stop", step=step)
-                    elif prof_start <= step < prof_stop and not prof_active:
-                        import jax.profiler as _prof
-
-                        _prof.start_trace(os.path.join(self.run_dir, "profile"))
-                        prof_active = True
-                        self.logger.log(f"profiler: trace started at step {step}")
-                        if self.events is not None:
+                    elif prof_start <= step < prof_stop \
+                            and not self.profiler.active:
+                        if self.profiler.start(step) \
+                                and self.events is not None:
                             self.events.append("profiler", action="start", step=step)
                 # On-demand capture window (SIGUSR2): both edges gate on
                 # group boundaries (`not pending`) so a scan-dispatched
@@ -1100,13 +1169,10 @@ class Trainer:
                 if self._trace_until and step >= self._trace_until \
                         and not pending:
                     self._trace_until = 0
-                    if self._trace_owns_prof and prof_active:
-                        import jax.profiler as _prof
-
-                        jax.block_until_ready(self.state["step"])
-                        _prof.stop_trace()
-                        prof_active = False
+                    if self._trace_owns_prof and self.profiler.active:
+                        report = self.profiler.stop(step)
                         self._trace_owns_prof = False
+                        self._apply_profile_report(report, step)
                     out = os.path.join(self.run_dir, f"trace_step{step}.json")
                     self.tracer.export(out)
                     self.tracer.enabled = self._trace_prev_enabled
@@ -1120,17 +1186,10 @@ class Trainer:
                     self._trace_until = step + max(1, self._trace_capture_steps)
                     self._trace_prev_enabled = self.tracer.enabled
                     self.tracer.enabled = True
-                    if not prof_active:
-                        import jax.profiler as _prof
-
-                        try:
-                            _prof.start_trace(
-                                os.path.join(self.run_dir, "profile"))
-                            prof_active = True
-                            self._trace_owns_prof = True
-                        except Exception as e:  # noqa: BLE001 - capture is best-effort
-                            self.logger.log(
-                                f"trace capture: profiler unavailable ({e})")
+                    if not self.profiler.active:
+                        # start() never raises (capture is best-effort);
+                        # a refused start just means spans-only capture.
+                        self._trace_owns_prof = self.profiler.start(step)
                     self.logger.log(
                         f"trace capture: recording steps "
                         f"[{step}, {self._trace_until})")
@@ -1320,6 +1379,10 @@ class Trainer:
                             goodput={k: round(v, 6) for k, v in gp.items()})
                         if self.pipeline:
                             ev["bubble"] = round(self._bubble_frac, 6)
+                        # Latest graftprof fractions ride every window
+                        # after a capture, so the durable stream records
+                        # the breakdown next to the tok/s it explains.
+                        ev.update(self._prof_fields)
                         self.events.append("step_window", **ev)
                     if self.tracer.enabled:
                         self.tracer.instant(
@@ -1391,11 +1454,11 @@ class Trainer:
                 self.checkpoints.wait()
             except RuntimeError as e:
                 self.logger.log(str(e))
-            if prof_active:
-                import jax.profiler as _prof
-
-                jax.block_until_ready(self.state["step"])
-                _prof.stop_trace()
+            if self.profiler.active:
+                # Run ended inside a capture window: the trace is still
+                # worth attributing (gauges + summary survive the run).
+                self._apply_profile_report(
+                    self.profiler.stop(), int(self.state["step"]))
             # Persist spans (run-long tracing, or an on-demand window cut
             # short by run end) next to the run's logs.
             if self.tracer.enabled and self.tracer.stats()["recorded"]:

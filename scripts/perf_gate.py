@@ -1,0 +1,232 @@
+#!/usr/bin/env python3
+"""perf_gate: compare the newest BENCH_*.json against bench_baseline.json.
+
+The bench harness emits a stdout-contract doc per run (bench.py
+``build_doc``: ``{metric, value, matrix: [rows]}``) that the driver
+archives as ``BENCH_<tag>.json`` at the repo root. This gate reads the
+newest such doc and compares every case/metric pinned in the committed
+``bench_baseline.json`` with a noise tolerance:
+
+- throughput metrics (``tok_s``, ``mfu``) compare RELATIVELY: a case
+  regresses when ``now < base * (1 - tolerance)``;
+- the graftprof fraction columns (``prof_*_frac``) compare ABSOLUTELY
+  (relative deltas blow up near 0.0): regression when the delta in the
+  bad direction exceeds ``tolerance`` outright.
+
+Higher is better for tok_s / mfu / prof_compute_frac /
+prof_overlap_frac; lower is better for prof_comm_frac / prof_idle_frac.
+
+Exit codes: 0 clean (improvements print a refresh-baseline hint),
+1 regression, 2 infrastructure (no bench doc / no baseline / nothing
+comparable) — bench.py's ``_perf_gate`` treats 2 like the audit gate
+treats a crash: logged, never gating. Rows whose values are null
+(device-unreachable skip rows) are skipped, not failed.
+
+``--write-baseline`` regenerates bench_baseline.json from the newest
+doc's complete rows, preserving the configured tolerance.
+
+Stdlib only; run as ``python scripts/perf_gate.py`` from anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(REPO, "bench_baseline.json")
+
+# metric -> +1 (higher is better) / -1 (lower is better)
+DIRECTIONS = {
+    "tok_s": +1,
+    "mfu": +1,
+    "prof_compute_frac": +1,
+    "prof_overlap_frac": +1,
+    "prof_comm_frac": -1,
+    "prof_idle_frac": -1,
+}
+# Fractions gate on absolute deltas; everything else relatively.
+ABSOLUTE = tuple(m for m in DIRECTIONS if m.endswith("_frac"))
+BASELINE_METRICS = tuple(DIRECTIONS)
+
+
+def find_newest_bench(root: str) -> Optional[str]:
+    """Newest parseable BENCH_*.json carrying a matrix."""
+    best: Tuple[float, Optional[str]] = (-1.0, None)
+    for path in glob.glob(os.path.join(root, "BENCH_*.json")):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+            if not isinstance(doc.get("matrix"), list):
+                continue
+            mt = os.path.getmtime(path)
+        except (OSError, json.JSONDecodeError, ValueError):
+            continue
+        if mt > best[0]:
+            best = (mt, path)
+    return best[1]
+
+
+def _rows_by_case(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    """First complete (tok_s numeric, not preempted) row per case —
+    same clean-row preference as bench.py's headline pick."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for row in doc.get("matrix") or []:
+        case = row.get("case")
+        if not case or case in out:
+            continue
+        if not isinstance(row.get("tok_s"), (int, float)):
+            continue
+        if row.get("preempted"):
+            continue
+        out[str(case)] = row
+    return out
+
+
+def compare(doc: Dict[str, Any], baseline: Dict[str, Any],
+            tolerance: Optional[float] = None
+            ) -> Tuple[List[str], List[str], List[str]]:
+    """(lines, regressions, improvements) over every pinned metric."""
+    tol = float(baseline.get("tolerance", 0.15)
+                if tolerance is None else tolerance)
+    rows = _rows_by_case(doc)
+    lines: List[str] = []
+    regressions: List[str] = []
+    improvements: List[str] = []
+    for case, pinned in sorted((baseline.get("cases") or {}).items()):
+        row = rows.get(case)
+        if row is None:
+            lines.append(f"perf_gate: case={case} SKIP (no complete row "
+                         f"in this bench doc)")
+            continue
+        for metric, base in sorted(pinned.items()):
+            if metric not in DIRECTIONS \
+                    or not isinstance(base, (int, float)):
+                continue
+            now = row.get(metric)
+            if not isinstance(now, (int, float)):
+                lines.append(f"perf_gate: case={case} metric={metric} "
+                             f"SKIP (not measured this run)")
+                continue
+            sign = DIRECTIONS[metric]
+            if metric in ABSOLUTE:
+                delta = (now - base) * sign
+                bad = delta < -tol
+                good = delta > tol
+                shown = f"delta={(now - base) * sign:+.4f} (abs)"
+            else:
+                if base == 0:
+                    continue
+                rel = (now - base) / abs(base) * sign
+                bad = rel < -tol
+                good = rel > tol
+                shown = f"delta={rel * 100:+.1f}%"
+            tag = "REGRESSION" if bad else ("IMPROVED" if good else "ok")
+            line = (f"perf_gate: case={case} metric={metric} "
+                    f"base={base} now={now} {shown} "
+                    f"tolerance={tol} {tag}")
+            lines.append(line)
+            if bad:
+                regressions.append(line)
+            elif good:
+                improvements.append(line)
+    return lines, regressions, improvements
+
+
+def write_baseline(doc: Dict[str, Any], path: str, tolerance: float,
+                   source: str) -> int:
+    """Pin every complete row's gateable metrics; returns cases pinned."""
+    cases: Dict[str, Dict[str, float]] = {}
+    for case, row in sorted(_rows_by_case(doc).items()):
+        pinned = {m: row[m] for m in BASELINE_METRICS
+                  if isinstance(row.get(m), (int, float))}
+        if pinned:
+            cases[case] = pinned
+    out = {"version": 1, "tool": "perf_gate", "tolerance": tolerance,
+           "source": os.path.basename(source), "cases": cases}
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return len(cases)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python scripts/perf_gate.py",
+        description="gate the newest BENCH_*.json against "
+                    "bench_baseline.json with a noise tolerance")
+    ap.add_argument("--bench", default=None,
+                    help="bench doc to check (default: newest "
+                         "BENCH_*.json at the repo root)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--tolerance", type=float, default=None,
+                    help="override the baseline's committed tolerance")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from the newest doc's "
+                         "complete rows and exit 0")
+    args = ap.parse_args(argv)
+
+    bench_path = args.bench or find_newest_bench(REPO)
+    if bench_path is None or not os.path.isfile(bench_path):
+        print("perf_gate: no BENCH_*.json doc found — run bench.py first",
+              file=sys.stderr)
+        return 2
+    try:
+        with open(bench_path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"perf_gate: unreadable bench doc {bench_path}: {e}",
+              file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        try:
+            with open(args.baseline, "r", encoding="utf-8") as f:
+                prev_tol = float(json.load(f).get("tolerance", 0.15))
+        except (OSError, json.JSONDecodeError, ValueError):
+            prev_tol = 0.15
+        tol = prev_tol if args.tolerance is None else args.tolerance
+        n = write_baseline(doc, args.baseline, tol, bench_path)
+        print(f"perf_gate: baseline refreshed from "
+              f"{os.path.basename(bench_path)} ({n} cases) -> "
+              f"{args.baseline}")
+        return 0
+
+    try:
+        with open(args.baseline, "r", encoding="utf-8") as f:
+            baseline = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"perf_gate: no baseline at {args.baseline} ({e}); "
+              f"create one with --write-baseline", file=sys.stderr)
+        return 2
+
+    lines, regressions, improvements = compare(doc, baseline,
+                                               args.tolerance)
+    print(f"perf_gate: doc={os.path.basename(bench_path)} "
+          f"baseline={os.path.basename(args.baseline)}")
+    for line in lines:
+        print(line)
+    if regressions:
+        print(f"perf_gate: {len(regressions)} regression(s) beyond "
+              f"tolerance — investigate before merging "
+              f"(BENCH_PERF=0 skips the bench-side gate)")
+        return 1
+    if improvements:
+        print(f"perf_gate: {len(improvements)} metric(s) improved beyond "
+              f"tolerance — refresh the baseline to lock the gain in: "
+              f"python scripts/perf_gate.py --write-baseline")
+    if not any("ok" in l or "REGRESSION" in l or "IMPROVED" in l
+               for l in lines):
+        print("perf_gate: nothing comparable (all rows skipped)")
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -24,15 +24,26 @@ Prints, in ``key=value`` form:
   * trainer step-time attribution — per-phase totals from the goodput
     ledger's span mirrors (data_wait / h2d_wait / dispatch / ckpt_save
     / eval / compile) next to the MFU the ``step_window`` instants
-    reported — when a trainer trace file is among the inputs.
+    reported — when a trainer trace file is among the inputs;
+  * with ``--run-dir <run>``: the run's own trace exports join the
+    inputs automatically, and when the run holds a jax.profiler dump
+    (``<run>/profile/``) the graftprof op-level attribution
+    (obs/profile_report.py: compute/comm/host/idle fractions, overlap,
+    top-k ops) is appended — ledger-, span-, and op-level views of the
+    same step window from one command.
 
-Stdlib-only: runs on dumped JSON anywhere, no repo install needed.
+Stdlib-only: runs on dumped JSON anywhere, no repo install needed (the
+graftprof fold imports the in-repo package via a repo-root fallback and
+degrades to a note if unavailable).
 """
 
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
+import sys
 from typing import Any, Dict, List, Optional
 
 # Trainer phase span names (obs/trace.py complete() mirrors of the
@@ -250,7 +261,44 @@ def trainer_report(spans, instants) -> List[str]:
     return lines
 
 
-def report(paths: List[str], top: int = 5) -> List[str]:
+def graftprof_report(run_dir: str) -> List[str]:
+    """graftprof fold: when the run dir holds a jax.profiler dump
+    (``<run_dir>/profile/plugins/profile/...``), append the op-level
+    attribution (obs/profile_report.py) under the span-level one, so a
+    single command shows ledger-, span-, and op-level views of the same
+    step window. Quiet when there is no dump; degrades to a note when
+    the package is not importable (this script runs uninstalled — the
+    repo-root fallback covers in-tree use)."""
+    try:
+        try:
+            from mlx_cuda_distributed_pretraining_tpu.obs import (
+                profile_report)
+        except ImportError:
+            sys.path.insert(0, os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+            from mlx_cuda_distributed_pretraining_tpu.obs import (
+                profile_report)
+    except ImportError:
+        return ["graftprof=0 reason=package_unavailable"]
+    try:
+        rep = profile_report.generate_report(run_dir)
+    except Exception as e:  # noqa: BLE001 - fold is best-effort
+        return [f"graftprof=0 reason=error detail={type(e).__name__}"]
+    if rep is None:
+        return []
+    return profile_report.format_report(rep)
+
+
+def run_dir_traces(run_dir: str) -> List[str]:
+    """Span-trace exports a trainer run dir is known to hold."""
+    out: List[str] = []
+    for pat in ("trace.json", "trace_p*.json", "trace_step*.json"):
+        out.extend(sorted(glob.glob(os.path.join(run_dir, pat))))
+    return out
+
+
+def report(paths: List[str], top: int = 5,
+           run_dir: Optional[str] = None) -> List[str]:
     spans, instants, stats = collect(paths)
     lines = []
     for st in stats:
@@ -258,18 +306,31 @@ def report(paths: List[str], top: int = 5) -> List[str]:
                      f"events={st['events']} dropped={st['dropped']}")
     lines.extend(request_report(spans, top))
     lines.extend(trainer_report(spans, instants))
+    if run_dir:
+        lines.extend(graftprof_report(run_dir))
     return lines
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("traces", nargs="+",
+    p.add_argument("traces", nargs="*",
                    help="chrome trace JSON files (/trace dumps, trainer "
                         "trace_step*.json)")
+    p.add_argument("--run-dir", default=None,
+                   help="trainer run dir: its trace.json/trace_step*.json "
+                        "exports join the inputs, and a jax.profiler dump "
+                        "under <run-dir>/profile gets the graftprof "
+                        "op-level attribution appended")
     p.add_argument("--top", type=int, default=5,
                    help="how many slowest requests to print as span trees")
     a = p.parse_args(argv)
-    for line in report(a.traces, top=a.top):
+    traces = list(a.traces)
+    if a.run_dir:
+        traces.extend(t for t in run_dir_traces(a.run_dir)
+                      if t not in traces)
+    if not traces and not a.run_dir:
+        p.error("give trace files and/or --run-dir")
+    for line in report(traces, top=a.top, run_dir=a.run_dir):
         print(line)
     return 0
 
