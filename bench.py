@@ -41,9 +41,15 @@ decode/prefill additionally use a two-point (T(n_hi)-T(n_lo)) difference
 to cancel the fixed overhead.
 
 Env knobs: BENCH_CASES (comma list: 2m,40m,100m,400m,650m,1b,simple,
-decode,serve,pp,moe,longctx,trainer,elastic; default all; plus CI-only
-"tiny"),
-BENCH_STEPS, BENCH_VOCAB, BENCH_BUDGET_S. The "serve" family compares
+decode,serve,pp,moe,longctx,trainer,elastic,overlap; default all; plus
+CI-only "tiny"),
+BENCH_STEPS, BENCH_VOCAB, BENCH_BUDGET_S. BENCH_XLA_FLAGS names the
+parallel/xla_flags.py flag set every child applies before backend init
+(default latency_hiding; every row carries xla_flag_set/xla_backend/
+xla_flags_applied attribution). BENCH_REMAT accepts the named
+model.remat_policy values (none/dots/full/save_attn); BENCH_SCAN_LAYERS
+forces scan-over-layers; scripts/bench_sweep.py --mfu sweeps the
+remat x scan x flag-set grid. The "serve" family compares
 the continuous-batching engine (serve/) against the locked server path
 at occupancy 1/4/8 — a scheduling comparison that is meaningful on CPU.
 
@@ -136,6 +142,13 @@ SCALES = {
 # double again — higher arithmetic intensity per HBM byte. Derived from
 # the 100m shape so the comparison stays same-model by construction.
 SCALES["100m_bs64"] = dict(SCALES["100m"], batch=64, remat="dots")
+# Scan-over-layers at a scale that actually completes: the scan column's
+# only default carriers used to be 400m+/1b, the exact rows whose compiles
+# died through the tunnel (TUNNEL_NOTE_r4) — so three rounds of matrices
+# never exercised scan. Same model/batch as the 100m_flash headline, so
+# the pair isolates the scan cost (loss parity is tested:
+# tests/test_model.py scan-vs-unrolled).
+SCALES["100m_scan"] = dict(SCALES["100m"], scan=True)
 # Simple (full-score) attention at 40m needs a smaller batch: [B,H,S,S]
 # fp32 scores at bs32 are ~4.3 GB in the forward alone.
 SCALES["40m_bs16"] = dict(SCALES["40m"], batch=16)
@@ -1449,6 +1462,179 @@ def bench_train_pp_case(vocab, steps, name="train_pp"):
     }
 
 
+_OVERLAP_WORKER = """
+import json, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+from mlx_cuda_distributed_pretraining_tpu.config import TrainingConfig
+from mlx_cuda_distributed_pretraining_tpu.models import llama
+from mlx_cuda_distributed_pretraining_tpu.optim import build_optimizer
+from mlx_cuda_distributed_pretraining_tpu.parallel.context import use_mesh
+from mlx_cuda_distributed_pretraining_tpu.train.train_step import (
+    init_train_state, make_train_step)
+
+assert jax.device_count() == 2, jax.devices()
+
+vocab = {vocab}
+args = llama.LlamaArgs(vocab_size=vocab, max_position_embeddings=256,
+                       **{shape!r})
+# host snapshot: each measured configuration re-materializes the same
+# initial params so off/on see identical state
+_host = jax.device_get(llama.init_params(jax.random.PRNGKey(0), args))
+def fresh_params():
+    return jax.tree_util.tree_map(jnp.asarray, _host)
+
+BATCH, SEQ, STEPS = 8, 256, {steps}
+rng = np.random.default_rng(0)
+flood = []
+for _ in range(STEPS):
+    x = rng.integers(1, vocab - 4, size=(BATCH, SEQ + 1)).astype(np.int32)
+    flood.append({{"inputs": jnp.asarray(x[:, :-1]),
+                   "targets": jnp.asarray(x[:, 1:]),
+                   "mask": jnp.ones((BATCH, SEQ), jnp.float32)}})
+
+def make_opt():
+    tr = TrainingConfig(
+        hyperparameters={{"learning_rate": 1e-3, "gradient_clip": 1.0}},
+        scheduler={{"type": "cosine"}}, optimization={{"optimizer": "adamw"}})
+    return build_optimizer(tr, 1000)
+
+mesh = Mesh(mesh_utils.create_device_mesh((1, 2), devices=jax.devices()),
+            ("dp", "fsdp"))
+
+def prof_cols(run_one, state):
+    import shutil, tempfile
+    from mlx_cuda_distributed_pretraining_tpu.obs.profile_report import (
+        generate_report, prof_fields)
+    tmp = tempfile.mkdtemp(prefix="bench-ovprof-")
+    try:
+        jax.profiler.start_trace(tmp)
+        try:
+            for i in range(3):
+                with jax.profiler.StepTraceAnnotation("train", step_num=i):
+                    state = run_one(state)
+            jax.block_until_ready(jax.tree_util.tree_leaves(state)[:1])
+        finally:
+            jax.profiler.stop_trace()
+        rep = generate_report(tmp)
+        return prof_fields(rep) if rep else {{}}
+    except Exception:
+        return {{}}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+def run(overlap):
+    opt = make_opt()
+    def loss(p, b):
+        return llama.loss_fn(p, b, args, overlap=overlap)
+    with use_mesh(mesh):
+        step, shardings = make_train_step(loss, opt, mesh=mesh,
+                                          params_like=fresh_params())
+        st = jax.device_put(init_train_state(fresh_params(), opt), shardings)
+        losses, ts = [], []
+        for b in flood:
+            t0 = time.perf_counter()
+            st, m = step(st, b)
+            l = float(m["loss"])  # host fetch syncs the step
+            losses.append(l); ts.append(time.perf_counter() - t0)
+        cols = prof_cols(lambda s: step(s, flood[-1])[0], st)
+    return losses, ts, cols
+
+losses_base, t_base, prof_base = run(False)
+losses_ov, t_ov, prof_ov = run(True)
+print("OVERLAP " + json.dumps({{
+    "losses_base": losses_base, "losses_ov": losses_ov,
+    "t_base": t_base, "t_ov": t_ov,
+    "prof_base": prof_base, "prof_ov": prof_ov,
+    "batch": BATCH, "seq": SEQ, "steps": STEPS,
+    "n_params": llama.num_params(_host)}}), flush=True)
+"""
+
+
+def bench_overlap_case(vocab, steps, name="train_overlap_fsdp2"):
+    """Manual gather/compute overlap (parallel/overlap.py) off-vs-on on a
+    dp=1 x fsdp=2 mesh over two forced host (CPU) devices.
+
+    CPU-meaningful like the serve/pp families: XLA:CPU has no
+    latency-hiding scheduler and every GSPMD collective is a synchronous
+    thread rendezvous, so the schedule change shows up as fewer/larger
+    collectives — the judged CPU directions are exposed-comm fraction
+    and idle fraction DOWN (d_comm_ms/d_idle_ms carry the absolute
+    per-step milliseconds, which stay unambiguous when the step time
+    itself shrinks), with per-step loss parity against the GSPMD
+    baseline (the overlap schedule is a scheduling change, not a
+    numerics change — bitwise at fp32). prof_overlap_frac is reported
+    but only judged on accelerators: on CPU "overlap" is cross-thread
+    coincidence, and the manual schedule cutting TOTAL collective time
+    2x makes the remaining ratio pure noise."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    n_steps = max(4, min(int(steps), 8))
+    src = _OVERLAP_WORKER.format(repo=repo, vocab=vocab, steps=n_steps,
+                                 shape=SCALES["2m"]["shape"])
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    proc = subprocess.run([sys.executable, "-c", src], env=env,
+                          capture_output=True, text=True, timeout=1200)
+    line = next((ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("OVERLAP ")), None)
+    if proc.returncode != 0 or line is None:
+        raise RuntimeError(
+            f"overlap worker rc={proc.returncode}: {proc.stderr[-1500:]}")
+    res = json.loads(line[len("OVERLAP "):])
+
+    def steady(ts):
+        tail = ts[1:] or ts
+        return sum(tail) / len(tail)
+
+    def rel_diff(a, b):
+        return max(abs(x - y) / max(abs(y), 1e-9) for x, y in zip(a, b))
+
+    d_loss = rel_diff(res["losses_ov"], res["losses_base"])
+    toks = res["batch"] * res["seq"]
+    st_ov, st_base = steady(res["t_ov"]), steady(res["t_base"])
+    sh = SCALES["2m"]["shape"]
+    ft = flops_per_token(res["n_params"], sh["num_layers"], res["seq"],
+                         sh["num_heads"] * sh["head_dim"])
+    prof_ov, prof_base = res["prof_ov"], res["prof_base"]
+    row = {
+        "case": name, "vocab": vocab, "devices": 2, "mesh": "dp=1,fsdp=2",
+        "batch": res["batch"], "seq": res["seq"], "steps": res["steps"],
+        "tok_s": round(toks / st_ov, 0),
+        "tok_s_base": round(toks / st_base, 0),
+        "step_ms": round(1000 * st_ov, 1),
+        "step_ms_base": round(1000 * st_base, 1),
+        "mfu": mfu_or_unknown(ft, toks / st_ov),
+        "loss_rel_diff": round(d_loss, 9),
+        "loss_parity": d_loss < 1e-6,
+        # graftprof attribution for the overlap schedule, with the GSPMD
+        # baseline's columns alongside and the judged deltas explicit
+        **prof_ov,
+        **{k + "_base": v for k, v in prof_base.items()},
+    }
+    for k in ("prof_comm_frac", "prof_idle_frac", "prof_overlap_frac"):
+        if k in prof_ov and k in prof_base:
+            row["d_" + k[5:]] = round(prof_ov[k] - prof_base[k], 4)
+    # Fraction deltas divide by DIFFERENT step times once overlap wins;
+    # absolute per-step milliseconds are the unambiguous direction
+    # (idle_ms can fall while idle_frac rises, because the denominator
+    # shrank more).
+    for k in ("prof_comm_frac", "prof_idle_frac"):
+        if k in prof_ov and k in prof_base:
+            row["d_" + k[5:-5] + "_ms"] = round(
+                prof_ov[k] * row["step_ms"]
+                - prof_base[k] * row["step_ms_base"], 1)
+    return row
+
+
 def bench_moe_case(vocab, steps, name="moe_8x40m"):
     """Grouped (dropless, sort-based — ops/grouped_matmul.py) vs einsum
     (GShard dispatch tensors) MoE training throughput on the SAME model:
@@ -1960,6 +2146,18 @@ def build_plan(vocab, steps):
         ("100m_mega", "100m",
          lambda: bench_train_case("100m_mega", "100m", "flash", vocab,
                                   max(steps, 10), megastep=10), 170),
+        # Scan-vs-unrolled at the headline scale (see SCALES["100m_scan"]):
+        # re-enabled carrier of the scan column after the 400m+ compile
+        # deaths kept it out of every captured matrix.
+        ("100m_scan", "100m",
+         lambda: bench_train_case("100m_scan", "100m_scan", "flash", vocab,
+                                  steps), 150),
+        # Manual fsdp gather/compute overlap (parallel/overlap.py) off-vs-on
+        # on 2 forced host devices — CPU-meaningful like serve/pp: bucketed
+        # per-layer collectives vs GSPMD's per-matmul gathers is a
+        # scheduling comparison, judged on prof_* deltas + loss parity.
+        ("train_overlap_fsdp2", "overlap",
+         lambda: bench_overlap_case(vocab, steps), 600),
         ("400m_mega", "400m",
          lambda: bench_train_case("400m_mega", "400m", "flash", vocab,
                                   max(steps, 10), megastep=10), 260),
@@ -2066,16 +2264,31 @@ def ensure_device(max_wait_s=None) -> bool:
     return False
 
 
+def _bench_flag_stamp() -> dict:
+    """Apply the BENCH_XLA_FLAGS flag set (parallel/xla_flags.py; default
+    latency_hiding) and return the attribution fields every row carries —
+    a bench number without its flag set is not comparable to anything."""
+    from mlx_cuda_distributed_pretraining_tpu.parallel import xla_flags as xf
+
+    stamp = xf.apply_flag_set(
+        os.environ.get("BENCH_XLA_FLAGS", xf.DEFAULT_FLAG_SET))
+    return {k: stamp[k]
+            for k in ("xla_flag_set", "xla_backend", "xla_flags_applied")}
+
+
 def run_child(case_id) -> None:
     """--one CASE_ID mode: run a single case in this process and print its
     result as a marked stdout line for the parent to collect."""
     vocab = int(os.environ.get("BENCH_VOCAB", "32768"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
+    # Before any device use: flags are read once at backend init.
+    flag_stamp = _bench_flag_stamp()
     plan = {cid: thunk for cid, _, thunk, _ in build_plan(vocab, steps)}
     import jax
 
     t0 = time.perf_counter()
     r = plan[case_id]()
+    r.update(flag_stamp)
     r["bench_wall_s"] = round(time.perf_counter() - t0, 1)
     r["device"] = str(jax.devices()[0])
     # Emit-time stamp: harvester_case_rows() judges freshness per row, so
@@ -2117,6 +2330,9 @@ def run_case(case_id, reserve, inproc_thunk=None):
         try:
             if inproc_thunk is not None:
                 r = inproc_thunk()
+                # In-process the backend is usually already initialized;
+                # the stamp then honestly reports applied=False.
+                r.update(_bench_flag_stamp())
                 r["bench_wall_s"] = round(time.perf_counter() - t0, 1)
             else:
                 _ACTIVE_CHILD = subprocess.Popen(
@@ -2301,7 +2517,7 @@ def main() -> None:
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     cases_env = os.environ.get(
         "BENCH_CASES",
-        "2m,40m,100m,400m,650m,1b,simple,decode,serve,longctx,trainer")
+        "2m,40m,100m,400m,650m,1b,simple,decode,serve,longctx,trainer,overlap")
     wanted = set(cases_env.split(","))
     inproc = os.environ.get("BENCH_INPROC") == "1"
 

@@ -216,11 +216,19 @@ def build_programs(config_path: str,
     compute_dtype = ("bfloat16" if cfg.system.compute_dtype == "bfloat16"
                      else "float32")
     jnp_compute = jnp.bfloat16 if compute_dtype == "bfloat16" else jnp.float32
-    remat = cfg.system.remat
+    # Same remat precedence as the Trainer: model.remat_policy wins over
+    # system.remat; legacy gradient_checkpointing means "full"; the
+    # explicit "none" opts out of all of them.
+    remat = getattr(cfg.model, "remat_policy", None)
+    if remat is None:
+        remat = cfg.system.remat
     if remat is None and cfg.system.gradient_checkpointing:
         remat = "full"
+    if remat == "none":
+        remat = None
     ce_chunk = int(getattr(cfg.system, "fused_ce_chunk", -1))
     scan_layers = bool(getattr(cfg.system, "scan_layers", False))
+    overlap = bool(getattr(cfg.system, "overlap_gather", False))
     z_loss = float(cfg.training.hyperparameters.get("z_loss") or 0.0)
     moe_experts = (
         args.num_local_experts
@@ -228,6 +236,9 @@ def build_programs(config_path: str,
             and "with_moe_stats"
             in inspect.signature(arch.loss_fn).parameters) else 0)
     _stats_kw = {"with_moe_stats": True} if moe_experts else {}
+    if (overlap and hasattr(arch, "loss_fn")
+            and "overlap" in inspect.signature(arch.loss_fn).parameters):
+        _stats_kw = {**_stats_kw, "overlap": True}
 
     def loss_fn(params, batch):
         return arch.loss_fn(
@@ -268,10 +279,18 @@ def build_programs(config_path: str,
             moe_stats_experts=moe_experts)
         state_abs = jax.eval_shape(
             lambda p: init_train_state(p, optimizer), params_abs)
-        programs.append(_trace_program(
+        prog = _trace_program(
             "train_step", config_name, step_fn, (state_abs, batch_abs),
             arg_names=("state", "batch"), compute_dtype=compute_dtype,
-            param_arg_index=0, expected_param_specs=expected_specs))
+            param_arg_index=0, expected_param_specs=expected_specs)
+        # sync-collectives rule inputs: what the config asked for, and
+        # the backend this lowering targets (the audit host's — a CPU
+        # host resolves every set to (), keeping CPU audits green).
+        from ..parallel import xla_flags as _xf
+        prog.requested_flag_set = str(
+            getattr(cfg.system, "xla_flag_set", "") or "") or None
+        prog.flag_backend = _xf.guess_backend()
+        programs.append(prog)
 
     if "serve_decode" in wanted:
         if args.is_moe:

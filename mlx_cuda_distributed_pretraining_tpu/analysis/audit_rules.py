@@ -31,6 +31,14 @@ Rules:
   fully replicated while parallel/sharding_rules.py::param_pspec names a
   sharded axis for it: the sharding annotation was lost on the way to
   the compiler.
+- ``sync-collectives``   — the config requested a latency-hiding XLA
+  flag set (``system.xla.flag_set``) yet the train program's
+  overlap-relevant collectives (all-gather / reduce-scatter /
+  all-reduce) lowered in their synchronous form: the flag set was
+  dropped (set after backend init, or not in ``XLA_FLAGS`` at all) and
+  every collective sits exposed on the critical path. Only meaningful
+  on backends whose flag set is non-empty — XLA:CPU resolves to ()
+  (parallel/xla_flags.py), so CPU-hosted audits never fire it.
 """
 
 from __future__ import annotations
@@ -79,6 +87,11 @@ class AuditProgram:
     expected_param_specs: Dict[str, str] = field(default_factory=dict)
     # Committed collective budget for this (config, program), or None.
     budget: Optional[Dict[str, Dict[str, int]]] = None
+    # What system.xla.flag_set asked for, and the backend the lowering
+    # targeted — the sync-collectives rule compares the two against the
+    # HLO that actually came out.
+    requested_flag_set: Optional[str] = None
+    flag_backend: str = "cpu"
     _compiled: Any = None
     _census: Optional[Dict[str, Dict[str, int]]] = None
 
@@ -187,6 +200,27 @@ def parse_hlo_census(hlo_text: str) -> Dict[str, Dict[str, int]]:
         entry = census.setdefault(m.group("op"), {"count": 0, "bytes": 0})
         entry["count"] += 1
         entry["bytes"] += _shape_bytes(m.group("shape"))
+    return census
+
+
+# Collectives the latency-hiding flag sets exist to overlap. Async HLO
+# spells them `<op>-start`/`<op>-done`; the plain form is synchronous and
+# sits exposed on the critical path. `<op>(` with no suffix matches only
+# the sync spelling (`-start(`/`-done(` put a suffix between op and paren).
+_OVERLAP_OPS = ("all-gather", "all-reduce", "reduce-scatter")
+_SYNC_COLL_RE = re.compile(
+    r"=\s*(?:\([^)]*\)|[a-z0-9_\[\]{},]+)\s+"
+    r"(?P<op>" + "|".join(_OVERLAP_OPS) + r")\(")
+
+
+def sync_collective_census(hlo_text: str) -> Dict[str, int]:
+    """Per-op count of SYNCHRONOUS overlap-relevant collectives in
+    post-optimization HLO text (async -start/-done pairs do not count)."""
+    census: Dict[str, int] = {}
+    for line in hlo_text.splitlines():
+        m = _SYNC_COLL_RE.search(line)
+        if m:
+            census[m.group("op")] = census.get(m.group("op"), 0) + 1
     return census
 
 
@@ -375,6 +409,47 @@ class ReplicatedParam:
                     f"the in_shardings wiring dropped it")
 
 
+class SyncCollectives:
+    id = "sync-collectives"
+    description = ("overlap-relevant collectives lowered synchronous although "
+                   "the config requested a latency-hiding XLA flag set")
+
+    def check(self, prog: AuditProgram) -> Iterable[Finding]:
+        if prog.name != "train_step" or not prog.requested_flag_set:
+            return
+        from ..parallel import xla_flags
+
+        try:
+            flags = xla_flags.flags_for(prog.requested_flag_set,
+                                        prog.flag_backend)
+        except ValueError:
+            return  # config validation owns unknown set names
+        if not flags:
+            # The backend has nothing to set (XLA:CPU): sync collectives
+            # are the only spelling it has, not a dropped flag set.
+            return
+        sync = sync_collective_census(prog.compiled().as_text())
+        if not sync:
+            return
+        missing = xla_flags.missing_flags(prog.requested_flag_set,
+                                          prog.flag_backend)
+        ops = ", ".join(f"{op} x{n}" for op, n in sorted(sync.items()))
+        msg = (f"program `{prog.name}`: {sum(sync.values())} synchronous "
+               f"overlap-relevant collective(s) ({ops}) although the config "
+               f"requested xla flag set `{prog.requested_flag_set}` for "
+               f"backend `{prog.flag_backend}`")
+        if missing:
+            msg += (" — flags missing from XLA_FLAGS: "
+                    + " ".join(missing)
+                    + " (apply_flag_set must run before backend init; "
+                      "see parallel/xla_flags.py)")
+        else:
+            msg += (" — the flags are in XLA_FLAGS but the compiler still "
+                    "emitted sync forms; check scheduler eligibility "
+                    "(fusion thresholds, program size)")
+        yield Finding(self.id, prog.synthetic_path, 0, 0, msg)
+
+
 def _keypath_str(kp) -> str:
     parts = []
     for p in kp:
@@ -388,7 +463,8 @@ def _keypath_str(kp) -> str:
 
 
 _AUDIT_RULES = [DonationGap(), CollectiveCensus(), DtypeUpcast(),
-                LargeConstantCapture(), ReplicatedParam()]
+                LargeConstantCapture(), ReplicatedParam(),
+                SyncCollectives()]
 
 
 def all_audit_rules() -> Dict[str, Any]:

@@ -62,6 +62,22 @@ class ModelConfig:
     rope: Dict[str, Any] = field(default_factory=dict)
     misc: Dict[str, Any] = field(default_factory=dict)
     moe: Dict[str, Any] = field(default_factory=dict)
+    # Named rematerialization policy: "none" | "dots" | "full" |
+    # "save_attn" (models/llama.py REMAT_POLICIES — save_attn keeps the
+    # checkpoint_name-tagged attention activations and replays only the
+    # cheap FFN elementwise work). Takes precedence over the legacy
+    # system.remat / system.gradient_checkpointing knobs when set.
+    remat_policy: Optional[str] = None
+
+    def __post_init__(self):
+        if self.remat_policy is not None:
+            norm = str(self.remat_policy).lower()
+            valid = ("none", "dots", "full", "save_attn")
+            if norm not in valid:
+                raise ValueError(
+                    f"unknown model.remat_policy: {self.remat_policy!r} "
+                    f"(expected one of {valid})")
+            object.__setattr__(self, "remat_policy", norm)
 
     @property
     def hidden_size(self) -> int:
@@ -322,6 +338,25 @@ class SystemConfig:
     # compiled executables instead of paying a full recompile; the trainer
     # logs a warm/cold line at startup. None disables.
     compilation_cache_dir: Optional[str] = None
+    # XLA scheduling flags (parallel/xla_flags.py)::
+    #
+    #   xla:
+    #     flag_set: latency_hiding   # or "none"
+    #     extra_flags: ["--xla_..."]  # appended verbatim
+    #
+    # The named set resolves per backend (CPU resolves empty — XLA:CPU
+    # has no latency-hiding scheduler), is applied before the backend
+    # initializes, and is stamped into events.jsonl / bench rows.
+    xla: Dict[str, Any] = field(default_factory=dict)
+    # Manual comm/compute overlap (parallel/overlap.py): under a pure
+    # dp×fsdp mesh with scan_layers, all-gather the NEXT layer's
+    # fsdp-sharded params (one bucketed gather per layer) while the
+    # current layer's matmuls run, double-buffered through the layer
+    # scan; the gather's transpose drains the gradient reduce-scatter
+    # per layer behind the backward pass instead of as one monolithic
+    # sync at the end. Falls back to the GSPMD path when the mesh or
+    # model shape doesn't qualify (tp/sp/ep/pp > 1, MoE, int8 leaves).
+    overlap_gather: bool = False
 
     def __post_init__(self):
         if self.compute_dtype is None:
@@ -336,6 +371,16 @@ class SystemConfig:
                 raise ValueError(
                     f"unknown system.compute_dtype: {self.compute_dtype!r} "
                     "(expected bfloat16/float16/float32)")
+
+    @property
+    def xla_flag_set(self) -> str:
+        v = self.xla.get("flag_set") if isinstance(self.xla, dict) else None
+        return str(v).lower() if v else "none"
+
+    @property
+    def xla_extra_flags(self) -> List[str]:
+        v = self.xla.get("extra_flags") if isinstance(self.xla, dict) else None
+        return [str(f) for f in v] if v else []
 
     def _distributed_map(self) -> Dict[str, Any]:
         return self.distributed if isinstance(self.distributed, dict) else {}

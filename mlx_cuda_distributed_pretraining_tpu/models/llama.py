@@ -26,12 +26,87 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from ..ops import fused_ce
 from ..ops import masks as masks_lib
 from ..ops.attention import reference_attention
 
 Params = Dict[str, Any]
+
+# -- named remat policies ----------------------------------------------------
+# Activation sites are tagged with jax.ad_checkpoint.checkpoint_name so a
+# policy trades exactly the FLOPs we choose instead of blanket replay:
+#   "qkv"      — q/k/v projections (pre-RoPE)
+#   "attn_out" — the attention output (flash/flex/ring/reference), pre-wo
+#   "ffn_up"   — silu(gate) * up, the SwiGLU elementwise product
+#   "ffn_down" — the MLP down-projection output
+# REMAT_POLICIES maps model.remat_policy names to what the backward pass
+# may keep; anything unnamed is recomputed.
+SAVE_ATTN_NAMES = ("qkv", "attn_out")
+REMAT_POLICIES = ("none", "dots", "full", "save_attn")
+
+
+def normalize_remat(remat: Optional[str]) -> Optional[str]:
+    """"none"/"" → None; unknown names raise (a typo'd policy must not
+    silently train without remat)."""
+    if remat is None or remat == "":
+        return None
+    name = str(remat).lower()
+    if name == "none":
+        return None
+    if name not in REMAT_POLICIES:
+        raise ValueError(
+            f"unknown remat policy {remat!r} (expected one of "
+            f"{REMAT_POLICIES})")
+    return name
+
+
+def remat_wrap(remat: Optional[str]):
+    """Per-layer ``jax.checkpoint`` wrapper for a named policy, or None.
+
+    - "full": replay everything (minimum memory, maximum recompute);
+    - "dots": keep matmul outputs (checkpoint_dots_with_no_batch_dims);
+    - "save_attn": keep only the tagged attention activations (qkv +
+      attention output) — the backward never replays the O(S²) attention
+      kernel, only the cheap FFN/elementwise work.
+    """
+    remat = normalize_remat(remat)
+    if remat is None:
+        return None
+    if remat == "full":
+        return partial(jax.checkpoint, static_argnums=(2, 5, 6))
+    if remat == "dots":
+        return partial(
+            jax.checkpoint,
+            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+            static_argnums=(2, 5, 6))
+    return partial(
+        jax.checkpoint,
+        policy=jax.checkpoint_policies.save_only_these_names(
+            *SAVE_ATTN_NAMES),
+        static_argnums=(2, 5, 6))
+
+
+def remat_checkpoint_for_overlap(remat: Optional[str]):
+    """``jax.checkpoint`` wrapper for the overlap path's per-layer
+    ``(param_shards, x, *consts)`` function — same named policies as
+    :func:`remat_wrap` but no static_argnums (the static config is closed
+    over), so the checkpoint encloses the param gather and the backward
+    re-gathers shards instead of keeping full per-layer params alive."""
+    remat = normalize_remat(remat)
+    if remat is None:
+        return None
+    if remat == "full":
+        return jax.checkpoint
+    if remat == "dots":
+        return partial(
+            jax.checkpoint,
+            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims)
+    return partial(
+        jax.checkpoint,
+        policy=jax.checkpoint_policies.save_only_these_names(
+            *SAVE_ATTN_NAMES))
 
 
 @dataclass(frozen=True)
@@ -331,9 +406,9 @@ def attention_block(
     B, S, _ = x.shape
     Hq, Hkv, Dh = args.num_heads, args.num_kv_heads, args.head_dim
 
-    q = _linear(x, p["wq"]).reshape(B, S, Hq, Dh)
-    k = _linear(x, p["wk"]).reshape(B, S, Hkv, Dh)
-    v = _linear(x, p["wv"]).reshape(B, S, Hkv, Dh)
+    q = checkpoint_name(_linear(x, p["wq"]), "qkv").reshape(B, S, Hq, Dh)
+    k = checkpoint_name(_linear(x, p["wk"]), "qkv").reshape(B, S, Hkv, Dh)
+    v = checkpoint_name(_linear(x, p["wv"]), "qkv").reshape(B, S, Hkv, Dh)
 
     cos, sin = rope_cos_sin(positions, Dh, args.rope_theta, args.rope_scaling_factor)
     q = apply_rope(q, cos, sin, args.rope_traditional)
@@ -393,7 +468,7 @@ def attention_block(
         else:
             out = reference_attention(q, k, v, mask_mod=mask_mod, score_mod=build_score_mod(args))
 
-    out = out.reshape(B, S, Hq * Dh)
+    out = checkpoint_name(out.reshape(B, S, Hq * Dh), "attn_out")
     return _linear(out, p["wo"]), new_cache
 
 
@@ -417,7 +492,9 @@ def _cached_attention(q, k, v, positions, pos, S):
 
 def mlp_block(p: Params, x: jnp.ndarray) -> jnp.ndarray:
     """Canonical SwiGLU: ``down(silu(gate(x)) * up(x))``."""
-    return _linear(jax.nn.silu(_linear(x, p["w_gate"])) * _linear(x, p["w_up"]), p["w_down"])
+    up = checkpoint_name(
+        jax.nn.silu(_linear(x, p["w_gate"])) * _linear(x, p["w_up"]), "ffn_up")
+    return checkpoint_name(_linear(up, p["w_down"]), "ffn_down")
 
 
 def transformer_block(
@@ -474,11 +551,13 @@ def forward(
     attend_len: Optional[int] = None,
     return_hidden: bool = False,
     scan_layers: bool = False,
+    overlap: bool = False,
 ) -> Tuple[jnp.ndarray, Optional[list]]:
     """tokens [B, S] int32 → (logits [B, S, V] fp32, new_cache | None).
 
-    ``remat``: None | "full" | "dots" — per-layer ``jax.checkpoint`` with the
-    corresponding policy; ``remat_ratio`` checkpoints only the first fraction
+    ``remat``: None | "none" | "full" | "dots" | "save_attn" — per-layer
+    ``jax.checkpoint`` with the named policy (see :data:`REMAT_POLICIES`);
+    ``remat_ratio`` checkpoints only the first fraction
     of layers (reference: system.gradient_checkpointing_ratio).
     ``return_aux=True`` appends the summed MoE aux loss:
     ``(logits, cache, aux)``. ``attend_len`` (static) bounds cached decode
@@ -495,20 +574,20 @@ def forward(
     already-casted params, negligible next to a training step. Training
     path only (ignored under KV cache). ``remat_ratio < 1`` runs as TWO
     scans — the checkpointed prefix and the plain suffix.
+    ``overlap=True`` routes the layer stack through the manual
+    shard_map overlap schedule (parallel/overlap.py: per-layer bucketed
+    fsdp param all-gather prefetched one layer ahead, gradient
+    reduce-scatter draining per layer behind the backward) when the
+    current mesh qualifies (pure dp×fsdp, dense, no int8); otherwise
+    this flag is a no-op and GSPMD schedules the collectives.
     """
     B, S = tokens.shape
     x = params["tok_embeddings"]["weight"].astype(compute_dtype)[tokens]
     positions = jnp.arange(S, dtype=jnp.int32) + start_pos
 
-    block = transformer_block
-    if remat == "full":
-        block = jax.checkpoint(transformer_block, static_argnums=(2, 5, 6))
-    elif remat == "dots":
-        block = jax.checkpoint(
-            transformer_block,
-            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
-            static_argnums=(2, 5, 6),
-        )
+    remat = normalize_remat(remat)
+    wrap = remat_wrap(remat)
+    block = wrap(transformer_block) if wrap is not None else transformer_block
 
     # int8 (quantized) leaves must stay int8 through the compute-dtype cast
     cast = partial(jax.tree_util.tree_map,
@@ -522,7 +601,38 @@ def forward(
     else:
         collect_stats = False
     stats_total = moe_lib.zero_stats(args.num_local_experts) if collect_stats else None
-    if scan_layers and cache is None:
+    use_overlap = False
+    if overlap and cache is None and not args.is_moe and not collect_stats:
+        from ..parallel import overlap as overlap_lib
+        from ..parallel.context import current_mesh
+
+        overlap_mesh = current_mesh()
+        layers_cast = [cast(l) for l in params["layers"]]
+        use_overlap = overlap_lib.can_overlap(overlap_mesh, layers_cast, B)
+    if use_overlap:
+        # Manual overlap schedule (parallel/overlap.py): one bucketed
+        # all-gather per layer over the fsdp axis, prefetched one layer
+        # ahead on the non-checkpointed segment; the gather's transpose
+        # drains the gradient reduce-scatter per layer in the backward.
+        def overlap_body(layer, h, pos):
+            h, _, aux = transformer_block(
+                layer, h, args, pos, None, None, attend_len)
+            return h, aux
+
+        policy_wrap = None
+        if wrap is not None:
+            # Re-wrap WITHOUT static_argnums: overlap closes over the
+            # static config and checkpoints (gather ∘ block) together so
+            # the backward re-gathers shards instead of saving full
+            # per-layer params as residuals.
+            policy_wrap = remat_checkpoint_for_overlap(remat)
+        x, aux = overlap_lib.overlapped_layer_scan(
+            overlap_body, x, layers_cast, overlap_mesh,
+            consts=(positions,), wrap=policy_wrap,
+            n_wrapped=(n_remat if remat else 0),
+        )
+        aux_total = aux_total + aux
+    elif scan_layers and cache is None:
         # Segmented scan: the checkpointed prefix (remat_ratio) and the
         # plain suffix each scan over their own stacked params — at most
         # two compiled layer bodies, any ratio.
@@ -679,6 +789,7 @@ def loss_fn(
     scan_layers: bool = False,
     z_loss_weight: float = 0.0,
     with_moe_stats: bool = False,
+    overlap: bool = False,
 ) -> Tuple[jnp.ndarray, Any]:
     """Masked mean cross-entropy in fp32 (reference: core/training.py
     compute_loss :1195-1260). Returns (loss, token_count). MoE models add
@@ -706,7 +817,7 @@ def loss_fn(
                 params, batch, args, compute_dtype=compute_dtype,
                 remat=remat, remat_ratio=remat_ratio, include_aux=include_aux,
                 ce_chunk=ce_chunk, scan_layers=scan_layers,
-                z_loss_weight=z_loss_weight,
+                z_loss_weight=z_loss_weight, overlap=overlap,
             )
         return loss, (count, moe_lib.merge_stats(tap, args.num_local_experts))
     targets = batch["targets"]
@@ -721,7 +832,7 @@ def loss_fn(
         hidden, _, aux = forward(
             params, batch["inputs"], args, compute_dtype=compute_dtype,
             remat=remat, remat_ratio=remat_ratio, return_aux=True,
-            return_hidden=True, scan_layers=scan_layers,
+            return_hidden=True, scan_layers=scan_layers, overlap=overlap,
         )
         if untied:
             w_vd = params["output"]["weight"].astype(compute_dtype).T
@@ -755,7 +866,7 @@ def loss_fn(
         logits, _, aux = forward(
             params, batch["inputs"], args, compute_dtype=compute_dtype,
             remat=remat, remat_ratio=remat_ratio, return_aux=True,
-            scan_layers=scan_layers,
+            scan_layers=scan_layers, overlap=overlap,
         )
         logz = jax.nn.logsumexp(logits, axis=-1)
         gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]

@@ -21,10 +21,17 @@ from typing import Tuple
 import jax
 
 
+def donation_enabled() -> bool:
+    """Whether jit donation is in effect: real aliasing (accelerator) or
+    audit lowering (GRAFTAUDIT_FORCE_DONATE=1). The fused optimizer path
+    (optim/fused.py) is donation-shaped either way; this gate only
+    controls whether the jits *declare* it, to keep XLA:CPU from warning
+    on every hot-path compile."""
+    if os.environ.get("GRAFTAUDIT_FORCE_DONATE") == "1":
+        return True
+    return jax.default_backend() != "cpu"
+
+
 def donate_argnums(*argnums: int) -> Tuple[int, ...]:
     """``argnums`` when donation is real (non-CPU backend), else ``()``."""
-    if os.environ.get("GRAFTAUDIT_FORCE_DONATE") == "1":
-        return argnums
-    if jax.default_backend() == "cpu":
-        return ()
-    return argnums
+    return argnums if donation_enabled() else ()

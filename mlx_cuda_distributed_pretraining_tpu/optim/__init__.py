@@ -11,6 +11,7 @@ from .base import (
 from .adafactor import adafactor
 from .enhanced import adam, adamw, lion, sgd
 from .factory import build_optimizer
+from .fused import FusedTransform, fused_adamw, fused_apply_of
 from .muon import muon, newton_schulz5
 from .schedules import (
     build_schedule,
@@ -28,4 +29,5 @@ __all__ = [
     "build_optimizer", "muon", "newton_schulz5", "build_schedule",
     "cosine_decay", "join_schedules", "linear_schedule", "schedule_value",
     "warmup_cosine", "inverse_pth_root", "shampoo", "adafactor",
+    "FusedTransform", "fused_adamw", "fused_apply_of",
 ]

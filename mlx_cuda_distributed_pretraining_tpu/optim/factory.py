@@ -12,6 +12,7 @@ from typing import Any, Optional
 from .adafactor import adafactor
 from .base import Schedule, Transform, partition
 from .enhanced import adam, adamw, lion, sgd
+from .fused import fused_adamw
 from .muon import embedding_rest_label_fn, matrix_label_fn, muon
 from .schedules import build_schedule
 from .shampoo import shampoo
@@ -40,12 +41,27 @@ def build_optimizer(
     ema_decay = _opt(training_cfg, "ema_decay")
 
     if name in ("adamw", "adamw_enhanced"):
+        use_ema = ema_decay if name == "adamw_enhanced" else None
+        # Single-pass donation-aliasable update (optim/fused.py); bitwise
+        # equal to the chain, so it is the default. ``fused: false`` opts
+        # out; EMA runs keep the chain (with_ema consumes the updates tree).
+        if bool(_opt(training_cfg, "fused", True)) and not use_ema:
+            return fused_adamw(
+                schedule, b1=float(betas[0]), b2=float(betas[1]), eps=eps,
+                weight_decay=wd, grad_clip=clip,
+                amsgrad=bool(_opt(training_cfg, "amsgrad", False)),
+            )
         return adamw(
             schedule, b1=float(betas[0]), b2=float(betas[1]), eps=eps, weight_decay=wd,
             grad_clip=clip, amsgrad=bool(_opt(training_cfg, "amsgrad", False)),
-            ema_decay=ema_decay if name == "adamw_enhanced" else None,
+            ema_decay=use_ema,
         )
     if name == "adam":
+        if bool(_opt(training_cfg, "fused", True)):
+            return fused_adamw(
+                schedule, b1=float(betas[0]), b2=float(betas[1]), eps=eps,
+                weight_decay=0.0, grad_clip=clip,
+            )
         return adam(schedule, b1=float(betas[0]), b2=float(betas[1]), eps=eps, grad_clip=clip)
     if name in ("sgd", "sgd_enhanced"):
         return sgd(

@@ -28,6 +28,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding
 
 from ..optim.base import Transform, apply_updates, global_norm
+from ..optim.fused import fused_apply_of
+from ..ops.donation import donate_argnums
 from ..parallel.sharding_rules import batch_pspec, state_sharding
 
 TrainState = Dict[str, Any]  # {"params", "opt_state", "step"}
@@ -109,8 +111,16 @@ def make_train_step(
             loss, toks, stats, grads = accumulate(params, batch)
         else:
             loss, toks, stats, grads = grads_of(params, batch)
-        updates, opt_state = optimizer.update(grads, state["opt_state"], params)
-        new_params = apply_updates(params, updates)
+        fused = fused_apply_of(optimizer)
+        if fused is not None:
+            # Single-pass update+apply (optim/fused.py): bitwise equal to
+            # the chain below, but with no intermediate updates tree, so
+            # the donated params/moments alias input->output cleanly
+            # (graftaudit donation-gap 0 on this program).
+            new_params, opt_state = fused(grads, state["opt_state"], params)
+        else:
+            updates, opt_state = optimizer.update(grads, state["opt_state"], params)
+            new_params = apply_updates(params, updates)
         metrics = {
             "loss": loss,
             "toks": toks,
@@ -125,7 +135,7 @@ def make_train_step(
         return new_state, metrics
 
     if mesh is None:
-        return jax.jit(train_step, donate_argnums=(0,)), None
+        return jax.jit(train_step, donate_argnums=donate_argnums(0)), None
 
     assert params_like is not None, "params_like required to derive shardings"
     probe_state = jax.eval_shape(lambda p: init_train_state(p, optimizer), params_like)
@@ -135,7 +145,7 @@ def make_train_step(
     metric_sharding = NamedSharding(mesh, jax.sharding.PartitionSpec())
     step_fn = jax.jit(
         train_step,
-        donate_argnums=(0,),
+        donate_argnums=donate_argnums(0),
         in_shardings=(shardings, batch_shardings),
         out_shardings=(shardings, None),
     )
@@ -179,14 +189,14 @@ def make_multi_step(
         return jax.lax.scan(body, state, batches)
 
     if mesh is None:
-        return jax.jit(multi_step, donate_argnums=(0,)), None
+        return jax.jit(multi_step, donate_argnums=donate_argnums(0)), None
 
     bp = batch_pspec(mesh)
     b_shard = NamedSharding(mesh, jax.sharding.PartitionSpec(None, *bp))
     batch_shardings = {"inputs": b_shard, "targets": b_shard, "mask": b_shard}
     multi_fn = jax.jit(
         multi_step,
-        donate_argnums=(0,),
+        donate_argnums=donate_argnums(0),
         in_shardings=(shardings, batch_shardings),
         out_shardings=(shardings, None),
     )

@@ -104,7 +104,13 @@ class Trainer:
         self.for_training = for_training
         self.runs_root = runs_root
 
-        # -- system: seeds, mesh (reference setup_system :964-1016) ---------
+        # -- system: XLA flag set, seeds, mesh (reference setup_system
+        # :964-1016). Flags FIRST: they are read once at backend init, and
+        # PRNGKey below initializes the backend.
+        from ..parallel import xla_flags as xla_flags_mod
+
+        self.xla_stamp = xla_flags_mod.apply_flag_set(
+            cfg.system.xla_flag_set, extra=cfg.system.xla_extra_flags)
         self.rng = jax.random.PRNGKey(cfg.system.seed)
         np.random.seed(cfg.system.seed)
         from ..parallel.context import set_mesh
@@ -152,6 +158,13 @@ class Trainer:
         # Integrity events (quarantine, GC, ledger rebuild, degraded
         # optimizer resume) surface in log.txt, not just stderr.
         self.checkpoints.notify = self.logger.log
+        if self.xla_stamp["xla_flags"]:
+            applied = self.xla_stamp["xla_flags_applied"]
+            self.logger.log(
+                f"xla flag set {self.xla_stamp['xla_flag_set']!r} "
+                f"({self.xla_stamp['xla_backend']}): "
+                + ("applied" if applied
+                   else f"NOT applied — {self.xla_stamp.get('reason')}"))
         if for_training and not resume and is_chief:
             cfg.to_yaml(os.path.join(run_dir, "config.yaml"))
 
@@ -200,9 +213,16 @@ class Trainer:
         self.logger.log_model_summary(self.n_params, args)
 
         self.compute_dtype = jnp.bfloat16 if cfg.system.compute_dtype == "bfloat16" else jnp.float32
-        remat = cfg.system.remat
+        # model.remat_policy is the first-class knob (named policies over
+        # checkpoint_name-tagged sites); system.remat / the legacy
+        # gradient_checkpointing bool remain as fallbacks.
+        remat = cfg.model.remat_policy
+        if remat is None:
+            remat = cfg.system.remat
         if remat is None and cfg.system.gradient_checkpointing:
             remat = "full"
+        if remat == "none":
+            remat = None
         self.remat = remat
         self.remat_ratio = float(cfg.system.gradient_checkpointing_ratio)
 
@@ -223,6 +243,11 @@ class Trainer:
                 self.logger.log("fused CE: sequence-sharded path on sp mesh")
 
         scan_layers = bool(getattr(cfg.system, "scan_layers", False))
+        # Manual fsdp gather/compute overlap (parallel/overlap.py). The
+        # knob only requests it; models/llama.py still gates on
+        # can_overlap(mesh, ...) so unsupported meshes fall back to GSPMD.
+        overlap = bool(getattr(cfg.system, "overlap_gather", False))
+        self.overlap_gather = overlap
         z_loss_weight = float(cfg.training.hyperparameters.get("z_loss") or 0.0)
 
         # MoE training steps carry routing stats (expert load, dropped
@@ -238,13 +263,16 @@ class Trainer:
                 and "with_moe_stats" in
                 _inspect.signature(arch.loss_fn).parameters) else 0)
         _stats_kw = {"with_moe_stats": True} if self.moe_stats_experts else {}
+        _ov_kw = ({"overlap": True} if (overlap and hasattr(arch, "loss_fn")
+                  and "overlap" in
+                  _inspect.signature(arch.loss_fn).parameters) else {})
 
         def loss_fn(params, batch):
             return arch.loss_fn(
                 params, batch, args, compute_dtype=self.compute_dtype,
                 remat=self.remat, remat_ratio=self.remat_ratio,
                 ce_chunk=ce_chunk, scan_layers=scan_layers,
-                z_loss_weight=z_loss_weight, **_stats_kw,
+                z_loss_weight=z_loss_weight, **_stats_kw, **_ov_kw,
             )
 
         # Validation excludes MoE router aux terms: val loss / ppl stay pure
@@ -1028,7 +1056,10 @@ class Trainer:
             self.events.append(
                 "run_start", name=cfg.name, total_steps=self.total_steps,
                 n_params=self.n_params, flops_per_token=self.flops_per_token,
-                peak_flops=self.peak_flops, n_chips=jax.device_count())
+                peak_flops=self.peak_flops, n_chips=jax.device_count(),
+                # attribution stamp: every downstream number traces to the
+                # XLA flag set it ran under (parallel/xla_flags.py)
+                **self.xla_stamp)
         log_int = max(1, cfg.logging.logging_interval)
         ckpt_int = cfg.logging.checkpoint_interval
         val_int = cfg.logging.validation_interval

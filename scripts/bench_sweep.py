@@ -8,7 +8,14 @@ real scale — where the MFU actually lives (VERDICT r3 item 2):
   FLASH_BLOCK_Q / FLASH_BLOCK_KV   flash kernel tiling
   BENCH_CE_CHUNK                   fused-CE rows per chunk
   BENCH_SCAN_LAYERS                lax.scan stack vs unrolled layers
-  BENCH_REMAT                      remat policy (none/dots/full)
+  BENCH_REMAT                      remat policy (none/dots/full/save_attn)
+  BENCH_XLA_FLAGS                  named XLA flag set (parallel/xla_flags.py)
+
+``--mfu`` runs the MFU-campaign matrix instead of the per-case combo
+list: the remat-policy x scan x flag-set cross product (axes trimmable
+via --remat/--scan/--flags), and folds the graftprof overlap/idle
+fractions into the summary table so the flag-set effect on exposed
+collectives is visible next to tok/s.
 
 Each combo runs in its own subprocess (a hung remote compile can only be
 SIGKILLed) and prints a ``BENCHCASE`` line whose case id carries the combo
@@ -42,7 +49,22 @@ _SHORT = {
     "BENCH_SCAN_LAYERS": "SCAN",
     "BENCH_REMAT": "REMAT",
     "BENCH_MEGASTEP": "MEGA",
+    "BENCH_XLA_FLAGS": "XLA",
 }
+
+# --mfu axes (MFU-campaign sweep). Defaults cover every named remat
+# policy (models/llama.py), both layer-stack forms, and both flag sets;
+# each axis can be trimmed on the command line.
+MFU_REMAT = ["none", "dots", "save_attn", "full"]
+MFU_SCAN = ["0", "1"]
+MFU_FLAGS = ["none", "latency_hiding"]
+
+
+def mfu_combos(remat_axis, scan_axis, flags_axis):
+    return [
+        {"BENCH_REMAT": r, "BENCH_SCAN_LAYERS": s, "BENCH_XLA_FLAGS": f}
+        for f in flags_axis for s in scan_axis for r in remat_axis
+    ]
 
 # Megastep-first: BENCH_MEGASTEP compiles K steps into one dispatch, so
 # the first combo separates tunnel dispatch overhead from chip compute —
@@ -102,14 +124,28 @@ def main():
     ap.add_argument("--timeout", type=int, default=600)
     ap.add_argument("--combo", action="append", default=[],
                     help="K=V[,K=V...] (repeatable; default: built-in list)")
+    ap.add_argument("--mfu", action="store_true",
+                    help="sweep the MFU-campaign matrix: remat policy x "
+                         "scan x xla flag set")
+    ap.add_argument("--remat", default=",".join(MFU_REMAT),
+                    help="--mfu remat axis (comma list)")
+    ap.add_argument("--scan", default=",".join(MFU_SCAN),
+                    help="--mfu scan axis (comma list of 0/1)")
+    ap.add_argument("--flags", default=",".join(MFU_FLAGS),
+                    help="--mfu flag-set axis (comma list)")
     ap.add_argument("--skip-done", default=None,
                     help="out-file from a previous attempt: combos whose "
                          "case id already has a row there are not re-run, "
                          "so a retried sweep resumes instead of restarting")
     a = ap.parse_args()
 
-    combos = ([parse_combo(c) for c in a.combo]
-              or DEFAULT_COMBOS.get(a.case))
+    if a.mfu:
+        combos = ([parse_combo(c) for c in a.combo]
+                  or mfu_combos(a.remat.split(","), a.scan.split(","),
+                                a.flags.split(",")))
+    else:
+        combos = ([parse_combo(c) for c in a.combo]
+                  or DEFAULT_COMBOS.get(a.case))
     if not combos:
         sys.exit(f"no default combos for case {a.case!r}; pass --combo")
 
@@ -124,6 +160,7 @@ def main():
                         pass
 
     failures = 0
+    rows = []
     for combo in combos:
         label = combo_label(combo)
         if f"{a.case}@{label}" in already:
@@ -162,10 +199,33 @@ def main():
             continue
         row["case"] = f"{a.case}@{label}"
         row["sweep_combo"] = combo
+        rows.append(row)
         print(CASE_MARK + json.dumps(row), flush=True)
         print(f"[sweep] {label}: tok_s={row.get('tok_s')} mfu={row.get('mfu')}"
               f" ({time.perf_counter() - t0:.0f}s)", file=sys.stderr)
+    if rows:
+        print_table(rows)
     sys.exit(1 if failures else 0)
+
+
+def print_table(rows):
+    """Aligned sweep summary on stderr. The graftprof fraction columns
+    (prof_* from bench.py's in-run profile) appear whenever any row has
+    them — overlap_frac next to tok/s is how a flag set proves it moved
+    collectives off the critical path, not just the step time."""
+    cols = ["case", "tok_s", "mfu"]
+    for c in ("prof_compute_frac", "prof_comm_frac", "prof_overlap_frac",
+              "prof_idle_frac"):
+        if any(c in r for r in rows):
+            cols.append(c)
+    head = [c.replace("prof_", "") for c in cols]
+    table = [head] + [
+        ["" if r.get(c) is None else str(r.get(c, "")) for c in cols]
+        for r in rows]
+    widths = [max(len(row[i]) for row in table) for i in range(len(cols))]
+    for row in table:
+        print("[sweep] " + "  ".join(v.ljust(w) for v, w in zip(row, widths)),
+              file=sys.stderr)
 
 
 if __name__ == "__main__":
