@@ -1160,6 +1160,198 @@ def bench_serve_router_case(name="serve_router"):
     }
 
 
+_FLEET_REPLICA = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+cores, role = sys.argv[1], sys.argv[2]
+if cores and hasattr(os, "sched_setaffinity"):
+    os.sched_setaffinity(0, {{int(c) for c in cores.split(",")}})
+import jax
+from mlx_cuda_distributed_pretraining_tpu.config import DataConfig
+from mlx_cuda_distributed_pretraining_tpu.infer.server import (
+    InferenceService, serve)
+from mlx_cuda_distributed_pretraining_tpu.models import llama
+from mlx_cuda_distributed_pretraining_tpu.serve import BatchEngine, EngineConfig
+from mlx_cuda_distributed_pretraining_tpu.tokenizer import TokenizerManager
+
+tok = TokenizerManager(DataConfig())
+args = llama.LlamaArgs(vocab_size=tok.vocab_size,
+                       max_position_embeddings=256, **{shape!r})
+params = llama.init_params(jax.random.PRNGKey(0), args)
+service = InferenceService(params, args, tok, run_name="bench")
+service.engine = BatchEngine(
+    params, args, tok,
+    EngineConfig(num_slots=8, max_len=256, prefill_chunk=64,
+                 max_queue=128, kv_backend="paged", block_size=32,
+                 prefix_cache=True, role=role)).start()
+httpd = serve(service, port=0)
+print("REPLICA_PORT", httpd.server_address[1], flush=True)
+while True:
+    time.sleep(3600)
+"""
+
+
+def bench_serve_fleet_case(name="serve_fleet"):
+    """Disaggregated 1 prefill + 1 decode fleet (serve/fleet.py) vs a
+    homogeneous 2-replica router at EQUAL replica/core count under a
+    mixed ``prefill-heavy:decode-heavy`` flood. The disaggregation claim
+    is an ISOLATION claim: decode-class requests must not queue behind
+    512-token prefills, so the bar is decode-class TTFT p99 (fleet <=
+    homogeneous). Prompt shapes are scaled to the bench model
+    (prefill-heavy 192/8, decode-heavy 16/48) and every prompt is
+    unique, so each handoff ships a fresh KV chain over the wire.
+
+    The fleet arm additionally performs a LIVE canary rolling weight
+    swap mid-flood (FleetController.rolling_swap against a checkpoint
+    that is value-identical, as in a deploy of retrained weights) — the
+    acceptance bar includes zero failed requests across the cutover.
+    The homogeneous arm is not swapped; the jitter handicap is on the
+    fleet side. Core-split bar semantics follow serve_router:
+    ``bar_enforced`` only when there are >= 2 cores to split."""
+    import importlib.util
+    import os
+    import subprocess
+    import tempfile
+    import threading
+
+    import jax
+    import numpy as np
+
+    from mlx_cuda_distributed_pretraining_tpu.checkpoint.safetensors_io import (
+        save_safetensors,
+    )
+    from mlx_cuda_distributed_pretraining_tpu.config import DataConfig
+    from mlx_cuda_distributed_pretraining_tpu.models import llama
+    from mlx_cuda_distributed_pretraining_tpu.serve import (
+        FleetConfig,
+        FleetController,
+        FleetRouter,
+        Router,
+        serve_router,
+    )
+    from mlx_cuda_distributed_pretraining_tpu.tokenizer import TokenizerManager
+    from mlx_cuda_distributed_pretraining_tpu.utils.tree import flatten_dict
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    spec = importlib.util.spec_from_file_location(
+        "load_gen", os.path.join(repo, "scripts", "load_gen.py"))
+    load_gen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(load_gen)
+
+    MIX = "prefill-heavy:decode-heavy"
+    SHAPES = {"prefill-heavy": (192, 8), "decode-heavy": (16, 48)}
+    FLOOD, CONC = 24, 6
+
+    try:
+        all_cores = sorted(os.sched_getaffinity(0))
+    except AttributeError:
+        all_cores = list(range(os.cpu_count() or 1))
+    cores_per_replica = max(1, len(all_cores) // 2)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def spawn_replica(idx, role):
+        cores = all_cores[idx * cores_per_replica:(idx + 1) * cores_per_replica]
+        src = _FLEET_REPLICA.format(repo=repo, shape=SCALES["2m"]["shape"])
+        proc = subprocess.Popen(
+            [sys.executable, "-c", src, ",".join(map(str, cores)), role],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+            text=True)
+        line = proc.stdout.readline()
+        if not line.startswith("REPLICA_PORT"):
+            proc.kill()
+            raise RuntimeError(f"replica {idx} died before binding: {line!r}")
+        return proc, f"http://127.0.0.1:{int(line.split()[1])}"
+
+    def flood(disagg, swap_path=None):
+        roles = ["prefill", "decode"] if disagg else ["any", "any"]
+        procs_urls = [spawn_replica(i, r) for i, r in enumerate(roles)]
+        urls = [u for _, u in procs_urls]
+        if disagg:
+            # Only long prompts pay the handoff round-trip; decode-class
+            # prompts (~100 bytes) prefill locally on the decode pool.
+            router = FleetRouter([urls[0]], [urls[1]],
+                                 poll_interval_s=0.2,
+                                 handoff_min_prompt_bytes=400)
+        else:
+            router = Router(urls, poll_interval_s=0.2)
+        rhttpd = serve_router(router, port=0)
+        rurl = f"http://127.0.0.1:{rhttpd.server_address[1]}"
+        swap = None
+        try:
+            # Warm every compile variant each arm will see (one request
+            # per class through the router exercises handoff + decode).
+            load_gen.run_load(rurl, concurrency=2, requests=4, prompt="",
+                              max_tokens=8, temperature=0.0, deadline_s=None,
+                              timeout=600.0, mix=MIX, mix_shapes=SHAPES)
+            result = {}
+
+            def timed():
+                result["summary"] = load_gen.run_load(
+                    rurl, concurrency=CONC, requests=FLOOD, prompt="",
+                    max_tokens=8, temperature=0.0, deadline_s=None,
+                    timeout=600.0, mix=MIX, mix_shapes=SHAPES)
+
+            t = threading.Thread(target=timed)
+            t.start()
+            if disagg and swap_path:
+                ctl = FleetController(router, FleetConfig())
+                time.sleep(0.5)  # flood in flight before the cutover
+                swap = ctl.rolling_swap(model_path=swap_path,
+                                        canary_requests=2,
+                                        canary_timeout_s=300.0)
+            t.join()
+            return result["summary"], swap
+        finally:
+            rhttpd.shutdown()
+            rhttpd.server_close()
+            router.stop()
+            for proc, _ in procs_urls:
+                proc.kill()
+                proc.communicate()
+
+    tok = TokenizerManager(DataConfig())
+    args = llama.LlamaArgs(vocab_size=tok.vocab_size,
+                           max_position_embeddings=256, **SCALES["2m"]["shape"])
+    params = llama.init_params(jax.random.PRNGKey(0), args)
+    with tempfile.TemporaryDirectory() as td:
+        swap_path = os.path.join(td, "model.safetensors")
+        save_safetensors(swap_path, {k: np.asarray(v) for k, v in
+                                     flatten_dict(params).items()})
+        fleet, swap = flood(True, swap_path=swap_path)
+        homog, _ = flood(False)
+
+    def dec_p99(s):
+        return s["mix"]["decode-heavy"]["ttft_p99_s"]
+
+    speedup = round(dec_p99(homog) / max(dec_p99(fleet), 1e-9), 2)
+    bar_enforced = len(all_cores) >= 2
+    swap_clean = (swap is not None and not swap["failed"]
+                  and len(swap["swapped"]) == 2)
+    return {
+        "case": name, "requests": FLOOD, "concurrency": CONC, "mix": MIX,
+        "mix_shapes": {k: list(v) for k, v in SHAPES.items()},
+        "cores": len(all_cores), "cores_per_replica": cores_per_replica,
+        "decode_ttft_p99_s_fleet": dec_p99(fleet),
+        "decode_ttft_p99_s_homog": dec_p99(homog),
+        "decode_ttft_p99_speedup": speedup,
+        "decode_tpot_p50_s_fleet": fleet["mix"]["decode-heavy"]["tpot_p50_s"],
+        "decode_tpot_p50_s_homog": homog["mix"]["decode-heavy"]["tpot_p50_s"],
+        "ok_fleet": fleet.get("ok"), "ok_homog": homog.get("ok"),
+        "failed_fleet": FLOOD - (fleet.get("ok") or 0),
+        "swap_replicas": (len(swap["swapped"]) if swap else 0),
+        "swap_failed": (len(swap["failed"]) if swap else None),
+        "swap_clean_zero_failed": bool(
+            swap_clean and fleet.get("ok") == FLOOD),
+        "bar_enforced": bar_enforced,
+        "bar_met": (bool(speedup >= 1.0 and swap_clean
+                         and fleet.get("ok") == FLOOD)
+                    if bar_enforced else None),
+    }
+
+
 _SERVE_TP_WORKER = """
 import json, sys, time
 sys.path.insert(0, {repo!r})
@@ -2091,6 +2283,11 @@ def build_plan(vocab, steps):
         # disjoint core subset; the >= 1.7x aggregate-tok/s bar is only
         # enforced with >= 2 cores (the row records cores_per_replica).
         ("serve_router", "serve", lambda: bench_serve_router_case(), 300),
+        # serve_fleet: disaggregated 1 prefill + 1 decode pool with KV
+        # handoff vs a homogeneous 2-replica router at equal cores under
+        # a mixed flood — bar is decode-class TTFT p99 (isolation) plus
+        # a zero-failed live canary weight swap mid-flood.
+        ("serve_fleet", "serve", lambda: bench_serve_fleet_case(), 420),
         # serve_tp: GSPMD tensor-parallel engine, tp=2 vs tp=1 on two
         # forced host devices — token-identical greedy, unchanged
         # per-step host-sync count, layout-overhead tok/s + TTFT.

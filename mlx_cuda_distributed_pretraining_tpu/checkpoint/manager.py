@@ -977,6 +977,19 @@ class CheckpointManager:
                 _atomic_json(os.path.join(self.run_dir, "metadata.json"), ledger)
 
 
+def latest_model_path(run_dir: str) -> Optional[str]:
+    """Newest VERIFIED model file under ``run_dir`` (read-only scan — a
+    concurrent trainer's resume/GC state is untouched). The serving
+    fleet's rolling weight swap resolves a run directory to the concrete
+    safetensors path through this, so a torn newest checkpoint degrades
+    the swap by one interval instead of failing it."""
+    mgr = CheckpointManager(run_dir)
+    step = mgr.latest_complete_step(quarantine=False)
+    if step is None:
+        return None
+    return mgr.paths_for_step(step)[0]
+
+
 def _restructure_like(like: Any, nested_dict: Any) -> Any:
     """Map a nested plain-dict (string keys, possibly stringified list
     indices) back onto the structure of ``like`` (dicts/lists/tuples)."""

@@ -68,6 +68,10 @@ class InferenceService:
         self.n_params = llama.num_params(params)
         self.started_at = int(time.time())
         self.engine = None  # set by attach_engine (--engine batch)
+        # Fleet lifecycle: a draining replica refuses NEW generation work
+        # (503 -> the router unpublishes it) while in-flight requests run
+        # to completion — the graceful half of scale-down and weight swap.
+        self.draining = False
 
     def attach_engine(self, cfg=None, mesh=None) -> "object":
         """Start the continuous-batching engine (serve/) and route
@@ -209,7 +213,7 @@ class InferenceService:
 
     def health(self) -> dict:
         d = {
-            "status": "ok",
+            "status": "draining" if self.draining else "ok",
             "run": self.run_name,
             "architecture": "llama",
             "params_m": round(self.n_params / 1e6, 2),
@@ -227,9 +231,99 @@ class InferenceService:
         return d
 
     def metrics(self) -> dict:
-        if self.engine is not None:
-            return self.engine.metrics()
-        return {"engine": "locked"}
+        base = (self.engine.metrics() if self.engine is not None
+                else {"engine": "locked", "role": "any"})
+        base["draining"] = self.draining
+        return base
+
+    # -- disaggregated fleet -------------------------------------------------
+    def prefill_handoff(self, body: dict,
+                        trace_id: Optional[str] = None) -> dict:
+        """POST /prefill: run a prefill-only request (prompt KV written +
+        published, no token sampled), export the block chain, and — when
+        ``transfer_to`` names a decode replica — push it there inside a
+        ``kv_transfer`` span. Returns a JSON summary either way; the
+        router then dispatches the ORIGINAL request to the decode
+        replica, whose admission adopts the transferred chain."""
+        if self.engine is None:
+            raise ValueError("/prefill requires --engine batch")
+        prompt = body["prompt"]
+        if isinstance(prompt, list):
+            prompt = prompt[0]
+        if not isinstance(prompt, str):
+            raise ValueError("'prompt' must be a string")
+        dl = body.get("deadline_s")
+        req = self.engine.submit(prompt, max_tokens=1,
+                                 temperature=0.0,
+                                 seed=int(body.get("seed", 0)),
+                                 deadline_s=(float(dl) if dl is not None
+                                             else None),
+                                 trace_id=trace_id, prefill_only=True)
+        if not req.wait(timeout=float(body.get("timeout_s", 300.0))):
+            raise TimeoutError("prefill did not complete in time")
+        if req.error is not None:
+            raise TimeoutError(req.error)
+        payload = self.engine.export_kv(req.prompt_ids, trace_id=trace_id)
+        out = {
+            "prefill": True,
+            "prompt_tokens": len(req.prompt_ids),
+            "blocks": payload.num_blocks,
+            "trace_id": req.trace_id,
+            **{k: req.result[k] for k in ("queue_ms", "prefill_ms")
+               if k in (req.result or {})},
+        }
+        target = body.get("transfer_to")
+        if target and payload.num_blocks:
+            from ..serve.kv_transfer import push_payload
+
+            t0 = time.perf_counter()
+            stats = push_payload(target, payload, trace_id=trace_id)
+            dur = time.perf_counter() - t0
+            if self.engine.tracer.enabled:
+                # The span that joins the two replicas' trees in
+                # scripts/trace_report.py: prefill-side, decode-bound.
+                self.engine.tracer.complete(
+                    "kv_transfer", dur, trace_id=trace_id,
+                    target=target, blocks=payload.num_blocks,
+                    bytes=payload.nbytes(), **stats)
+            out.update({"transfer_ms": round(dur * 1e3, 2),
+                        "transfer_bytes": payload.nbytes(), **stats})
+        return out
+
+    def adopt_kv(self, data: bytes, trace_id: Optional[str] = None) -> dict:
+        """POST /adopt_kv: install a pushed KV payload into this
+        replica's arena (decode side of the handoff)."""
+        if self.engine is None:
+            raise ValueError("/adopt_kv requires --engine batch")
+        from ..serve.kv_transfer import KVTransferPayload
+
+        payload = KVTransferPayload.from_bytes(data)
+        return self.engine.adopt_kv(payload, trace_id=trace_id)
+
+    def swap_weights(self, body: dict) -> dict:
+        """POST /admin/swap_weights: reshard a checkpoint straight into
+        the live engine's mesh (per-device slices, no host gather) and
+        cut over between iterations — in-flight requests finish on the
+        new weights, nothing is evicted or failed."""
+        from ..checkpoint.manager import CheckpointManager, latest_model_path
+
+        model_path = body.get("model_path")
+        if not model_path and body.get("run_dir"):
+            model_path = latest_model_path(body["run_dir"])
+            if model_path is None:
+                raise ValueError(
+                    f"no complete checkpoint under {body['run_dir']!r}")
+        if not model_path:
+            raise ValueError("need 'model_path' or 'run_dir'")
+        like = self.engine.params if self.engine is not None else self.params
+        mesh = self.engine.mesh if self.engine is not None else None
+        new = CheckpointManager.load_params(model_path, like=like, mesh=mesh)
+        with self.lock:  # the locked path reads self.params per request
+            self.params = new
+        version = (self.engine.swap_params(new)
+                   if self.engine is not None else 0)
+        return {"swapped": True, "model_path": model_path,
+                "params_version": version}
 
     def trace(self, clear: bool = False) -> dict:
         """Chrome trace dump of the engine's span ring (GET /trace)."""
@@ -378,8 +472,67 @@ def make_handler(service: InferenceService):
 
         def do_POST(self):
             path = self.path.rstrip("/")
-            if path not in ("/generate", "/v1/completions"):
+            if path in ("/admin/drain", "/admin/undrain"):
+                # Drain: stop admitting (503 below -> the router
+                # unpublishes this replica) while in-flight work runs to
+                # completion; undrain reopens (e.g. post-swap canary).
+                service.draining = path == "/admin/drain"
+                m = service.metrics()
+                self._reply(200, {
+                    "draining": service.draining,
+                    "inflight": int(m.get("batch_occupancy", 0)),
+                    "queue_depth": int(m.get("queue_depth", 0))})
+                return
+            if path == "/admin/swap_weights":
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    self._reply(200, service.swap_weights(body))
+                except (ValueError, KeyError, TypeError,
+                        json.JSONDecodeError) as e:
+                    self._reply(400, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                return
+            if path == "/adopt_kv":
+                # Binary GKV1 payload (serve/kv_transfer.py), NOT json —
+                # and deliberately allowed while draining: adoption only
+                # warms the prefix cache, it admits nothing.
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    self._reply(200, service.adopt_kv(
+                        self.rfile.read(length),
+                        trace_id=self.headers.get(TRACE_HEADER)))
+                except (ValueError, KeyError, TypeError) as e:
+                    self._reply(400, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                return
+            if path not in ("/generate", "/v1/completions", "/prefill"):
                 self._reply(404, {"error": f"unknown path {self.path}"})
+                return
+            if service.draining:
+                self._reply(503, {"error": "draining: not admitting "
+                                           "new requests"})
+                return
+            if path == "/prefill":
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    if not isinstance(body, dict) or "prompt" not in body:
+                        raise ValueError(
+                            "body must be a JSON object with 'prompt'")
+                    self._reply(200, service.prefill_handoff(
+                        body, trace_id=self.headers.get(TRACE_HEADER)))
+                except QueueFullError as e:
+                    self._reply(429, {"error": str(e)})
+                except TimeoutError as e:
+                    self._reply(504, {"error": str(e)})
+                except (ValueError, KeyError, TypeError,
+                        json.JSONDecodeError) as e:
+                    self._reply(400, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
                 return
             try:
                 length = int(self.headers.get("Content-Length", "0"))
@@ -558,6 +711,16 @@ def main(argv=None) -> int:
                         "step over the device mesh; the checkpoint "
                         "reshards straight into it on load (yaml: "
                         "serving.mesh)")
+    p.add_argument("--role", choices=("any", "prefill", "decode"),
+                   default="any",
+                   help="fleet pool this replica serves (surfaced via "
+                        "/metrics; the fleet router routes accordingly)")
+    p.add_argument("--fleet-dir", default=None,
+                   help="fleet membership directory (serve/fleet.py): "
+                        "register this replica and heartbeat so the "
+                        "controller sees liveness/death")
+    p.add_argument("--fleet-index", type=int, default=0,
+                   help="membership slot index under --fleet-dir")
     a = p.parse_args(argv)
 
     mesh = None
@@ -585,11 +748,18 @@ def main(argv=None) -> int:
             prefix_cache=not a.no_prefix_cache,
             prefix_min_hit_blocks=a.prefix_min_hit_blocks,
             default_deadline_s=a.deadline_s, stats_url=a.stats_url,
-            trace=a.trace, trace_sample=a.trace_sample,
+            trace=a.trace, trace_sample=a.trace_sample, role=a.role,
             mesh=parse_mesh_spec(a.mesh) if a.mesh else None), mesh=mesh)
     httpd = ThreadingHTTPServer((a.host, a.port), make_handler(service))
+    if a.fleet_dir:
+        from ..serve.fleet import start_heartbeat
+
+        start_heartbeat(a.fleet_dir,
+                        f"http://{a.host}:{httpd.server_address[1]}",
+                        role=a.role, index=a.fleet_index)
     print(f"serving {a.run} ({service.n_params / 1e6:.1f}M params, "
-          f"engine={a.engine}) on http://{a.host}:{httpd.server_address[1]}")
+          f"engine={a.engine}, role={a.role}) "
+          f"on http://{a.host}:{httpd.server_address[1]}")
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:

@@ -28,11 +28,19 @@ many requests concurrently from ONE compiled decode step:
 - ``router``    — the multi-replica HTTP front door: consistent-hash
   prefix/session affinity (cache hits land where the blocks live),
   least-loaded spill, SSE pass-through, 429 backpressure with
-  Retry-After, and idempotent retry when a replica dies.
+  Retry-After, and idempotent retry when a replica dies;
+- ``kv_transfer`` — the GKV1 wire format for shipping KV block chains
+  between replicas, addressed by prefix-cache content hashes (shared
+  prefixes cross the wire at most once, receivers verify the chain);
+- ``fleet``     — disaggregated prefill/decode pools over the router:
+  KV handoff dispatch, heartbeat membership, queue/KV-pressure
+  autoscaling, graceful drain, and canary-gated rolling weight swaps.
 """
 
 from .engine import BatchEngine, EngineConfig, QueueFullError
-from .kv_pool import PagedKVPool, SlotKVPool
+from .fleet import FleetConfig, FleetController, FleetRouter
+from .kv_pool import KVExport, PagedKVPool, SlotKVPool
+from .kv_transfer import KVTransferPayload
 from .prefix_cache import PrefixCache
 from .router import Router, serve_router
 from .scheduler import Request, Scheduler
@@ -40,6 +48,11 @@ from .scheduler import Request, Scheduler
 __all__ = [
     "BatchEngine",
     "EngineConfig",
+    "FleetConfig",
+    "FleetController",
+    "FleetRouter",
+    "KVExport",
+    "KVTransferPayload",
     "PagedKVPool",
     "PrefixCache",
     "QueueFullError",

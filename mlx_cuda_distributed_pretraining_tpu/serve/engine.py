@@ -66,6 +66,7 @@ class EngineConfig:
     stats_url: Optional[str] = None  # ws://host:port of obs stats server
     stats_interval_s: float = 1.0
     worker_id: str = "serve-engine"
+    role: str = "any"           # fleet pool: "prefill" | "decode" | "any"
     metrics_port: int = 0       # Prometheus exposition (obs/prometheus.py); 0 off
     mesh: Optional[Dict[str, int]] = None  # serving mesh axes, e.g. {"tp": 2};
     #                             None/all-ones = single-device (pre-mesh path)
@@ -156,6 +157,11 @@ class BatchEngine:
         self._thread: Optional[threading.Thread] = None
         self._stats = None
         self.iterations = 0
+        # Cross-thread work: the engine thread is the SOLE mutator of pool
+        # bookkeeping and self.params, so KV export/adopt and weight swaps
+        # enqueue closures here and _iteration drains them between steps.
+        self._tasks: "queue.Queue" = queue.Queue()
+        self.params_version = 0  # bumps on every applied weight swap
         # sliding decode-throughput window + last-published snapshot
         self._win_t0 = time.monotonic()
         self._win_tokens = 0
@@ -226,6 +232,14 @@ class BatchEngine:
         self._mg_prefix_hit_rate = reg.gauge(
             "serve_prefix_cache_hit_rate",
             "prompt tokens served from cache / prompt tokens offered")
+        # Disaggregated-fleet observability: KV handoff volume and
+        # zero-downtime weight swaps (zero outside a fleet).
+        self._mc_kv_transfer = reg.counter(
+            "serve_kv_transfer_blocks_total",
+            "KV blocks moved by the prefill->decode handoff, by kind "
+            "(exported/adopted/reused)")
+        self._mc_swaps = reg.counter(
+            "serve_weight_swaps_total", "weight swaps applied in place")
         self._spec_proposed = 0
         self._spec_accepted = 0
         self._m_last = {"admitted": 0, "rejected": 0, "evicted": 0,
@@ -289,12 +303,114 @@ class BatchEngine:
             self._thread.join(timeout=10.0)
             self._thread = None
         self.scheduler.drain(self.pool)
+        self._drain_tasks()  # run stragglers inline; nobody left to race
         if self._stats is not None:
             self._stats.close()
             self._stats = None
         if self._metrics_server is not None:
             self._metrics_server.shutdown()
             self._metrics_server = None
+
+    # -- engine-thread task queue --------------------------------------------
+    def _drain_tasks(self) -> None:
+        while True:
+            try:
+                fn, box, done = self._tasks.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                box["result"] = fn()
+            except Exception as e:  # noqa: BLE001 - delivered to the caller
+                box["error"] = e
+            done.set()
+
+    def call_in_loop(self, fn, timeout: float = 120.0):
+        """Run ``fn`` on the engine thread between iterations and return
+        its result (exceptions re-raise here). Pool bookkeeping and
+        ``self.params`` have a single writer — the loop — so any
+        cross-thread mutation (KV export/adopt, weight swap) must ride
+        this. Runs inline when the loop is not running."""
+        t = self._thread
+        if t is None or not t.is_alive():
+            return fn()
+        done = threading.Event()
+        box: Dict[str, Any] = {}
+        self._tasks.put((fn, box, done))
+        self._wake.set()
+        if not done.wait(timeout):
+            raise TimeoutError("engine-loop task timed out")
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
+
+    # -- disaggregated fleet: weight swap + KV handoff -----------------------
+    def swap_params(self, new_params) -> int:
+        """Zero-downtime weight swap: shard ``new_params`` into this
+        engine's mesh on the CALLING thread (the expensive part — in-flight
+        decode keeps stepping on the old weights meanwhile), then cut the
+        pointer over between two iterations. Requests straddling the
+        cutover decode their remaining tokens on the new weights; nothing
+        is evicted, nothing fails. Returns the new params_version."""
+        placed = (self._place_params(new_params, self.mesh)
+                  if self.mesh is not None else new_params)
+
+        def _cutover():
+            self.params = placed
+            self.params_version += 1
+            self._mc_swaps.inc()
+            return self.params_version
+
+        return self.call_in_loop(_cutover)
+
+    def export_kv(self, token_ids: List[int],
+                  trace_id: Optional[str] = None):
+        """Serialize the cached KV chain covering ``token_ids`` into a
+        ``KVTransferPayload`` (the prefill half of the handoff). Pin on
+        the engine thread, fetch bytes off it, release on it again."""
+        from .kv_transfer import build_payload
+
+        pool = self.pool
+        if pool.kind != "paged" or getattr(pool, "prefix", None) is None:
+            raise ValueError("KV export needs kv_backend='paged' with "
+                             "prefix_cache=True")
+        export = self.call_in_loop(lambda: pool.export_blocks(token_ids))
+        try:
+            payload = build_payload(export, token_ids, pool.block_size,
+                                    pool.quantize)
+        finally:
+            self.call_in_loop(lambda: pool.release_export(export))
+        if payload.num_blocks:
+            self._mc_kv_transfer.inc(payload.num_blocks, kind="exported")
+        if self.tracer.enabled:
+            self.tracer.instant("kv_export", trace_id=trace_id,
+                                blocks=payload.num_blocks,
+                                bytes=payload.nbytes())
+        return payload
+
+    def adopt_kv(self, payload, trace_id: Optional[str] = None
+                 ) -> Dict[str, int]:
+        """Install a transferred payload into this engine's arena (the
+        decode half). Verifies the chain keys and the arena layout before
+        any bytes land; returns the pool's adopt stats."""
+        pool = self.pool
+        if pool.kind != "paged" or getattr(pool, "prefix", None) is None:
+            raise ValueError("KV adopt needs kv_backend='paged' with "
+                             "prefix_cache=True")
+        if payload.block_size != pool.block_size:
+            raise ValueError(f"payload block_size {payload.block_size} != "
+                             f"pool block_size {pool.block_size}")
+        if bool(payload.quantized) != bool(pool.quantize):
+            raise ValueError("payload/pool KV quantization mismatch "
+                             f"({payload.quantized} vs {pool.quantize})")
+        payload.verify_keys()
+        stats = self.call_in_loop(
+            lambda: pool.adopt_blocks(payload.keys, payload.blocks))
+        for kind in ("adopted", "reused"):
+            if stats.get(kind):
+                self._mc_kv_transfer.inc(stats[kind], kind=kind)
+        if self.tracer.enabled:
+            self.tracer.instant("kv_adopt", trace_id=trace_id, **stats)
+        return stats
 
     def warmup(self, prompt_ids: Optional[List[int]] = None) -> None:
         """Pay the prefill/decode jit compiles before traffic arrives."""
@@ -312,23 +428,29 @@ class BatchEngine:
                temperature: float = 0.0, seed: int = 0,
                deadline_s: Optional[float] = None,
                stream: bool = False,
-               trace_id: Optional[str] = None) -> Request:
+               trace_id: Optional[str] = None,
+               prefill_only: bool = False) -> Request:
         """Tokenize and enqueue; raises QueueFullError (-> 429) past the
         queue bound, ValueError when the request can never fit a slot.
         With ``stream=True`` the request carries a ``stream_q`` the engine
         pushes each sampled token id into (None = end of stream) — the
         HTTP layer drains it into an SSE response. ``trace_id`` joins this
         request's spans to an upstream trace (router X-Trace-Id); one is
-        minted when absent so responses always carry an id."""
+        minted when absent so responses always carry an id.
+        ``prefill_only=True`` (disaggregated handoff) finishes the request
+        the moment its prompt KV is materialized and published — no token
+        is sampled; a decode replica adopts the blocks and samples."""
         ids = [self.tokenizer.bos_id] + self.tokenizer.tokenize(prompt)
         return self._submit_ids(ids, max_tokens, temperature, seed,
-                                deadline_s, stream=stream, trace_id=trace_id)
+                                deadline_s, stream=stream, trace_id=trace_id,
+                                prefill_only=prefill_only)
 
     def _submit_ids(self, ids: List[int], max_tokens: int,
                     temperature: float, seed: int,
                     deadline_s: Optional[float] = None,
                     stream: bool = False,
-                    trace_id: Optional[str] = None) -> Request:
+                    trace_id: Optional[str] = None,
+                    prefill_only: bool = False) -> Request:
         import jax
 
         P = len(ids)
@@ -346,7 +468,8 @@ class BatchEngine:
         req = Request(ids, max_tokens, temperature=temperature, seed=seed,
                       deadline_s=(deadline_s if deadline_s is not None
                                   else self.cfg.default_deadline_s),
-                      stop_ids=[self.tokenizer.eos_id])
+                      stop_ids=[self.tokenizer.eos_id],
+                      prefill_only=prefill_only)
         if stream:
             req.stream_q = queue.Queue()
         from ..obs.trace import new_trace_id
@@ -387,6 +510,10 @@ class BatchEngine:
             "completed": s.completed,
             "preempted": s.preempted,
             "kv_backend": self.pool.kind,
+            # Fleet fields: the router's poller reads these to learn pool
+            # membership and swap progress.
+            "role": self.cfg.role,
+            "params_version": self.params_version,
             # Dashboard "mesh" column: "tp=2" / "tp=2,dp=2" / "1dev".
             "mesh": (",".join(f"{a}={n}" for a, n in self.mesh.shape.items())
                      if self.mesh is not None else "1dev"),
@@ -395,6 +522,10 @@ class BatchEngine:
             snap.update({
                 "kv_blocks_used": self.pool.blocks_in_use,
                 "kv_blocks_free": self.pool.free_blocks,
+                "kv_num_blocks": self.pool.num_blocks,
+                # Peek (no reset — _publish owns the reset cycle): the
+                # fleet autoscaler keys scale-up on this headroom gauge.
+                "kv_free_watermark": self.pool._watermark,
                 "kv_fragmentation": round(self.pool.fragmentation(), 4),
             })
         if self.draft_len:
@@ -514,6 +645,7 @@ class BatchEngine:
 
     def _iteration(self) -> bool:
         self.iterations += 1
+        self._drain_tasks()  # KV export/adopt + weight cutover run here
         sched, pool = self.scheduler, self.pool
         for r in sched.expire(pool):
             self._resolve_evicted(r)
@@ -603,6 +735,15 @@ class BatchEngine:
         if not final:
             return
         pool.lengths[req.slot] = P
+        if req.prefill_only:
+            # Handoff request: the prompt KV is written and every full
+            # block published under its chain key — that WAS the job.
+            # No sampling; the adopting decode replica recomputes the
+            # final prompt token's logits and samples there.
+            if req.first_token_at is None:
+                req.first_token_at = time.monotonic()
+            self._finish(req, "prefill")
+            return
         tok, lp, key = batch_step.sample_token(last_logits, req.temperature,
                                                req.rng_key)
         req.rng_key = np.asarray(key)

@@ -23,10 +23,29 @@ sequence length is ``max_len - 1``.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
 
 from ..models import llama
 from .prefix_cache import PrefixCache, chain_keys
+
+
+@dataclass
+class KVExport:
+    """A pinned, immutable view of one request's full KV blocks.
+
+    Produced by ``PagedKVPool.export_blocks``: every listed block carries
+    an extra refcount (it cannot be recycled or evicted while the export
+    is live) and ``cache`` snapshots the arena array refs — jax arrays
+    are immutable, so the snapshot stays byte-consistent even while the
+    engine keeps decoding into NEW arena arrays. Callers read KV bytes
+    from ``cache`` (off the engine thread if they like), then MUST call
+    ``release_export`` exactly once."""
+
+    keys: List[bytes]          # chain keys, one per exported full block
+    blocks: List[int]          # pinned physical block ids, chain order
+    cache: list = field(repr=False, default_factory=list)
+    released: bool = False
 
 
 def _place_cache(cache, mesh, num_kv_heads):
@@ -429,3 +448,152 @@ class PagedKVPool:
         """Longest written length among ``seqs`` — drives the attend bucket
         of the next batched decode step."""
         return max((self.lengths[s] for s in seqs), default=0)
+
+    # -- KV transfer (public API) --------------------------------------------
+    # The disaggregated-serving handoff (serve/kv_transfer.py) moves KV
+    # between replicas through these three calls. Both sides must run with
+    # the prefix cache on: content-hash chain keys are the wire addresses,
+    # which is what makes shared prefixes transfer at most once.
+
+    def export_blocks(self, token_ids: Sequence[int]) -> KVExport:
+        """Pin and return the cached block-chain covering ``token_ids``.
+
+        ``token_ids`` is the fed-token sequence a request wrote (prompt
+        plus generated) — the same sequence ``register_upto`` published.
+        Every full block whose chain key is published gets refcount++
+        (revived off the LRU if retired), so the bytes cannot be recycled
+        while the export is live. The chain stops at the first
+        unpublished key; a short prompt (< one full block) exports empty.
+        Overlapping exports of the same blocks are fine — pins nest via
+        the refcount. Call on the engine thread (``call_in_loop``); read
+        ``cache`` wherever; release on the engine thread again."""
+        if self.prefix is None:
+            raise ValueError("export_blocks requires prefix_cache=True "
+                             "(chain keys are the transfer addresses)")
+        full = len(token_ids) // self.block_size
+        keys: List[bytes] = []
+        blocks: List[int] = []
+        for key in chain_keys(token_ids[:full * self.block_size],
+                              self.block_size):
+            b = self.prefix.lookup(key)
+            if b is None:
+                break
+            keys.append(key)
+            blocks.append(b)
+        for b in blocks:
+            if self._ref[b] == 0:
+                self.prefix.revive(b)
+            self._ref[b] += 1
+        self._note_free_level()
+        return KVExport(keys=keys, blocks=blocks,
+                        cache=[dict(layer) for layer in self.cache])
+
+    def release_export(self, export: KVExport) -> None:
+        """Unpin an export's blocks (refcount--; zero retires registered
+        blocks to the prefix LRU). Exactly once per export — a double
+        release would corrupt refcounts, so it raises instead."""
+        if export.released:
+            raise ValueError("KV export already released (double release "
+                             "would double-decrement block refcounts)")
+        for b in export.blocks:
+            if self._ref[b] <= 0:
+                raise RuntimeError(
+                    f"refcount invariant violated: exported block {b} has "
+                    f"refcount {self._ref[b]} at release")
+        export.released = True
+        export.cache = []
+        for b in export.blocks:
+            self._release_block(b)
+
+    def adopt_blocks(self, keys: Sequence[bytes],
+                     blocks_data: Sequence[Sequence[Dict[str, "object"]]],
+                     ) -> Dict[str, int]:
+        """Install transferred KV blocks into this arena under their chain
+        keys — the receiving half of the handoff.
+
+        ``keys[i]`` is the chain key of block ``i``; ``blocks_data[i]`` is
+        its payload, a per-layer list of ``{name: ndarray[block_size, Hkv,
+        Dh]}`` dicts whose names/shapes/dtypes must match this arena's
+        layout exactly (fp or int8 quartet — a mismatch raises, nothing is
+        mutated). Keys must arrive in chain order.
+
+        A key already published here is skipped (``reused`` — that block
+        transferred at most once, ever). Fresh keys take a free block,
+        write the bytes, register, and retire to the prefix LRU: refcount
+        0, adoptable by the next ``allocate(token_ids=...)`` and evictable
+        under pressure like any cached block — which is exactly what makes
+        adopt-after-evict safe: a re-transfer simply re-installs. Runs out
+        of arena space → stops at a chain prefix (``skipped`` counts the
+        rest). Engine-thread only."""
+        import numpy as np
+
+        if self.prefix is None:
+            raise ValueError("adopt_blocks requires prefix_cache=True")
+        if len(keys) != len(blocks_data):
+            raise ValueError(f"{len(keys)} keys but {len(blocks_data)} "
+                             "block payloads")
+        layout = [{name: (tuple(arr.shape[1:]), np.dtype(arr.dtype))
+                   for name, arr in layer.items()} for layer in self.cache]
+        for i, data in enumerate(blocks_data):
+            if len(data) != len(layout):
+                raise ValueError(f"block {i}: {len(data)} layers, arena "
+                                 f"has {len(layout)}")
+            for li, layer in enumerate(data):
+                if set(layer) != set(layout[li]):
+                    raise ValueError(
+                        f"block {i} layer {li}: names {sorted(layer)} != "
+                        f"arena {sorted(layout[li])} (fp/int8 mismatch?)")
+                for name, arr in layer.items():
+                    want_shape, want_dtype = layout[li][name]
+                    got = np.asarray(arr)
+                    if tuple(got.shape) != want_shape \
+                            or np.dtype(got.dtype) != want_dtype:
+                        raise ValueError(
+                            f"block {i} layer {li} '{name}': "
+                            f"{got.shape}/{got.dtype} != arena "
+                            f"{want_shape}/{want_dtype}")
+        reused = adopted = 0
+        staged: List[int] = []   # fresh blocks, pinned until bytes land
+        staged_data: List[Sequence[Dict[str, "object"]]] = []
+        for key, data in zip(keys, blocks_data):
+            if self.prefix.lookup(key) is not None:
+                reused += 1
+                continue
+            b = self._take_block()
+            if b is None:
+                break  # arena full of live data; keep the chain prefix
+            if self._ref[b] != 0:
+                raise RuntimeError(
+                    f"refcount invariant violated: free block {b} has "
+                    f"refcount {self._ref[b]}")
+            # Pin while staging so a later _take_block in THIS loop can
+            # never evict a block we just adopted (chain stays contiguous).
+            self._ref[b] = 1
+            self.prefix.register(key, b)
+            staged.append(b)
+            staged_data.append(data)
+            adopted += 1
+        if staged:
+            self._write_blocks(staged, staged_data)
+        for b in staged:
+            self._release_block(b)  # refcount 0 -> retires to the LRU
+        self._note_free_level()
+        return {"adopted": adopted, "reused": reused,
+                "skipped": len(keys) - adopted - reused}
+
+    def _write_blocks(self, block_ids: Sequence[int], blocks_data) -> None:
+        """Scatter transferred bytes into the arena: one batched
+        ``.at[ids].set`` per layer tensor (a single device write each, not
+        one per block)."""
+        import numpy as np
+
+        idx = np.asarray(block_ids, dtype=np.int32)
+        new_cache = []
+        for li, layer in enumerate(self.cache):
+            new_layer = {}
+            for name, arr in layer.items():
+                stack = np.stack([np.asarray(d[li][name])
+                                  for d in blocks_data])
+                new_layer[name] = arr.at[idx].set(stack)
+            new_cache.append(new_layer)
+        self.cache = new_cache

@@ -1,0 +1,168 @@
+"""KV-block transfer between serving replicas (disaggregated prefill).
+
+The prefill→decode handoff ships the KV bytes a prefill replica computed
+into a decode replica's paged arena, addressed by the prefix cache's
+content-hash chain keys (prefix_cache.chain_keys). Because the addresses
+are content hashes, the transfer composes with prefix caching for free:
+a block the receiver already holds — from an earlier request sharing the
+prompt prefix, or from an earlier transfer — is skipped, so shared
+prefixes cross the wire at most once.
+
+Wire format (``GKV1``, little-endian)::
+
+    b"GKV1" | u32 header_len | header JSON | block bytes...
+
+The JSON header carries ``block_size``, ``quantized``, the covered
+``token_ids``, the hex chain ``keys``, and the per-layer tensor layout
+``{name: {shape, dtype}}`` (fp ``k/v`` pair or int8 ``k_q/k_s/v_q/v_s``
+quartet — the receiver's arena must match exactly). Block bytes follow
+in chain order, per block per layer per sorted tensor name, C-contiguous
+raw buffers. The receiver recomputes the chain keys from ``token_ids``
+and refuses a payload whose keys disagree — a corrupt or misaddressed
+transfer can never poison the prefix cache.
+
+This module is transport + (de)serialization only; arena bookkeeping
+lives in ``kv_pool.PagedKVPool.export_blocks/adopt_blocks`` and the
+engine-thread choreography in ``BatchEngine.export_kv/adopt_kv``.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+from urllib.request import Request, urlopen
+
+import numpy as np
+
+from ..obs.trace import TRACE_HEADER
+from .prefix_cache import chain_keys
+
+__all__ = ["KVTransferPayload", "build_payload", "push_payload"]
+
+MAGIC = b"GKV1"
+
+
+@dataclass
+class KVTransferPayload:
+    """One request's exportable KV blocks, in chain order."""
+
+    token_ids: List[int]           # exactly the tokens the blocks cover
+    block_size: int
+    quantized: bool
+    keys: List[bytes]              # chain keys, one per block
+    # blocks[i][layer] = {tensor name: ndarray[block_size, Hkv, Dh]}
+    blocks: List[List[Dict[str, np.ndarray]]] = field(repr=False,
+                                                      default_factory=list)
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self.keys)
+
+    def nbytes(self) -> int:
+        return sum(arr.nbytes for blk in self.blocks
+                   for layer in blk for arr in layer.values())
+
+    def verify_keys(self) -> None:
+        """Recompute the chain from ``token_ids`` and compare — the
+        receiver's integrity gate (content addresses must be earned)."""
+        want = chain_keys(self.token_ids[:self.num_blocks * self.block_size],
+                          self.block_size)
+        if list(self.keys) != want:
+            raise ValueError(
+                "KV transfer keys do not match the chain recomputed from "
+                "token_ids (corrupt or misaddressed payload)")
+
+    def to_bytes(self) -> bytes:
+        if self.blocks and len(self.blocks) != len(self.keys):
+            raise ValueError(f"{len(self.keys)} keys but "
+                             f"{len(self.blocks)} blocks")
+        layers = []
+        if self.blocks:
+            layers = [{name: {"shape": list(arr.shape),
+                              "dtype": np.dtype(arr.dtype).name}
+                       for name, arr in layer.items()}
+                      for layer in self.blocks[0]]
+        header = json.dumps({
+            "block_size": self.block_size,
+            "quantized": bool(self.quantized),
+            "token_ids": [int(t) for t in self.token_ids],
+            "keys": [k.hex() for k in self.keys],
+            "layers": layers,
+        }).encode()
+        parts = [MAGIC, struct.pack("<I", len(header)), header]
+        for blk in self.blocks:
+            for layer in blk:
+                for name in sorted(layer):
+                    parts.append(np.ascontiguousarray(layer[name]).tobytes())
+        return b"".join(parts)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "KVTransferPayload":
+        if data[:4] != MAGIC:
+            raise ValueError(f"bad KV transfer magic {data[:4]!r}")
+        (hlen,) = struct.unpack_from("<I", data, 4)
+        header = json.loads(data[8:8 + hlen].decode())
+        keys = [bytes.fromhex(k) for k in header["keys"]]
+        layers = header["layers"]
+        blocks: List[List[Dict[str, np.ndarray]]] = []
+        off = 8 + hlen
+        for _ in keys:
+            blk = []
+            for layer in layers:
+                tensors = {}
+                for name in sorted(layer):
+                    shape = tuple(layer[name]["shape"])
+                    dtype = np.dtype(layer[name]["dtype"])
+                    n = int(np.prod(shape)) * dtype.itemsize
+                    tensors[name] = np.frombuffer(
+                        data[off:off + n], dtype=dtype).reshape(shape)
+                    off += n
+                blk.append(tensors)
+            blocks.append(blk)
+        if off != len(data):
+            raise ValueError(f"KV transfer payload has {len(data) - off} "
+                             "trailing bytes")
+        out = cls(token_ids=[int(t) for t in header["token_ids"]],
+                  block_size=int(header["block_size"]),
+                  quantized=bool(header["quantized"]),
+                  keys=keys, blocks=blocks)
+        out.verify_keys()
+        return out
+
+
+def build_payload(export, token_ids: Sequence[int], block_size: int,
+                  quantized: bool) -> KVTransferPayload:
+    """Materialize a ``kv_pool.KVExport`` as a wire payload: one batched
+    gather + host fetch per layer tensor (not one per block). Safe off the
+    engine thread — the export's ``cache`` snapshot is immutable."""
+    covered = len(export.keys) * block_size
+    blocks: List[List[Dict[str, np.ndarray]]] = [
+        [] for _ in range(len(export.blocks))]
+    if export.blocks:
+        idx = np.asarray(export.blocks, dtype=np.int32)
+        for layer in export.cache:
+            fetched = {name: np.asarray(arr[idx])
+                       for name, arr in layer.items()}
+            for i in range(len(export.blocks)):
+                blocks[i].append({name: fetched[name][i]
+                                  for name in fetched})
+    return KVTransferPayload(
+        token_ids=[int(t) for t in token_ids[:covered]],
+        block_size=block_size, quantized=quantized,
+        keys=list(export.keys), blocks=blocks)
+
+
+def push_payload(url: str, payload: KVTransferPayload,
+                 timeout: float = 30.0,
+                 trace_id: Optional[str] = None) -> Dict[str, int]:
+    """POST a payload to a decode replica's ``/adopt_kv``; returns its
+    adopt stats (``{"adopted": n, "reused": n, "skipped": n}``)."""
+    headers = {"Content-Type": "application/octet-stream"}
+    if trace_id:
+        headers[TRACE_HEADER] = trace_id
+    req = Request(url.rstrip("/") + "/adopt_kv", data=payload.to_bytes(),
+                  headers=headers, method="POST")
+    with urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())

@@ -143,6 +143,13 @@ class PrefixCache:
     def key_of(self, block: int) -> Optional[bytes]:
         return self._key_of.get(block)
 
+    def lookup(self, key: bytes) -> Optional[int]:
+        """Physical block published under ``key`` (None if unpublished).
+        Pure lookup — no LRU touch, no counters; the KV-transfer export
+        path uses it to resolve a chain without perturbing eviction
+        order."""
+        return self._by_key.get(key)
+
     # -- refcount-edge notifications (called by the pool) --------------------
     def retire(self, block: int) -> bool:
         """Refcount hit 0: park a registered block on the LRU list (True)

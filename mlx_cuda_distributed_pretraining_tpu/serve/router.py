@@ -78,13 +78,25 @@ class _Ring:
 class Replica:
     """Router-side view of one engine replica (no model state here)."""
 
-    def __init__(self, rid: str, url: str):
+    def __init__(self, rid: str, url: str, role: str = "any"):
         self.id = rid
         self.url = url.rstrip("/")
+        self.role = role          # fleet pool: "prefill" | "decode" | "any"
         self.up = True            # optimistic until a probe/dispatch fails
+        self.stale = False        # /metrics scrape slow; stats are old but
+        #                           the replica is NOT dead (keep routing)
+        self.scrape_timeouts = 0  # consecutive slow scrapes
+        self.draining = False     # finishing in-flight, admitting nothing
+        self.canary = False       # freshly swapped weights, gated traffic
         self.queue_depth = 0
         self.occupancy = 0
         self.inflight = 0         # router-side: requests currently forwarded
+        self.kv_blocks_free: Optional[int] = None
+        self.kv_num_blocks: Optional[int] = None
+        self.kv_free_watermark: Optional[int] = None
+        self.params_version = 0
+        self.ok_count = 0         # responses fully delivered through us
+        self.err_count = 0        # dead / broken-stream / http-error
         self.last_error: Optional[str] = None
 
     @property
@@ -92,11 +104,40 @@ class Replica:
         """Dispatch-ordering load: replica queue + what we just sent it."""
         return self.queue_depth + self.inflight
 
+    @property
+    def state(self) -> str:
+        if not self.up:
+            return "down"
+        if self.draining:
+            return "draining"
+        if self.canary:
+            return "canary"
+        if self.stale:
+            return "stale"
+        return "active"
+
     def snapshot(self) -> Dict[str, object]:
-        return {"url": self.url, "up": self.up,
+        return {"url": self.url, "up": self.up, "role": self.role,
+                "state": self.state,
                 "queue_depth": self.queue_depth, "inflight": self.inflight,
                 "occupancy": self.occupancy,
+                "params_version": self.params_version,
+                "ok": self.ok_count, "err": self.err_count,
+                **({"kv_blocks_free": self.kv_blocks_free}
+                   if self.kv_blocks_free is not None else {}),
                 **({"last_error": self.last_error} if self.last_error else {})}
+
+
+def _is_scrape_timeout(e: BaseException) -> bool:
+    """A SLOW replica, not a dead one: socket timeouts (directly, or
+    wrapped in URLError) mean the TCP connection worked but the reply
+    was late — routing must keep going on last-known stats. Refused /
+    reset connections are actual death."""
+    if isinstance(e, TimeoutError):  # socket.timeout is an alias (3.10+)
+        return True
+    if isinstance(e, urllib.error.URLError):
+        return isinstance(e.reason, TimeoutError)
+    return False
 
 
 class Router:
@@ -105,6 +146,9 @@ class Router:
                  vnodes: int = 64, spill_depth: int = 8,
                  poll_interval_s: float = 0.5, retries: int = 1,
                  request_timeout_s: float = 600.0,
+                 scrape_timeout_s: float = 2.0,
+                 stale_down_after: int = 4,
+                 roles: Optional[List[str]] = None,
                  trace: bool = False, trace_sample: float = 1.0,
                  trace_capacity: int = 16384):
         if not replica_urls:
@@ -112,15 +156,27 @@ class Router:
         if affinity not in ("prefix", "none"):
             raise ValueError(f"unknown affinity {affinity!r} "
                              "(expected 'prefix' or 'none')")
+        roles = roles or ["any"] * len(replica_urls)
+        if len(roles) != len(replica_urls):
+            raise ValueError(f"{len(roles)} roles for "
+                             f"{len(replica_urls)} replicas")
         self.replicas: Dict[str, Replica] = {
-            f"r{i}": Replica(f"r{i}", u) for i, u in enumerate(replica_urls)}
+            f"r{i}": Replica(f"r{i}", u, role=role)
+            for i, (u, role) in enumerate(zip(replica_urls, roles))}
         self.affinity = affinity
         self.block_size = block_size
         self.spill_depth = spill_depth
         self.poll_interval_s = poll_interval_s
         self.retries = max(0, retries)
         self.request_timeout_s = request_timeout_s
+        self.scrape_timeout_s = scrape_timeout_s
+        # Consecutive slow scrapes tolerated before a stale replica is
+        # finally declared down (it stopped proving liveness entirely).
+        self.stale_down_after = max(1, stale_down_after)
+        self._vnodes = vnodes
         self._ring = _Ring(sorted(self.replicas), vnodes=vnodes)
+        self._published = set(self.replicas)  # ids currently on the ring
+        self._next_rid = len(replica_urls)
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._poller: Optional[threading.Thread] = None
@@ -146,6 +202,18 @@ class Router:
         self._mg_inflight = reg.gauge(
             "serve_router_replica_inflight",
             "requests currently forwarded to the replica")
+        self._mg_stale = reg.gauge(
+            "serve_router_replica_stale",
+            "1 = last /metrics scrape timed out (routing on stale stats)")
+        # Per-pool fleet gauges: the autoscaler's spawn/drain inputs.
+        self._mg_pool_up = reg.gauge(
+            "serve_router_pool_replicas_up", "live replicas per pool")
+        self._mg_pool_depth = reg.gauge(
+            "serve_router_pool_queue_depth",
+            "summed admission-queue depth per pool")
+        self._mg_pool_kv_free = reg.gauge(
+            "serve_router_pool_kv_blocks_free",
+            "minimum free KV blocks across the pool's live replicas")
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "Router":
@@ -169,22 +237,106 @@ class Router:
 
     def poll_once(self) -> None:
         """Probe every replica's /metrics for queue depth (and liveness —
-        a down replica that answers again is revived here)."""
-        for r in self.replicas.values():
+        a down replica that answers again is revived here).
+
+        Failure taxonomy matters: a scrape that TIMES OUT reached a
+        replica that is merely slow (long prefill hogging the GIL, a
+        stats hiccup) — marking it down would dump its queue onto the
+        rest of the fleet and thrash the ring. Such a replica stays up
+        with ``stale=True`` (routing continues on last-known stats) and
+        is only declared down after ``stale_down_after`` consecutive
+        silent scrapes. Connection-level failures (refused, reset, DNS)
+        mean nobody is listening: down immediately."""
+        for r in list(self.replicas.values()):
             try:
-                with urllib.request.urlopen(r.url + "/metrics",
-                                            timeout=2.0) as resp:
+                with urllib.request.urlopen(
+                        r.url + "/metrics",
+                        timeout=self.scrape_timeout_s) as resp:
                     m = json.loads(resp.read())
                 r.queue_depth = int(m.get("queue_depth", 0))
                 r.occupancy = int(m.get("batch_occupancy", 0))
+                role = m.get("role")
+                if role and r.role == "any":
+                    r.role = str(role)  # replica self-reports its pool
+                r.draining = bool(m.get("draining", False))
+                r.params_version = int(m.get("params_version", 0))
+                if "kv_blocks_free" in m:
+                    r.kv_blocks_free = int(m["kv_blocks_free"])
+                if "kv_num_blocks" in m:
+                    r.kv_num_blocks = int(m["kv_num_blocks"])
+                if "kv_free_watermark" in m:
+                    r.kv_free_watermark = int(m["kv_free_watermark"])
                 r.up = True
+                r.stale = False
+                r.scrape_timeouts = 0
                 r.last_error = None
-            except Exception as e:  # noqa: BLE001 - any failure = down
-                r.up = False
-                r.last_error = f"{type(e).__name__}: {e}"
+            except Exception as e:  # noqa: BLE001 - classified below
+                if _is_scrape_timeout(e):
+                    r.scrape_timeouts += 1
+                    r.stale = True
+                    r.last_error = f"stale: {type(e).__name__}: {e}"
+                    if r.scrape_timeouts >= self.stale_down_after:
+                        r.up = False  # silent too long: stop routing to it
+                else:
+                    r.up = False
+                    r.stale = False
+                    r.scrape_timeouts = 0
+                    r.last_error = f"{type(e).__name__}: {e}"
             self._mg_up.set(1.0 if r.up else 0.0, replica=r.id)
+            self._mg_stale.set(1.0 if r.stale else 0.0, replica=r.id)
             self._mg_depth.set(r.queue_depth, replica=r.id)
             self._mg_inflight.set(r.inflight, replica=r.id)
+        self._refresh_ring()
+        self._publish_pool_gauges()
+
+    def _publish_pool_gauges(self) -> None:
+        pools: Dict[str, List[Replica]] = {}
+        for r in self.replicas.values():
+            pools.setdefault(r.role, []).append(r)
+        for pool, rs in pools.items():
+            live = [r for r in rs if r.up and not r.draining]
+            self._mg_pool_up.set(len(live), pool=pool)
+            self._mg_pool_depth.set(sum(r.queue_depth for r in live),
+                                    pool=pool)
+            kv = [r.kv_blocks_free for r in live
+                  if r.kv_blocks_free is not None]
+            if kv:
+                self._mg_pool_kv_free.set(min(kv), pool=pool)
+
+    # -- membership ----------------------------------------------------------
+    def _refresh_ring(self) -> None:
+        """Rebuild the consistent-hash ring when the PUBLISHABLE set (up,
+        not draining) changed — drain unpublishes a replica so new keys
+        remap (~1/N of the space) while it finishes in-flight work."""
+        want = {rid for rid, r in self.replicas.items()
+                if r.up and not r.draining}
+        with self._lock:
+            if want != self._published:
+                self._published = want
+                self._ring = _Ring(sorted(want), vnodes=self._vnodes)
+
+    def add_replica(self, url: str, role: str = "any") -> Replica:
+        """Scale-up: register a freshly spawned replica and publish it."""
+        with self._lock:
+            rid = f"r{self._next_rid}"
+            self._next_rid += 1
+            r = Replica(rid, url, role=role)
+            self.replicas[rid] = r
+        self._refresh_ring()
+        return r
+
+    def remove_replica(self, rid: str) -> None:
+        """Scale-down terminal step (after drain): forget the replica."""
+        with self._lock:
+            self.replicas.pop(rid, None)
+        self._refresh_ring()
+
+    def set_draining(self, rid: str, draining: bool = True) -> None:
+        self.replicas[rid].draining = draining
+        self._refresh_ring()
+
+    def set_canary(self, rid: str, canary: bool = True) -> None:
+        self.replicas[rid].canary = canary
 
     # -- routing -------------------------------------------------------------
     def routing_key(self, body: dict) -> Optional[bytes]:
@@ -209,18 +361,23 @@ class Router:
             return head  # short prompt: raw bytes still give a stable key
         return chain_keys(head, self.block_size)[0]
 
-    def candidates(self, key: Optional[bytes]) -> List[Replica]:
+    def candidates(self, key: Optional[bytes],
+                   role: Optional[str] = None) -> List[Replica]:
         """Dispatch order: the affinity target first (unless saturated),
-        then every other live replica by ascending load."""
+        then every other live replica by ascending load. Draining
+        replicas admit nothing. With ``role``, only that pool's replicas
+        (plus role-"any" ones) qualify."""
         with self._lock:
-            alive = [r for r in self.replicas.values() if r.up]
+            alive = [r for r in self.replicas.values()
+                     if r.up and not r.draining
+                     and (role is None or r.role in (role, "any"))]
             if not alive:
                 return []
             order = sorted(alive, key=lambda r: (r.load, r.id))
             primary = self._ring.lookup(key) if key is not None else None
-            if primary is not None:
+            if primary is not None and primary in self.replicas:
                 p = self.replicas[primary]
-                if p.up and p.queue_depth < self.spill_depth:
+                if p in order and p.queue_depth < self.spill_depth:
                     order.remove(p)
                     order.insert(0, p)
             return order
@@ -234,8 +391,13 @@ class Router:
         (idempotent: sampling is seeded); replica 429s propagate after
         every candidate rejected. ``trace_id`` (minted here when absent)
         rides the X-Trace-Id header so replica spans join this trace."""
-        key = self.routing_key(body)
-        cands = self.candidates(key)
+        return self._dispatch_to(self.candidates(self.routing_key(body)),
+                                 path, body, trace_id)
+
+    def _dispatch_to(self, cands: List[Replica], path: str, body: dict,
+                     trace_id: Optional[str] = None):
+        """Try an ordered candidate list (the shared retry/backpressure
+        machinery under both homogeneous and fleet dispatch)."""
         if not cands:
             raise NoReplicaError("no live replica")
         if trace_id is None:
@@ -261,12 +423,14 @@ class Router:
                     self._mc_requests.inc(replica=r.id, outcome="saturated")
                     continue
                 self._mc_requests.inc(replica=r.id, outcome="http_error")
+                r.err_count += 1
                 raise
             except Exception as e:  # noqa: BLE001 - connection-level death
                 r.up = False
                 r.last_error = f"{type(e).__name__}: {e}"
                 self._mg_up.set(0.0, replica=r.id)
                 self._mc_requests.inc(replica=r.id, outcome="dead")
+                r.err_count += 1
                 self._mc_retries.inc()
                 continue
         if saturated is not None:
@@ -408,10 +572,12 @@ def make_router_handler(router: Router):
                         self.wfile.write(chunk)
                         self.wfile.flush()
                 router._mc_requests.inc(replica=replica.id, outcome="ok")
+                replica.ok_count += 1
             except Exception:  # noqa: BLE001 - replica died mid-stream
                 # Bytes already left for the client: cannot retry (the
                 # request would double-bill tokens); surface the break.
                 replica.up = False
+                replica.err_count += 1
                 router._mc_requests.inc(replica=replica.id,
                                         outcome="broken_stream")
                 raise

@@ -48,10 +48,14 @@ class Request:
     def __init__(self, prompt_ids: List[int], max_tokens: int,
                  temperature: float = 0.0, seed: int = 0,
                  deadline_s: Optional[float] = None,
-                 stop_ids: Optional[List[int]] = None):
+                 stop_ids: Optional[List[int]] = None,
+                 prefill_only: bool = False):
         self.id = next(Request._ids)
         self.prompt_ids = list(prompt_ids)
         self.max_tokens = int(max_tokens)
+        # Disaggregated handoff: finish once the prompt KV is written and
+        # published — never sample (the decode replica does).
+        self.prefill_only = bool(prefill_only)
         self.temperature = float(temperature)
         self.seed = int(seed)
         self.stop_ids = set(stop_ids or ())

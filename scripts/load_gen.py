@@ -25,6 +25,17 @@ reports ``prefix_cached_tokens`` per request) and adds the aggregate
 ``cache_hit_rate``; against the router (serve/router.py) each group is
 consistently hashed to one replica, so hits land where the blocks live.
 
+Mixed flood (``--mix prefill-heavy:decode-heavy``): interleaves traffic
+classes with opposite resource profiles — ``prefill-heavy`` sends a long
+unique prompt and asks for a few tokens (compute-bound, the disaggregated
+fleet's prefill-pool diet), ``decode-heavy`` a short prompt with a long
+generation (bandwidth-bound; its TTFT is what prefill interference
+destroys on a homogeneous replica). Class weights repeat via ``*N``
+(``prefill-heavy*2:decode-heavy``); shapes via ``--mix-*`` flags. The
+summary gains per-class TTFT and TPOT (per-output-token decode latency)
+p50/p95/p99 — the ``serve_fleet`` bench case reads exactly these to
+score a prefill/decode fleet against a homogeneous baseline.
+
 Per-request tracing (``--trace-out FILE``): writes one CSV row per
 request with the server-minted trace id and the server-side TTFT
 breakdown (queue_ms / prefill_ms / decode_ms) that the batch engine
@@ -46,7 +57,38 @@ import urllib.request
 
 TRACE_FIELDS = ("trace_id", "status", "latency_s", "ttft_ms", "queue_ms",
                 "prefill_ms", "decode_ms", "tokens", "prompt_tokens",
-                "cached_tokens")
+                "cached_tokens", "cls")
+
+# --mix class shapes: (prompt tokens, generated tokens). ~1 token/char
+# under the byte-fallback tokenizer; prompts are unique per request (the
+# request id leads) so prefill work is real, not a prefix-cache hit.
+MIX_SHAPES = {
+    "prefill-heavy": (512, 8),
+    "decode-heavy": (16, 128),
+}
+
+
+def parse_mix(spec: str) -> list:
+    """``a:b*2:c`` -> ["a", "b", "b", "c"] (the round-robin schedule)."""
+    classes = []
+    for part in spec.split(":"):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, weight = part.partition("*")
+        classes.extend([name] * max(1, int(weight or 1)))
+    if not classes:
+        raise ValueError(f"empty --mix spec {spec!r}")
+    return classes
+
+
+def class_prompt(cls: str, i: int, tokens: int) -> str:
+    """Unique ~``tokens``-token prompt for request ``i`` of class
+    ``cls``: the id comes FIRST so no two prompts share a KV block —
+    prefill cost is genuine, not amortized by the prefix cache."""
+    stem = f"[{cls} {i}] measure the fleet under mixed load; "
+    reps = -(-tokens // len(stem))
+    return (stem * reps)[:tokens]
 
 
 def _one_request(url: str, body: dict, timeout: float) -> dict:
@@ -94,10 +136,14 @@ def group_prefix(group: int, tokens: int) -> str:
 def run_load(url: str, concurrency: int, requests: int, prompt: str,
              max_tokens: int, temperature: float, deadline_s: float | None,
              timeout: float, shared_prefix_tokens: int = 0,
-             prefix_groups: int = 1, trace_out: str | None = None) -> dict:
+             prefix_groups: int = 1, trace_out: str | None = None,
+             mix: str | None = None,
+             mix_shapes: dict | None = None) -> dict:
     results: list = []
     lock = threading.Lock()
     counter = iter(range(requests))
+    schedule = parse_mix(mix) if mix else None
+    shapes = {**MIX_SHAPES, **(mix_shapes or {})}
 
     def worker():
         while True:
@@ -105,15 +151,27 @@ def run_load(url: str, concurrency: int, requests: int, prompt: str,
                 i = next(counter, None)
             if i is None:
                 return
-            head = (group_prefix(i % max(prefix_groups, 1),
-                                 shared_prefix_tokens)
-                    if shared_prefix_tokens > 0 else "")
-            body = {"prompt": f"{head}{prompt} [{i}]",
-                    "max_tokens": max_tokens,
-                    "temperature": temperature, "seed": i}
+            cls = None
+            if schedule is not None:
+                cls = schedule[i % len(schedule)]
+                if cls not in shapes:
+                    raise ValueError(f"unknown --mix class {cls!r} "
+                                     f"(known: {sorted(shapes)})")
+                p_toks, g_toks = shapes[cls]
+                body = {"prompt": class_prompt(cls, i, p_toks),
+                        "max_tokens": g_toks,
+                        "temperature": temperature, "seed": i}
+            else:
+                head = (group_prefix(i % max(prefix_groups, 1),
+                                     shared_prefix_tokens)
+                        if shared_prefix_tokens > 0 else "")
+                body = {"prompt": f"{head}{prompt} [{i}]",
+                        "max_tokens": max_tokens,
+                        "temperature": temperature, "seed": i}
             if deadline_s is not None:
                 body["deadline_s"] = deadline_s
             r = _one_request(url, body, timeout)
+            r["cls"] = cls
             with lock:
                 results.append(r)
 
@@ -182,6 +240,34 @@ def run_load(url: str, concurrency: int, requests: int, prompt: str,
             "ttft_miss_p50_s": pct(miss_t, 0.50),
             "ttft_miss_p95_s": pct(miss_t, 0.95),
         })
+    if schedule is not None:
+        # Per-class TTFT/TPOT tails: decode-heavy TTFT p99 is THE number
+        # disaggregation exists to protect (prefill interference lands
+        # there first); prefill-heavy TTFT tracks prompt-pass throughput.
+        def tpot(r) -> float | None:
+            if r["tokens"] <= 0:
+                return None
+            if r["ttft_s"] is not None:
+                return (r["latency_s"] - r["ttft_s"]) / max(r["tokens"] - 1,
+                                                            1)
+            return r["latency_s"] / max(r["tokens"], 1)
+
+        per_class = {}
+        for cls in dict.fromkeys(schedule):
+            rs = [r for r in results if r["cls"] == cls]
+            ok_c = [r for r in rs if r["status"] == 200]
+            t = sorted(r["ttft_s"] for r in ok_c if r["ttft_s"] is not None)
+            d = sorted(v for v in (tpot(r) for r in ok_c) if v is not None)
+            p_toks, g_toks = shapes[cls]
+            per_class[cls] = {
+                "requests": len(rs), "ok": len(ok_c),
+                "prompt_tokens": p_toks, "gen_tokens": g_toks,
+                "ttft_p50_s": pct(t, 0.50), "ttft_p95_s": pct(t, 0.95),
+                "ttft_p99_s": pct(t, 0.99),
+                "tpot_p50_s": pct(d, 0.50, 5), "tpot_p95_s": pct(d, 0.95, 5),
+                "tpot_p99_s": pct(d, 0.99, 5),
+            }
+        summary["mix"] = per_class
     if trace_out:
         # One row per request, in completion order. ttft_ms mirrors the
         # server value; queue/prefill/decode are the server's own
@@ -196,7 +282,8 @@ def run_load(url: str, concurrency: int, requests: int, prompt: str,
                 row["latency_s"] = round(r["latency_s"], 4)
                 row["ttft_ms"] = (round(r["ttft_s"] * 1e3, 2)
                                   if r["ttft_s"] is not None else "")
-                for k in ("trace_id", "queue_ms", "prefill_ms", "decode_ms"):
+                for k in ("trace_id", "queue_ms", "prefill_ms", "decode_ms",
+                          "cls"):
                     if row.get(k) is None:
                         row[k] = ""
                 w.writerow(row)
@@ -234,11 +321,30 @@ def main(argv=None) -> int:
     p.add_argument("--trace-out", default=None, metavar="FILE",
                    help="write a per-request CSV (trace_id + server-side "
                         "queue/prefill/decode breakdown) to FILE")
+    p.add_argument("--mix", default=None, metavar="SPEC",
+                   help="mixed flood: colon-separated traffic classes "
+                        "round-robined across requests, e.g. "
+                        "'prefill-heavy:decode-heavy' (weights via *N); "
+                        "overrides --prompt/--max-tokens and reports "
+                        "per-class TTFT/TPOT p50/p95/p99")
+    p.add_argument("--mix-prefill-prompt", type=int, default=512,
+                   help="prefill-heavy class: ~prompt tokens per request")
+    p.add_argument("--mix-prefill-gen", type=int, default=8,
+                   help="prefill-heavy class: generated tokens per request")
+    p.add_argument("--mix-decode-prompt", type=int, default=16,
+                   help="decode-heavy class: ~prompt tokens per request")
+    p.add_argument("--mix-decode-gen", type=int, default=128,
+                   help="decode-heavy class: generated tokens per request")
     a = p.parse_args(argv)
     summary = run_load(a.url, a.concurrency, a.requests, a.prompt,
                        a.max_tokens, a.temperature, a.deadline_s, a.timeout,
                        shared_prefix_tokens=a.shared_prefix_tokens,
-                       prefix_groups=a.prefix_groups, trace_out=a.trace_out)
+                       prefix_groups=a.prefix_groups, trace_out=a.trace_out,
+                       mix=a.mix, mix_shapes={
+                           "prefill-heavy": (a.mix_prefill_prompt,
+                                             a.mix_prefill_gen),
+                           "decode-heavy": (a.mix_decode_prompt,
+                                            a.mix_decode_gen)})
     print(json.dumps(summary))
     return 0
 

@@ -6,13 +6,96 @@
 # stack can be torn down with `kill $(cat "$OUT"/*.pid)`.
 #
 # Usage: scripts/serve_stack.sh <run-name> [replicas] [runs_root] [base_port]
+#        scripts/serve_stack.sh --fleet <run-name> [P] [M] [runs_root] [base_port]
 #
 #   scripts/serve_stack.sh myrun 2
 #   python scripts/load_gen.py --url http://127.0.0.1:8500 \
 #       --shared-prefix-tokens 64 --prefix-groups 4
+#
+# --fleet launches a DISAGGREGATED fleet instead: P prefill replicas +
+# M decode replicas (each registering a heartbeat under the fleet
+# membership dir) behind the fleet router (serve/fleet.py), which hands
+# long prompts to the prefill pool, ships the KV chain to the chosen
+# decode replica, and dispatches the request there:
+#
+#   scripts/serve_stack.sh --fleet myrun 1 1
+#   python scripts/load_gen.py --url http://127.0.0.1:8500 \
+#       --mix prefill-heavy:decode-heavy
 set -euo pipefail
 
-RUN="${1:?usage: serve_stack.sh <run-name> [replicas] [runs_root] [base_port]}"
+FLEET=0
+if [ "${1:-}" = "--fleet" ]; then
+  FLEET=1
+  shift
+fi
+RUN="${1:?usage: serve_stack.sh [--fleet] <run-name> [replicas...] [runs_root] [base_port]}"
+
+start_replica() { # index port role fleet_dir -> background server
+  local i="$1" port="$2" role="$3" fleet_dir="$4"
+  local log="$OUT/replica-$i.log"
+  local extra=()
+  if [ -n "$fleet_dir" ]; then
+    extra=(--role "$role" --fleet-dir "$fleet_dir" --fleet-index "$i")
+  fi
+  nohup python -m mlx_cuda_distributed_pretraining_tpu.infer.server \
+    --run "$RUN" --runs-root "$RUNS_ROOT" --engine batch \
+    --port "$port" "${extra[@]}" >"$log" 2>&1 &
+  echo $! > "$OUT/replica-$i.pid"
+  echo "replica $i: role=$role pid=$(cat "$OUT/replica-$i.pid") port=$port log=$log"
+}
+
+wait_health() { # port [tries]
+  local port="$1" tries="${2:-120}"
+  for _ in $(seq 1 "$tries"); do
+    curl -sf "http://127.0.0.1:$port/healthz" >/dev/null 2>&1 && return 0
+    sleep 1
+  done
+  return 1
+}
+
+if [ "$FLEET" = "1" ]; then
+  P="${2:-1}"                 # prefill replicas
+  M="${3:-1}"                 # decode replicas
+  RUNS_ROOT="${4:-runs}"
+  BASE_PORT="${5:-8451}"
+  ROUTER_PORT="${6:-8500}"
+  OUT="$RUNS_ROOT/$RUN.serve-stack"
+  FLEET_DIR="$OUT/fleet"
+  mkdir -p "$OUT" "$FLEET_DIR"
+
+  PRE_URLS=""; DEC_URLS=""
+  for i in $(seq 0 $((P + M - 1))); do
+    PORT=$((BASE_PORT + i))
+    if [ "$i" -lt "$P" ]; then ROLE=prefill; else ROLE=decode; fi
+    start_replica "$i" "$PORT" "$ROLE" "$FLEET_DIR"
+    if [ "$ROLE" = prefill ]; then
+      PRE_URLS="$PRE_URLS${PRE_URLS:+,}http://127.0.0.1:$PORT"
+    else
+      DEC_URLS="$DEC_URLS${DEC_URLS:+,}http://127.0.0.1:$PORT"
+    fi
+  done
+  for i in $(seq 0 $((P + M - 1))); do
+    wait_health $((BASE_PORT + i))
+  done
+
+  nohup python -m mlx_cuda_distributed_pretraining_tpu.serve.fleet \
+    --prefill "$PRE_URLS" --decode "$DEC_URLS" --fleet-dir "$FLEET_DIR" \
+    --port "$ROUTER_PORT" >"$OUT/router.log" 2>&1 &
+  echo $! > "$OUT/router.pid"
+  echo "fleet router: pid=$(cat "$OUT/router.pid") port=$ROUTER_PORT" \
+       "prefill=$PRE_URLS decode=$DEC_URLS"
+  wait_health "$ROUTER_PORT" 30
+
+  echo "smoke: one streamed request through the fleet (long prompt -> handoff)"
+  PROMPT=$(printf 'fleet smoke prompt %.0s' $(seq 1 8))
+  curl -sN "http://127.0.0.1:$ROUTER_PORT/generate" \
+    -H 'Content-Type: application/json' \
+    -d "{\"prompt\": \"$PROMPT\", \"max_tokens\": 8, \"stream\": true}"
+  echo
+  echo "stack up. tear down with: kill \$(cat $OUT/*.pid)"
+  exit 0
+fi
+
 N="${2:-2}"
 RUNS_ROOT="${3:-runs}"
 BASE_PORT="${4:-8451}"
@@ -23,33 +106,21 @@ mkdir -p "$OUT"
 URLS=""
 for i in $(seq 0 $((N - 1))); do
   PORT=$((BASE_PORT + i))
-  LOG="$OUT/replica-$i.log"
-  nohup python -m mlx_cuda_distributed_pretraining_tpu.infer.server \
-    --run "$RUN" --runs-root "$RUNS_ROOT" --engine batch \
-    --port "$PORT" >"$LOG" 2>&1 &
-  echo $! > "$OUT/replica-$i.pid"
+  start_replica "$i" "$PORT" any ""
   URLS="$URLS${URLS:+,}http://127.0.0.1:$PORT"
-  echo "replica $i: pid=$(cat "$OUT/replica-$i.pid") port=$PORT log=$LOG"
 done
 
 # Wait for every replica to answer /healthz (first request pays the jit
 # compile, so give them time).
 for i in $(seq 0 $((N - 1))); do
-  PORT=$((BASE_PORT + i))
-  for _ in $(seq 1 120); do
-    curl -sf "http://127.0.0.1:$PORT/healthz" >/dev/null 2>&1 && break
-    sleep 1
-  done
+  wait_health $((BASE_PORT + i))
 done
 
 nohup python -m mlx_cuda_distributed_pretraining_tpu.serve.router \
   --replicas "$URLS" --port "$ROUTER_PORT" >"$OUT/router.log" 2>&1 &
 echo $! > "$OUT/router.pid"
 echo "router: pid=$(cat "$OUT/router.pid") port=$ROUTER_PORT replicas=$URLS"
-for _ in $(seq 1 30); do
-  curl -sf "http://127.0.0.1:$ROUTER_PORT/healthz" >/dev/null 2>&1 && break
-  sleep 1
-done
+wait_health "$ROUTER_PORT" 30
 
 echo "smoke: one streamed request through the router"
 curl -sN "http://127.0.0.1:$ROUTER_PORT/generate" \

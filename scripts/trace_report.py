@@ -15,9 +15,12 @@ recorded on whichever replica served it:
         replica0_trace.json replica1_trace.json --top 3
 
 Prints, in ``key=value`` form:
-  * an accounting line — how many requests completed, and how many
+  * an accounting line — how many requests completed, how many
     ``route`` spans never matched a replica-side ``request`` span
-    (anything non-zero there means a replica dropped its ring or died);
+    (anything non-zero there means a replica dropped its ring or died),
+    and how many requests were disaggregated handoffs (a prefill
+    replica's and a decode replica's ``request`` spans joined under one
+    trace id, with the ``kv_transfer`` push between them);
   * per-component TTFT breakdown percentiles (queue_wait, prefill,
     decode, route overhead) across all completed requests;
   * the top-k slowest requests, each with its indented span tree;
@@ -51,8 +54,10 @@ from typing import Any, Dict, List, Optional
 TRAIN_PHASES = ("compile", "data_wait", "h2d_wait", "dispatch",
                 "ckpt_save", "eval")
 # Request-path component span names emitted by serve/engine.py +
-# serve/router.py.
-REQUEST_COMPONENTS = ("queue_wait", "prefill_chunk", "decode")
+# serve/router.py (+ the prefill->decode KV push from infer/server.py
+# in a disaggregated fleet).
+REQUEST_COMPONENTS = ("queue_wait", "prefill_chunk", "decode",
+                      "kv_transfer")
 # Wall-clock slack (µs) tolerated when nesting spans from different
 # processes: their timelines share one wall anchor but not one clock.
 EPS_US = 500.0
@@ -160,21 +165,35 @@ def _fmt(v) -> str:
 
 def request_report(spans, top: int) -> List[str]:
     groups = by_trace_id(spans)
-    # A request is "complete" when the replica recorded its terminal
+    # A request is "complete" when a replica recorded its terminal
     # `request` span; `route` spans with no matching request span mean
     # the replica side was lost (ring overwrite, crash, still running).
+    # A disaggregated handoff records TWO request spans under one trace
+    # id — the prefill replica's prefill-only pass, then the decode
+    # replica's full request — so the terminal span is the LATEST-ending
+    # one; the earlier ones are the handoff legs, joined in the same
+    # tree with the `kv_transfer` push between them.
     complete: Dict[str, Dict[str, Any]] = {}
     routed_only = 0
+    handoffs = 0
+    kv_pushes = 0
     for tid, evs in groups.items():
         req = [e for e in evs if e["name"] == "request"]
         route = [e for e in evs if e["name"] == "route"]
         if req:
-            complete[tid] = {"evs": evs, "req": req[0],
-                             "route": route[0] if route else None}
+            complete[tid] = {
+                "evs": evs,
+                "req": max(req, key=lambda e: e["ts"] + e["dur"]),
+                "route": route[0] if route else None}
+            if len(req) > 1:
+                handoffs += 1
+            if any(e["name"] == "kv_transfer" for e in evs):
+                kv_pushes += 1
         elif route:
             routed_only += 1
     lines = [f"requests_complete={len(complete)} "
              f"route_unmatched={routed_only} "
+             f"handoffs={handoffs} kv_transfers={kv_pushes} "
              f"trace_ids_seen={len(groups)}"]
 
     comp_ms: Dict[str, List[float]] = {}
@@ -196,7 +215,7 @@ def request_report(spans, top: int) -> List[str]:
         ttft = per.get("queue_wait", 0.0) + per.get("prefill", 0.0)
         comp_ms.setdefault("ttft", []).append(ttft)
         totals.append((g["req"]["dur"] / 1e3, tid, g))
-    for name in ("ttft", "queue_wait", "prefill", "decode",
+    for name in ("ttft", "queue_wait", "prefill", "kv_transfer", "decode",
                  "route_overhead"):
         vals = comp_ms.get(name, [])
         if not vals:

@@ -437,3 +437,44 @@ def test_replica_sse_stream_matches_buffered_result():
         httpd.shutdown()
         httpd.server_close()
         service.close()
+
+
+def test_router_stale_scrape_vs_connection_refused():
+    # A hung /metrics (connect succeeds, response never comes) must NOT
+    # mark the replica down — stats go stale and routing continues on
+    # the last-known load; only `stale_down_after` consecutive slow
+    # scrapes declare it down. A refused connection is down immediately.
+    import socket
+
+    hung = socket.socket()
+    hung.bind(("127.0.0.1", 0))
+    hung.listen(8)  # backlog completes the TCP handshake; never accept
+    hung_url = f"http://127.0.0.1:{hung.getsockname()[1]}"
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_url = f"http://127.0.0.1:{probe.getsockname()[1]}"
+    probe.close()  # nothing listens here any more -> refused
+
+    router = Router([hung_url, dead_url], poll_interval_s=30.0,
+                    scrape_timeout_s=0.2, stale_down_after=3)
+    try:
+        hung_r = next(r for r in router.replicas.values()
+                      if r.url == hung_url)
+        dead_r = next(r for r in router.replicas.values()
+                      if r.url == dead_url)
+        router.poll_once()
+        assert dead_r.up is False          # refused -> down at once
+        assert dead_r.stale is False
+        assert hung_r.up is True           # slow -> stale, still routable
+        assert hung_r.stale is True
+        assert hung_r.state == "stale"
+        assert "stale" in hung_r.last_error
+        assert hung_r in router.candidates(None)
+        router.poll_once()
+        assert hung_r.up is True           # 2 of 3: still tolerated
+        router.poll_once()
+        assert hung_r.up is False          # 3rd consecutive: give up
+        assert hung_r not in router.candidates(None)
+    finally:
+        router.stop()
+        hung.close()

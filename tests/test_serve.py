@@ -434,4 +434,5 @@ def test_server_locked_path_unchanged_and_reshaping_knobs_fall_back():
     # without an engine, health keeps the pre-engine shape
     plain = InferenceService(PARAMS, ARGS, TOK, run_name="tiny")
     assert "engine" not in plain.health()
-    assert plain.metrics() == {"engine": "locked"}
+    assert plain.metrics() == {"engine": "locked", "role": "any",
+                               "draining": False}
