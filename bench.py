@@ -2621,6 +2621,37 @@ def _lint_gate() -> None:
     sys.exit(1)
 
 
+def _sync_gate() -> None:
+    """graftsync companion to the lint gate: refuse to bench a tree with
+    NEW thread-ownership or lock-discipline findings — a data race in the
+    serving layer skews queue-depth/refcount bookkeeping and the benched
+    number measures the race, not the chip. Shares BENCH_LINT=0 as the
+    escape hatch."""
+    if os.environ.get("BENCH_LINT") == "0":
+        return
+    try:
+        from mlx_cuda_distributed_pretraining_tpu.analysis import load_baseline
+        from mlx_cuda_distributed_pretraining_tpu.analysis.sync import (
+            default_sync_baseline_path, run_sync)
+        pkg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "mlx_cuda_distributed_pretraining_tpu")
+        result = run_sync(
+            [pkg], baseline=load_baseline(default_sync_baseline_path()))
+    except Exception as e:  # noqa: BLE001 - a linter bug must not brick benching
+        log(f"[bench] graftsync gate errored ({e}); continuing without it")
+        return
+    if not result.new:
+        return
+    for f in result.new[:20]:
+        log(f"[bench] graftsync: {f.path}:{f.line}: [{f.rule}] {f.message}")
+    print(json.dumps({
+        "error": f"graftsync found {len(result.new)} new finding(s) — fix, "
+                 "suppress, or baseline them first (BENCH_LINT=0 to force)",
+        "value": 0,
+    }), flush=True)
+    sys.exit(1)
+
+
 def _audit_gate() -> None:
     """graftaudit companion to the lint gate: AOT-lower the sample
     config's train/serve/decode programs and refuse to bench a tree with
@@ -2762,6 +2793,7 @@ if __name__ == "__main__":
         probe_child()
     else:
         _lint_gate()  # before the atexit hook: a refusal must emit no doc
+        _sync_gate()
         _audit_gate()
         atexit.register(emit, "atexit")
         signal.signal(signal.SIGTERM, _on_signal)

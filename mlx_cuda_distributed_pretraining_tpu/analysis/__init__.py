@@ -1,4 +1,4 @@
-"""graftlint + graftaudit: static analysis for this repo's jit-heavy code.
+"""graftlint + graftaudit + graftsync: static analysis for this repo.
 
 The TPU silent killers — jit recompile storms, reused PRNG keys,
 host↔device syncs inside hot loops, use-after-donate — leave no
@@ -15,12 +15,22 @@ collective counts/bytes against a committed per-config budget, fp32
 matmuls under a bf16 config, closed-over constants, replicated params
 that the sharding rules say should be sharded.
 
+graftsync covers the layer neither sees: the host-side threads around
+the device program. Concurrency contracts are declared as ``# graftsync:
+owner=...`` / ``guarded-by=...`` comments on the serving/training
+classes; four pure-AST rules check thread ownership, lock guards,
+blocking-under-lock, and lock-order cycles, and an opt-in runtime shim
+(``GRAFTSYNC_RUNTIME=1``, ``sync_runtime.py``) asserts actual thread
+identity and acquisition order against the statically derived map.
+
     python -m mlx_cuda_distributed_pretraining_tpu.analysis.lint [paths]
+    python -m mlx_cuda_distributed_pretraining_tpu.analysis.sync [paths]
     python -m mlx_cuda_distributed_pretraining_tpu.analysis.audit \
         --config configs/model-config-sample.yaml
 
-See ``rules.py``/``audit_rules.py`` for the rule catalogues and README
-"graftlint" for the workflow (suppressing, baselining, budgets).
+See ``rules.py``/``audit_rules.py``/``sync_rules.py`` for the rule
+catalogues and README "graftlint"/"Concurrency model" for the workflow
+(suppressing, baselining, budgets).
 """
 
 from .core import (  # noqa: F401
@@ -35,4 +45,10 @@ from .core import (  # noqa: F401
     run_lint,
     write_baseline,
     write_baseline_entries,
+)
+from .sync_rules import (  # noqa: F401
+    SYNC_SUPPRESS_RE,
+    all_sync_rules,
+    package_lock_edges,
+    package_ownership,
 )

@@ -299,13 +299,19 @@ def decorated_header_spans(tree: ast.Module) -> Dict[int, Tuple[int, int]]:
 
 def suppressed_rules_at(lines: Sequence[str],
                         header_spans: Dict[int, Tuple[int, int]],
-                        line: int) -> Optional[set]:
+                        line: int,
+                        suppress_re: Optional[re.Pattern] = None
+                        ) -> Optional[set]:
     """Rule ids suppressed for a finding at ``line`` (None when none):
     the line's own comment, plus — when the line sits in a decorated
-    statement's header — comments on every other line of that header."""
+    statement's header — comments on every other line of that header.
+    ``suppress_re`` lets a sibling tool (graftsync) carry its own
+    comment tag; default is the graftlint one."""
+    pat = suppress_re or _SUPPRESS_RE
+
     def line_tags(ln: int) -> Optional[set]:
         if 1 <= ln <= len(lines):
-            m = _SUPPRESS_RE.search(lines[ln - 1])
+            m = pat.search(lines[ln - 1])
             if m:
                 return {r.strip() for r in m.group(1).split(",") if r.strip()}
         return None
@@ -330,9 +336,14 @@ class ModuleContext:
     lines: List[str]
     jit_index: JitIndex
     header_spans: Dict[int, Tuple[int, int]] = field(default_factory=dict)
+    # Tools sharing this runner but carrying their own comment tag
+    # (graftsync: ``# graftsync: disable=RULE``) set this; None means
+    # the graftlint tag.
+    suppress_re: Optional[re.Pattern] = None
 
     def suppressed_rules(self, line: int) -> Optional[set]:
-        return suppressed_rules_at(self.lines, self.header_spans, line)
+        return suppressed_rules_at(self.lines, self.header_spans, line,
+                                   suppress_re=self.suppress_re)
 
 
 def normalize_path(path: str) -> str:
@@ -475,7 +486,8 @@ def result_to_json(tool: str, result: LintResult) -> Dict[str, Any]:
     }
 
 
-def lint_file(path: str, rules: Optional[Dict[str, Rule]] = None
+def lint_file(path: str, rules: Optional[Dict[str, Rule]] = None,
+              suppress_re: Optional[re.Pattern] = None
               ) -> Tuple[List[Finding], List[Finding]]:
     """Lint one file. Returns (active findings, inline-suppressed)."""
     rules = rules if rules is not None else all_rules()
@@ -491,7 +503,8 @@ def lint_file(path: str, rules: Optional[Dict[str, Rule]] = None
                         f"{type(e).__name__}: {e}")], []
     ctx = ModuleContext(norm, ap, tree, src.splitlines(),
                         build_jit_index(tree),
-                        header_spans=decorated_header_spans(tree))
+                        header_spans=decorated_header_spans(tree),
+                        suppress_re=suppress_re)
     active: List[Finding] = []
     suppressed: List[Finding] = []
     for rule in rules.values():
@@ -507,11 +520,12 @@ def lint_file(path: str, rules: Optional[Dict[str, Rule]] = None
 
 def run_lint(paths: Sequence[str],
              baseline: Optional[Sequence[Dict[str, Any]]] = None,
-             rules: Optional[Dict[str, Rule]] = None) -> LintResult:
+             rules: Optional[Dict[str, Rule]] = None,
+             suppress_re: Optional[re.Pattern] = None) -> LintResult:
     findings: List[Finding] = []
     suppressed: List[Finding] = []
     for fp in _iter_py_files(paths):
-        got, sup = lint_file(fp, rules=rules)
+        got, sup = lint_file(fp, rules=rules, suppress_re=suppress_re)
         findings.extend(got)
         suppressed.extend(sup)
 

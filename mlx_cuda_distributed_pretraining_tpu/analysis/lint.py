@@ -53,7 +53,8 @@ def _covers_package(paths: Sequence[str]) -> bool:
     return False
 
 
-def _prune_stale(baseline_path: str, baseline, stale) -> int:
+def _prune_stale(baseline_path: str, baseline, stale,
+                 tool: str = "graftlint") -> int:
     """Rewrite the baseline minus the stale entries (multiset removal on
     (rule, path, message); surviving entries keep their reasons)."""
     drop = {}
@@ -67,7 +68,7 @@ def _prune_stale(baseline_path: str, baseline, stale) -> int:
             drop[k] -= 1
         else:
             kept.append(e)
-    write_baseline_entries(baseline_path, kept)
+    write_baseline_entries(baseline_path, kept, tool=tool)
     return len(baseline) - len(kept)
 
 

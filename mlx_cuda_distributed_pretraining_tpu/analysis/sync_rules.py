@@ -1,0 +1,900 @@
+"""graftsync rules: thread-ownership & lock-discipline static analysis.
+
+graftlint gates the *device* program; graftsync gates the host-side
+concurrency layer around it — the engine thread, router/fleet scrape
+threads, prefetch worker, supervisor watchdog, and the shared metrics
+registry. Contracts are declared in source as lightweight comments:
+
+- ``# graftsync: owner=engine-thread`` on an attribute assignment marks
+  the attribute as mutable only from that logical thread domain; on a
+  ``def`` line it marks the method as an entry point that *runs on* the
+  domain (reachability from entries via ``self.m()`` edges whitelists
+  helpers); on a ``class`` line it marks the whole object as owned (the
+  contract is cross-object, enforced by the runtime shim).
+- ``# graftsync: guarded-by=self._lock`` on an attribute assignment
+  requires every access to sit inside ``with <base>._lock`` (the lock
+  attribute is resolved against the accessing expression's base, so
+  ``r.up`` requires ``with r.lock:``). A spec without the ``self.``
+  prefix (``guarded-by=_lock``) is suffix-matched instead — for locks
+  that live on a *different* object than the guarded attribute (the
+  metrics registry guards its series' fields).
+- ``# graftsync: disable=RULE[,RULE2]`` acknowledges a finding in place,
+  exactly like graftlint's tag (reasons go in the same comment).
+
+Four rules:
+
+- ``sync-owned-attr``    — owned attribute mutated from a method not
+  reachable from an owner-thread entry point and not funneled through
+  ``call_in_loop``;
+- ``sync-guard``         — guarded attribute accessed outside its lock
+  (interprocedural: an unguarded access inside a helper is excused when
+  every same-module call site of the helper holds the lock);
+- ``sync-blocking-under-lock`` — blocking call (queue get/put, socket /
+  urllib, ``time.sleep``, jax dispatch sync) while holding a lock;
+- ``sync-lock-order``    — cycle in the cross-module lock acquisition
+  graph (``with A: with B`` edges, one level of local-call chasing).
+
+Everything is pure-AST and errs toward silence: an access whose base is
+not a plain dotted name, a lock the resolver can't identify, or an
+ambiguous attribute name simply isn't checked. The runtime shim
+(``sync_runtime``) covers the dynamic remainder.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import PACKAGE_NAME, Finding, ModuleContext, Rule, dotted_name
+from .rules import (_CALL_CHASE_DEPTH, _build_parents, _is_generator,
+                    _local_defs, _resolve_local_call, _walk_skip_defs)
+
+SYNC_SUPPRESS_RE = re.compile(r"#\s*graftsync:\s*disable=([A-Za-z0-9_,\- ]+)")
+_ANNOT_RE = re.compile(r"#\s*graftsync:\s*(owner|guarded-by)=([A-Za-z0-9_.\-]+)")
+
+# Terminal component of a with-item name that we treat as a mutex.
+_LOCKISH_RE = re.compile(r"(^|_)(lock|rlock|mutex)$", re.IGNORECASE)
+# Receiver names whose .get/.put we treat as queue operations.
+_QUEUEISH_RE = re.compile(r"(queue|(^|_)q$|(^|_)tasks$)", re.IGNORECASE)
+# Receiver names whose .join blocks on another thread/process.
+_JOINABLE_RE = re.compile(r"(thread|worker|poller|watchdog|child|proc)",
+                          re.IGNORECASE)
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+# Container-method names that mutate the receiver in place.
+_MUTATORS = {"append", "appendleft", "add", "clear", "discard", "extend",
+             "insert", "pop", "popleft", "popitem", "remove", "setdefault",
+             "sort", "update"}
+
+_BLOCKING_DOTTED = {"time.sleep", "subprocess.run", "subprocess.check_output",
+                    "subprocess.check_call", "subprocess.call", "os.system"}
+_BLOCKING_TERMINALS = {"urlopen", "create_connection", "getaddrinfo",
+                       "block_until_ready", "device_get"}
+
+
+# -- sync rule registry (separate from graftlint's) -------------------------
+
+_SYNC_RULES: Dict[str, Rule] = {}
+
+
+def register_sync(cls):
+    inst = cls()
+    assert inst.id and inst.id not in _SYNC_RULES, f"bad rule id {inst.id!r}"
+    _SYNC_RULES[inst.id] = inst
+    return cls
+
+
+def all_sync_rules() -> Dict[str, Rule]:
+    return dict(_SYNC_RULES)
+
+
+# -- annotation model --------------------------------------------------------
+
+@dataclass
+class ModuleSync:
+    """Per-module contracts parsed from ``# graftsync:`` comments."""
+    # class -> attr -> owning thread domain
+    owned_attrs: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    # class -> method -> thread domain the method runs on (entry point)
+    owner_methods: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    # class -> thread domain (whole object owned; runtime contract)
+    owned_classes: Dict[str, str] = field(default_factory=dict)
+    # class -> attr -> lock spec ("self._lock" base form / "_lock" suffix)
+    guarded_attrs: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    # class -> lock-attribute names the class constructs (self.X = Lock())
+    lock_decls: Dict[str, Set[str]] = field(default_factory=dict)
+    # class -> every attr the class itself assigns via plain `self.X = ...`
+    # (a class's own unguarded attribute shadows same-named guard
+    # contracts imported from other modules)
+    declared_attrs: Dict[str, Set[str]] = field(default_factory=dict)
+    # module-level lock names (NAME = threading.Lock())
+    module_locks: Set[str] = field(default_factory=set)
+    # resolved (abspath, imported-names) for package-local from-imports
+    imports: List[Tuple[str, Tuple[str, ...]]] = field(default_factory=list)
+
+
+def _annotations_on(lines: Sequence[str], lineno: int
+                    ) -> List[Tuple[str, str]]:
+    if 1 <= lineno <= len(lines):
+        return [(m.group(1), m.group(2))
+                for m in _ANNOT_RE.finditer(lines[lineno - 1])]
+    return []
+
+
+def _resolve_import(abspath: str, node: ast.ImportFrom) -> Optional[str]:
+    """Absolute path of a package-local from-import target, else None."""
+    if node.level:
+        base = os.path.dirname(abspath)
+        for _ in range(node.level - 1):
+            base = os.path.dirname(base)
+        modparts = node.module.split(".") if node.module else []
+    else:
+        name = node.module or ""
+        if not (name == PACKAGE_NAME or name.startswith(PACKAGE_NAME + ".")):
+            return None
+        d = os.path.dirname(abspath)
+        while d and os.path.basename(d) != PACKAGE_NAME:
+            nd = os.path.dirname(d)
+            if nd == d:
+                return None
+            d = nd
+        base = os.path.dirname(d)
+        modparts = name.split(".")
+    cand = os.path.join(base, *modparts) if modparts else base
+    if os.path.isfile(cand + ".py"):
+        return cand + ".py"
+    init = os.path.join(cand, "__init__.py")
+    if os.path.isdir(cand) and os.path.isfile(init):
+        return init
+    return None
+
+
+def _self_attr_root(t: ast.AST) -> Optional[str]:
+    """Attribute name when ``t`` is ``self.attr`` or a subscript/attribute
+    chain rooted at one (``self.d[k]``, ``self.d[k].f``)."""
+    while isinstance(t, ast.Subscript):
+        t = t.value
+    chain: List[str] = []
+    while isinstance(t, ast.Attribute):
+        chain.append(t.attr)
+        t = t.value
+        while isinstance(t, ast.Subscript):
+            t = t.value
+    if isinstance(t, ast.Name) and t.id == "self" and chain:
+        return chain[-1]
+    return None
+
+
+def _parse_module_sync(tree: ast.Module, lines: Sequence[str],
+                       abspath: str) -> ModuleSync:
+    ms = ModuleSync()
+    parents = _build_parents(tree)
+
+    def encl_class(node: ast.AST) -> Optional[str]:
+        n = node
+        while n in parents:
+            n = parents[n]
+            if isinstance(n, ast.ClassDef):
+                return n.name
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # keep climbing: methods sit inside their class
+                continue
+        return None
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for kind, val in _annotations_on(lines, node.lineno):
+                if kind == "owner":
+                    ms.owned_classes[node.name] = val
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            cls = encl_class(node)
+            if cls is None:
+                continue
+            for kind, val in _annotations_on(lines, node.lineno):
+                if kind == "owner":
+                    ms.owner_methods.setdefault(cls, {})[node.name] = val
+        elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            cls = encl_class(node)
+            annots = _annotations_on(lines, node.lineno)
+            for t in targets:
+                attr = _self_attr_root(t)
+                if attr and cls:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        ms.declared_attrs.setdefault(cls, set()).add(attr)
+                    for kind, val in annots:
+                        if kind == "owner":
+                            ms.owned_attrs.setdefault(cls, {})[attr] = val
+                        else:
+                            ms.guarded_attrs.setdefault(cls, {})[attr] = val
+                    # lock declaration: self.X = threading.Lock()
+                    val_node = getattr(node, "value", None)
+                    if isinstance(val_node, ast.Call):
+                        nm = dotted_name(val_node.func)
+                        if nm and nm.split(".")[-1] in _LOCK_CTORS:
+                            ms.lock_decls.setdefault(cls, set()).add(attr)
+                elif cls is None and isinstance(t, ast.Name):
+                    val_node = getattr(node, "value", None)
+                    if isinstance(val_node, ast.Call):
+                        nm = dotted_name(val_node.func)
+                        if nm and nm.split(".")[-1] in _LOCK_CTORS:
+                            ms.module_locks.add(t.id)
+        elif isinstance(node, ast.ImportFrom):
+            tgt = _resolve_import(abspath, node)
+            if tgt:
+                names = tuple(a.name for a in node.names)
+                ms.imports.append((tgt, names))
+    return ms
+
+
+# -- per-file info cache -----------------------------------------------------
+
+@dataclass
+class _Info:
+    ms: ModuleSync
+    tree: ast.Module
+    lines: List[str]
+
+
+_INFO_CACHE: Dict[str, Tuple[Tuple[float, int], Optional[_Info]]] = {}
+
+
+def _module_info(abspath: str) -> Optional[_Info]:
+    abspath = os.path.abspath(abspath)
+    try:
+        st = os.stat(abspath)
+        sig = (st.st_mtime, st.st_size)
+    except OSError:
+        return None
+    hit = _INFO_CACHE.get(abspath)
+    if hit and hit[0] == sig:
+        return hit[1]
+    try:
+        with open(abspath, encoding="utf-8") as f:
+            src = f.read()
+        tree = ast.parse(src, filename=abspath)
+    except (OSError, SyntaxError):
+        _INFO_CACHE[abspath] = (sig, None)
+        return None
+    lines = src.splitlines()
+    info = _Info(_parse_module_sync(tree, lines, abspath), tree, lines)
+    _INFO_CACHE[abspath] = (sig, info)
+    return info
+
+
+def _merged_guards(info: _Info) -> Dict[str, Set[str]]:
+    """attr -> lock specs, from this module's classes plus classes this
+    module imports *by name* from package-local modules. Scoping by
+    imported name keeps generic attribute names (``value``, ``count``)
+    from leaking guard contracts into unrelated modules."""
+    out: Dict[str, Set[str]] = {}
+    for attrs in info.ms.guarded_attrs.values():
+        for a, spec in attrs.items():
+            out.setdefault(a, set()).add(spec)
+    for imp_path, names in info.ms.imports:
+        sub = _module_info(imp_path)
+        if sub is None:
+            continue
+        for cls in names:
+            for a, spec in sub.ms.guarded_attrs.get(cls, {}).items():
+                out.setdefault(a, set()).add(spec)
+    return out
+
+
+def _merged_lock_decls(info: _Info) -> Dict[str, Set[str]]:
+    """lock-attribute terminal -> classes declaring it (module + named
+    imports); used to give ``x.lock`` a class identity for rule 4."""
+    out: Dict[str, Set[str]] = {}
+    for cls, locks in info.ms.lock_decls.items():
+        for lk in locks:
+            out.setdefault(lk, set()).add(cls)
+    for imp_path, names in info.ms.imports:
+        sub = _module_info(imp_path)
+        if sub is None:
+            continue
+        for cls in names:
+            for lk in sub.ms.lock_decls.get(cls, set()):
+                out.setdefault(lk, set()).add(cls)
+    return out
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+def _enclosing_fn_node(node: ast.AST, parents: Dict[ast.AST, ast.AST]
+                       ) -> Optional[ast.AST]:
+    n = node
+    while n in parents:
+        n = parents[n]
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return n
+    return None
+
+
+def _enclosing_class_name(node: ast.AST, parents: Dict[ast.AST, ast.AST]
+                          ) -> Optional[str]:
+    n = node
+    while n in parents:
+        n = parents[n]
+        if isinstance(n, ast.ClassDef):
+            return n.name
+    return None
+
+
+def _enclosing_with_names(node: ast.AST, parents: Dict[ast.AST, ast.AST]
+                          ) -> Set[str]:
+    """Dotted names of every with-item lock held at ``node``, collected
+    only up to the nearest enclosing def (a nested def's body runs later,
+    outside the lexical with)."""
+    names: Set[str] = set()
+    n = node
+    while n in parents:
+        p = parents[n]
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            break
+        if isinstance(p, (ast.With, ast.AsyncWith)) \
+                and not isinstance(n, ast.withitem):
+            for item in p.items:
+                nm = dotted_name(item.context_expr)
+                if nm:
+                    names.add(nm)
+        n = p
+    return names
+
+
+def _with_lock_names(w: ast.AST,
+                     known_terminals: frozenset = frozenset(),
+                     known_names: frozenset = frozenset()) -> List[str]:
+    """Dotted names among a with statement's items that denote a mutex:
+    lock-ish by name, or a known lock declaration (module-level
+    ``X = threading.Lock()`` / a class's declared lock attribute)."""
+    out = []
+    for item in w.items:
+        nm = dotted_name(item.context_expr)
+        if not nm:
+            continue
+        term = nm.split(".")[-1]
+        if _LOCKISH_RE.search(term) or nm in known_names \
+                or term in known_terminals:
+            out.append(nm)
+    return out
+
+
+def _known_locks(info: Optional["_Info"]
+                 ) -> Tuple[frozenset, frozenset]:
+    """(terminal attr names, bare module-level names) of declared locks
+    for a module — module + named package-local imports."""
+    if info is None:
+        return frozenset(), frozenset()
+    terms: Set[str] = set()
+    for locks in _merged_lock_decls(info).keys():
+        terms.add(locks)
+    return frozenset(terms), frozenset(info.ms.module_locks)
+
+
+# -- rule 1: owned-attribute mutation ---------------------------------------
+
+def _call_in_loop_exempt(mnode: ast.AST) -> Set[ast.AST]:
+    """Nodes inside closures handed to ``call_in_loop`` — those run on
+    the owner thread regardless of who built them."""
+    exempt: Set[ast.AST] = set()
+    localdefs = {n.name: n for n in ast.walk(mnode)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                 and n is not mnode}
+    for call in ast.walk(mnode):
+        if not isinstance(call, ast.Call):
+            continue
+        nm = dotted_name(call.func)
+        if not nm or nm.split(".")[-1] != "call_in_loop":
+            continue
+        for a in list(call.args) + [k.value for k in call.keywords]:
+            tgt: Optional[ast.AST] = None
+            if isinstance(a, ast.Lambda):
+                tgt = a
+            elif isinstance(a, ast.Name) and a.id in localdefs:
+                tgt = localdefs[a.id]
+            if tgt is not None:
+                exempt.update(ast.walk(tgt))
+    return exempt
+
+
+def _self_mutations(node: ast.AST) -> List[Tuple[str, ast.AST]]:
+    """(attr, node) pairs for statements that mutate ``self.<attr>`` —
+    assignments (plain/aug/ann, subscripted or chained), deletes, and
+    in-place container mutator calls (``self.d.pop(k)``)."""
+    out: List[Tuple[str, ast.AST]] = []
+    if isinstance(node, ast.Assign):
+        targets: List[ast.AST] = list(node.targets)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    elif isinstance(node, ast.Delete):
+        targets = list(node.targets)
+    elif isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS:
+            root = _self_attr_root(node.func.value)
+            if root:
+                out.append((root, node))
+        return out
+    else:
+        return out
+    flat: List[ast.AST] = []
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            flat.extend(t.elts)
+        else:
+            flat.append(t)
+    for t in flat:
+        if isinstance(t, ast.Starred):
+            t = t.value
+        root = _self_attr_root(t)
+        if root:
+            out.append((root, node))
+    return out
+
+
+@register_sync
+class OwnedAttrRule(Rule):
+    id = "sync-owned-attr"
+    description = ("thread-owned attribute mutated from a method not "
+                   "reachable from an owner-thread entry point (route it "
+                   "through call_in_loop)")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        info = _module_info(ctx.abspath)
+        if info is None:
+            return
+        ms = info.ms
+        for cls_node in ast.walk(ctx.tree):
+            if not isinstance(cls_node, ast.ClassDef):
+                continue
+            owned = ms.owned_attrs.get(cls_node.name, {})
+            if not owned:
+                continue
+            methods = {m.name: m for m in cls_node.body
+                       if isinstance(m, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            owner_of = ms.owner_methods.get(cls_node.name, {})
+            # reachability from entry methods over self.m()/cls.m() edges
+            reach: Dict[str, Set[str]] = {}
+            for thread in set(owned.values()) | set(owner_of.values()):
+                seeds = [m for m, th in owner_of.items() if th == thread]
+                seen: Set[str] = set(seeds)
+                stack = list(seeds)
+                while stack:
+                    mnode = methods.get(stack.pop())
+                    if mnode is None:
+                        continue
+                    for call in ast.walk(mnode):
+                        if not isinstance(call, ast.Call):
+                            continue
+                        nm = dotted_name(call.func)
+                        if not nm:
+                            continue
+                        parts = nm.split(".")
+                        if len(parts) == 2 and parts[0] in ("self", "cls") \
+                                and parts[1] in methods \
+                                and parts[1] not in seen:
+                            seen.add(parts[1])
+                            stack.append(parts[1])
+                reach[thread] = seen
+            for mname, mnode in methods.items():
+                if mname == "__init__":
+                    continue
+                exempt = _call_in_loop_exempt(mnode)
+                for node in ast.walk(mnode):
+                    if node in exempt:
+                        continue
+                    for attr, site in _self_mutations(node):
+                        thread = owned.get(attr)
+                        if thread is None:
+                            continue
+                        if mname in reach.get(thread, set()):
+                            continue
+                        yield self.finding(
+                            ctx, site,
+                            f"'{cls_node.name}.{attr}' is owned by thread "
+                            f"'{thread}' but mutated in "
+                            f"'{cls_node.name}.{mname}', which is not "
+                            f"reachable from an owner-thread entry point; "
+                            f"route the mutation through call_in_loop")
+
+
+# -- rule 2: guarded access outside lock ------------------------------------
+
+def _guard_satisfied(withnames: Set[str], spec: str, base: str) -> bool:
+    if spec.startswith("self."):
+        lockattr = spec[len("self."):]
+        required = spec if base in ("self", "cls") else f"{base}.{lockattr}"
+        return required in withnames
+    return any(nm == spec or nm.endswith("." + spec) for nm in withnames)
+
+
+def _guard_suffix_held(withnames: Set[str], spec: str) -> bool:
+    """Looser check used at call sites, where the access base doesn't
+    translate: any held lock whose name ends with the spec's terminal."""
+    suffix = spec[len("self."):] if spec.startswith("self.") else spec
+    return any(nm == suffix or nm.endswith("." + suffix) for nm in withnames)
+
+
+def _all_call_sites_guarded(fn_node: ast.AST, spec: str, tree: ast.Module,
+                            parents: Dict[ast.AST, ast.AST],
+                            localdefs: Dict[str, ast.AST],
+                            depth: int, stack: frozenset) -> bool:
+    if depth <= 0 or fn_node in stack:
+        return False
+    sites = [c for c in ast.walk(tree) if isinstance(c, ast.Call)
+             and _resolve_local_call(c, localdefs) is fn_node]
+    if not sites:
+        return False
+    for c in sites:
+        if _guard_suffix_held(_enclosing_with_names(c, parents), spec):
+            continue
+        encl = _enclosing_fn_node(c, parents)
+        if encl is None:
+            return False
+        if not _all_call_sites_guarded(encl, spec, tree, parents, localdefs,
+                                       depth - 1, stack | {fn_node}):
+            return False
+    return True
+
+
+@register_sync
+class GuardedAccessRule(Rule):
+    id = "sync-guard"
+    description = ("guarded attribute accessed outside a `with <lock>` "
+                   "block (interprocedural over same-module call sites)")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        info = _module_info(ctx.abspath)
+        if info is None:
+            return
+        ms = info.ms
+        merged = _merged_guards(info)
+        if not merged and not ms.guarded_attrs:
+            return
+        parents = _build_parents(ctx.tree)
+        localdefs = _local_defs(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            base = dotted_name(node.value)
+            if base is None:
+                continue
+            par = parents.get(node)
+            if isinstance(par, ast.Call) and par.func is node:
+                continue  # method call, not a data access of the attr
+            attr = node.attr
+            spec: Optional[str] = None
+            if base in ("self", "cls"):
+                cls = _enclosing_class_name(node, parents)
+                if cls:
+                    spec = ms.guarded_attrs.get(cls, {}).get(attr)
+                    if spec is None \
+                            and attr in ms.declared_attrs.get(cls, set()):
+                        continue  # class's own unguarded attr, not the
+                        # imported guard contract of the same name
+            if spec is None:
+                specs = merged.get(attr, set())
+                spec = next(iter(specs)) if len(specs) == 1 else None
+            if spec is None:
+                continue
+            fn = _enclosing_fn_node(node, parents)
+            if fn is None:
+                continue  # module level runs single-threaded at import
+            if fn.name == "__init__" and base in ("self", "cls"):
+                continue  # construction precedes sharing
+            withnames = _enclosing_with_names(node, parents)
+            if _guard_satisfied(withnames, spec, base):
+                continue
+            if _all_call_sites_guarded(fn, spec, ctx.tree, parents,
+                                       localdefs, _CALL_CHASE_DEPTH,
+                                       frozenset()):
+                continue
+            required = spec if spec.startswith("self.") and base in (
+                "self", "cls") else (
+                f"{base}.{spec[len('self.'):]}" if spec.startswith("self.")
+                else spec)
+            yield self.finding(
+                ctx, node,
+                f"'{base}.{attr}' is declared guarded-by={spec} but is "
+                f"accessed outside `with {required}` (and not every call "
+                f"site of '{fn.name}' holds it)")
+
+
+# -- rule 3: blocking call while holding a lock -----------------------------
+
+def _blocking_desc(call: ast.Call) -> Optional[str]:
+    nm = dotted_name(call.func)
+    if not nm:
+        return None
+    parts = nm.split(".")
+    term = parts[-1]
+    if nm in _BLOCKING_DOTTED or term in _BLOCKING_TERMINALS:
+        return nm
+    if term in ("get", "put") and len(parts) >= 2 \
+            and _QUEUEISH_RE.search(parts[-2]):
+        return nm
+    if term == "wait":
+        return nm
+    if term == "join" and len(parts) >= 2 \
+            and _JOINABLE_RE.search(parts[-2]):
+        return nm
+    return None
+
+
+def _blocking_in_def(fn_node: ast.AST, localdefs: Dict[str, ast.AST],
+                     depth: int, stack: frozenset
+                     ) -> Optional[Tuple[str, str]]:
+    """(callee-chain, blocking-name) when the def's body reaches a
+    blocking call, chasing local calls up to ``depth``."""
+    if depth <= 0 or fn_node in stack or _is_generator(fn_node):
+        return None
+    for node in _walk_skip_defs(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        desc = _blocking_desc(node)
+        if desc:
+            return (fn_node.name, desc)
+        callee = _resolve_local_call(node, localdefs)
+        if callee is not None and callee is not fn_node:
+            got = _blocking_in_def(callee, localdefs, depth - 1,
+                                   stack | {fn_node})
+            if got:
+                return (f"{fn_node.name} -> {got[0]}", got[1])
+    return None
+
+
+@register_sync
+class BlockingUnderLockRule(Rule):
+    id = "sync-blocking-under-lock"
+    description = ("blocking call (queue get/put, socket/urllib, sleep, "
+                   "jax dispatch sync) while holding a lock")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        localdefs = _local_defs(ctx.tree)
+        kt, kn = _known_locks(_module_info(ctx.abspath))
+        for w in ast.walk(ctx.tree):
+            if not isinstance(w, (ast.With, ast.AsyncWith)):
+                continue
+            locks = _with_lock_names(w, kt, kn)
+            if not locks:
+                continue
+            held = locks[0]
+            for stmt in w.body:
+                for node in _walk_skip_defs(stmt, skip_root_check=False):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    desc = _blocking_desc(node)
+                    if desc:
+                        yield self.finding(
+                            ctx, node,
+                            f"blocking call '{desc}' while holding "
+                            f"'{held}'")
+                        continue
+                    callee = _resolve_local_call(node, localdefs)
+                    if callee is None:
+                        continue
+                    got = _blocking_in_def(callee, localdefs,
+                                           _CALL_CHASE_DEPTH, frozenset())
+                    if got:
+                        yield self.finding(
+                            ctx, node,
+                            f"call to '{got[0]}' reaches blocking "
+                            f"'{got[1]}' while holding '{held}'")
+
+
+# -- rule 4: lock-order cycles ----------------------------------------------
+
+def _lock_identity(nm: str, encl_class: Optional[str],
+                   decl_classes: Dict[str, Set[str]],
+                   module_locks: Set[str]) -> Optional[str]:
+    parts = nm.split(".")
+    term = parts[-1]
+    if len(parts) == 1:
+        return f"<module>.{term}" if term in module_locks else None
+    if parts[0] in ("self", "cls") and len(parts) == 2 and encl_class:
+        return f"{encl_class}.{term}"
+    cands = decl_classes.get(term, set())
+    if len(cands) == 1:
+        return f"{next(iter(cands))}.{term}"
+    return None
+
+
+def _locks_in_def(fn_node: ast.AST, parents: Dict[ast.AST, ast.AST],
+                  localdefs: Dict[str, ast.AST],
+                  ident, kt: frozenset, kn: frozenset,
+                  depth: int, stack: frozenset) -> Set[str]:
+    """Lock identities acquired anywhere in a def's body (local-call
+    chase); used to add call-mediated edges from an enclosing with."""
+    if depth <= 0 or fn_node in stack or _is_generator(fn_node):
+        return set()
+    out: Set[str] = set()
+    for node in _walk_skip_defs(fn_node):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for nm in _with_lock_names(node, kt, kn):
+                lid = ident(nm, node)
+                if lid:
+                    out.add(lid)
+        elif isinstance(node, ast.Call):
+            callee = _resolve_local_call(node, localdefs)
+            if callee is not None and callee is not fn_node:
+                out |= _locks_in_def(callee, parents, localdefs, ident,
+                                     kt, kn, depth - 1, stack | {fn_node})
+    return out
+
+
+def _module_lock_edges(info: _Info, abspath: str
+                       ) -> List[Tuple[str, str, int]]:
+    """(src-lock, dst-lock, src-lineno) acquisition-order edges for one
+    module: dst acquired (lexically or via a local call) while src held."""
+    tree = info.tree
+    parents = _build_parents(tree)
+    localdefs = _local_defs(tree)
+    decl_classes = _merged_lock_decls(info)
+    module_locks = set(info.ms.module_locks)
+    kt, kn = _known_locks(info)
+
+    def ident(nm: str, at: ast.AST) -> Optional[str]:
+        return _lock_identity(nm, _enclosing_class_name(at, parents),
+                              decl_classes, module_locks)
+
+    edges: List[Tuple[str, str, int]] = []
+    seen: Set[Tuple[str, str]] = set()
+    for w in ast.walk(tree):
+        if not isinstance(w, (ast.With, ast.AsyncWith)):
+            continue
+        src_ids = [lid for lid in
+                   (ident(nm, w) for nm in _with_lock_names(w, kt, kn))
+                   if lid]
+        if not src_ids:
+            continue
+        dsts: Set[str] = set()
+        for stmt in w.body:
+            for node in _walk_skip_defs(stmt, skip_root_check=False):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for nm in _with_lock_names(node, kt, kn):
+                        lid = ident(nm, node)
+                        if lid:
+                            dsts.add(lid)
+                elif isinstance(node, ast.Call):
+                    callee = _resolve_local_call(node, localdefs)
+                    if callee is not None:
+                        dsts |= _locks_in_def(callee, parents, localdefs,
+                                              ident, kt, kn,
+                                              _CALL_CHASE_DEPTH,
+                                              frozenset())
+        for s in src_ids:
+            for d in dsts:
+                if s != d and (s, d) not in seen:
+                    seen.add((s, d))
+                    edges.append((s, d, w.lineno))
+    return edges
+
+
+_PKG_EDGE_CACHE: Dict[Tuple, List[Tuple[str, str, str, int]]] = {}
+
+
+def _package_dir() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def package_lock_edges(pkg_dir: Optional[str] = None
+                       ) -> List[Tuple[str, str, str, int]]:
+    """(src, dst, relpath, lineno) acquisition edges across the whole
+    package — the statically derived lock-order graph the runtime shim
+    asserts against."""
+    from .core import _iter_py_files, normalize_path
+    pkg_dir = pkg_dir or _package_dir()
+    files = _iter_py_files([pkg_dir])
+    try:
+        sig = tuple((f, os.path.getmtime(f), os.path.getsize(f))
+                    for f in files)
+    except OSError:
+        sig = tuple(files)
+    hit = _PKG_EDGE_CACHE.get(sig)
+    if hit is not None:
+        return hit
+    edges: List[Tuple[str, str, str, int]] = []
+    for f in files:
+        info = _module_info(f)
+        if info is None:
+            continue
+        rel = normalize_path(f)
+        edges.extend((s, d, rel, ln)
+                     for s, d, ln in _module_lock_edges(info, f))
+    _PKG_EDGE_CACHE.clear()  # single entry: the package only changes on edit
+    _PKG_EDGE_CACHE[sig] = edges
+    return edges
+
+
+def package_ownership(pkg_dir: Optional[str] = None
+                      ) -> Dict[str, Dict[str, List[str]]]:
+    """thread domain -> {classes, attrs, methods} across the package —
+    the statically derived ownership map (runtime shim / docs / tests)."""
+    from .core import _iter_py_files
+    pkg_dir = pkg_dir or _package_dir()
+    out: Dict[str, Dict[str, List[str]]] = {}
+
+    def slot(thread: str) -> Dict[str, List[str]]:
+        return out.setdefault(thread,
+                              {"classes": [], "attrs": [], "methods": []})
+
+    for f in _iter_py_files([pkg_dir]):
+        info = _module_info(f)
+        if info is None:
+            continue
+        ms = info.ms
+        for cls, thread in ms.owned_classes.items():
+            slot(thread)["classes"].append(cls)
+        for cls, attrs in ms.owned_attrs.items():
+            for a, thread in attrs.items():
+                slot(thread)["attrs"].append(f"{cls}.{a}")
+        for cls, meths in ms.owner_methods.items():
+            for m, thread in meths.items():
+                slot(thread)["methods"].append(f"{cls}.{m}")
+    for rec in out.values():
+        for k in rec:
+            rec[k] = sorted(rec[k])
+    return out
+
+
+def _find_cycle(start: str, target: str,
+                adj: Dict[str, Set[str]]) -> Optional[List[str]]:
+    """A path start -> ... -> target in adj, as a list of nodes."""
+    seen = {start}
+    stack: List[Tuple[str, List[str]]] = [(start, [start])]
+    while stack:
+        node, path = stack.pop()
+        for nxt in sorted(adj.get(node, ())):
+            if nxt == target:
+                return path + [nxt]
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+@register_sync
+class LockOrderRule(Rule):
+    id = "sync-lock-order"
+    description = ("cycle in the lock acquisition-order graph "
+                   "(cross-module; `with A: with B` edges)")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        info = _module_info(ctx.abspath)
+        if info is None:
+            return
+        local = _module_lock_edges(info, ctx.abspath)
+        if not local:
+            return
+        in_pkg = ctx.path.startswith(PACKAGE_NAME + "/")
+        merged: List[Tuple[str, str]] = [(s, d) for s, d, _ in local]
+        if in_pkg:
+            merged.extend((s, d) for s, d, _, _ in package_lock_edges())
+        adj: Dict[str, Set[str]] = {}
+        for s, d in merged:
+            adj.setdefault(s, set()).add(d)
+        reported: Set[Tuple[str, ...]] = set()
+        for s, d, lineno in local:
+            path = _find_cycle(d, s, adj)
+            if path is None:
+                continue
+            cycle = [s] + path  # s -> d -> ... -> s
+            # canonical rotation for a stable message / dedup key
+            body = cycle[:-1] if cycle[-1] == s else cycle
+            k = body.index(min(body))
+            canon = tuple(body[k:] + body[:k])
+            if canon in reported:
+                continue
+            reported.add(canon)
+            desc = " -> ".join(canon + (canon[0],))
+            yield Finding(self.id, ctx.path, lineno, 0,
+                          f"lock-order cycle: {desc} (acquisition order "
+                          f"must be consistent across threads)")

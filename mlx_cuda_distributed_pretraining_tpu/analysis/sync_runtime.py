@@ -1,0 +1,198 @@
+"""graftsync runtime shim: opt-in dynamic enforcement of the contracts
+the static pass derives.
+
+Static analysis proves what it can see; this shim asserts the rest at
+run time — which *actual* thread touched an owned subsystem, and in
+which *actual* order locks were taken. It is a no-op unless a monitor is
+active: production code calls the module-level ``bind``/``check_owner``
+hooks, which cost one global ``is None`` check when disarmed. Arm it
+with ``GRAFTSYNC_RUNTIME=1`` in the environment (auto-activates at
+import, seeded with the statically derived lock-order edges) or
+explicitly via :func:`activate` — the deterministic interleaving tests
+do the latter.
+
+Two checks:
+
+- **ownership** — ``bind(domain)`` marks the calling thread as the owner
+  of a logical thread domain (the engine thread binds
+  ``"engine-thread"`` at the top of its loop); ``check_owner(domain)``
+  raises :class:`SyncViolation` when called from any other thread.
+  Domains nobody bound are not enforced — a pool used single-threaded
+  in a script stays silent.
+- **lock order** — :meth:`SyncMonitor.wrap_lock` returns an instrumented
+  lock; each acquisition records an edge from every lock the thread
+  already holds to the new one, into a digraph seeded with the static
+  acquisition edges (``sync_rules.package_lock_edges``). An edge that
+  closes a cycle raises :class:`SyncViolation` at the acquisition site —
+  the would-be deadlock, caught on the first interleaving that exhibits
+  the inverted order rather than the unlucky one that deadlocks.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+
+class SyncViolation(AssertionError):
+    """A thread-ownership or lock-order contract was broken at runtime."""
+
+
+class InstrumentedLock:
+    """A mutex that reports its acquisitions to a :class:`SyncMonitor`.
+
+    Wraps a real ``threading.Lock`` (or any lock-like object passed in),
+    so blocking semantics are unchanged — only the ordering bookkeeping
+    is added, *before* blocking, which is what lets an inverted order
+    raise instead of deadlock."""
+
+    def __init__(self, name: str, monitor: "SyncMonitor",
+                 lock=None) -> None:
+        self.name = name
+        self._monitor = monitor
+        self._lock = lock if lock is not None else threading.Lock()
+
+    def acquire(self, *a, **kw):
+        self._monitor._note_acquire(self.name)
+        got = self._lock.acquire(*a, **kw)
+        if not got:
+            self._monitor._note_release(self.name)
+        return got
+
+    def release(self) -> None:
+        self._lock.release()
+        self._monitor._note_release(self.name)
+
+    def __enter__(self) -> "InstrumentedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class SyncMonitor:
+    """Records lock acquisition order and thread-domain ownership,
+    asserting against the statically derived contracts."""
+
+    def __init__(self, static_order: Iterable[Tuple[str, str]] = ()) -> None:
+        self._graph: Dict[str, Set[str]] = {}
+        for a, b in static_order:
+            self._graph.setdefault(a, set()).add(b)
+        self._graph_lock = threading.Lock()
+        self._owners: Dict[str, int] = {}          # domain -> thread ident
+        self._held = threading.local()             # per-thread lock stack
+        self.violations: List[str] = []
+
+    # -- ownership ----------------------------------------------------------
+
+    def bind(self, domain: str) -> None:
+        self._owners[domain] = threading.get_ident()
+
+    def unbind(self, domain: str) -> None:
+        self._owners.pop(domain, None)
+
+    def check_owner(self, domain: str) -> None:
+        owner = self._owners.get(domain)
+        if owner is None:
+            return  # nobody claimed the domain: not enforced
+        me = threading.get_ident()
+        if me != owner:
+            msg = (f"graftsync: '{threading.current_thread().name}' touched "
+                   f"state owned by domain '{domain}' (bound to thread "
+                   f"{owner}); route the call through the owner thread "
+                   f"(call_in_loop)")
+            self.violations.append(msg)
+            raise SyncViolation(msg)
+
+    # -- lock order ---------------------------------------------------------
+
+    def wrap_lock(self, name: str, lock=None) -> InstrumentedLock:
+        return InstrumentedLock(name, self, lock=lock)
+
+    def _stack(self) -> List[str]:
+        st = getattr(self._held, "stack", None)
+        if st is None:
+            st = self._held.stack = []
+        return st
+
+    def _reaches(self, src: str, dst: str) -> bool:
+        seen = {src}
+        stack = [src]
+        while stack:
+            for nxt in self._graph.get(stack.pop(), ()):
+                if nxt == dst:
+                    return True
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(nxt)
+        return False
+
+    def _note_acquire(self, name: str) -> None:
+        st = self._stack()
+        with self._graph_lock:
+            for held in st:
+                if held == name:
+                    continue
+                # adding held -> name: a path name -> ... -> held means
+                # some thread (statically or dynamically) takes them in
+                # the opposite order — a deadlock waiting for traffic.
+                if self._reaches(name, held):
+                    msg = (f"graftsync: lock-order violation: acquiring "
+                           f"'{name}' while holding '{held}', but the "
+                           f"acquisition graph already orders '{name}' "
+                           f"before '{held}'")
+                    self.violations.append(msg)
+                    raise SyncViolation(msg)
+                self._graph.setdefault(held, set()).add(name)
+        st.append(name)
+
+    def _note_release(self, name: str) -> None:
+        st = self._stack()
+        if name in st:
+            st.reverse()
+            st.remove(name)
+            st.reverse()
+
+
+_MONITOR: Optional[SyncMonitor] = None
+
+
+def activate(monitor: Optional[SyncMonitor] = None) -> SyncMonitor:
+    """Arm the module-level hooks. With no argument, builds a monitor
+    seeded with the static package lock-order edges."""
+    global _MONITOR
+    if monitor is None:
+        from .sync_rules import package_lock_edges
+        edges = [(s, d) for s, d, _, _ in package_lock_edges()]
+        monitor = SyncMonitor(static_order=edges)
+    _MONITOR = monitor
+    return monitor
+
+
+def deactivate() -> None:
+    global _MONITOR
+    _MONITOR = None
+
+
+def active() -> Optional[SyncMonitor]:
+    return _MONITOR
+
+
+def bind(domain: str) -> None:
+    """Production hook: claim the current thread as owner of ``domain``.
+    One ``is None`` check when the shim is disarmed."""
+    if _MONITOR is not None:
+        _MONITOR.bind(domain)
+
+
+def check_owner(domain: str) -> None:
+    """Production hook: assert the caller is ``domain``'s owner thread.
+    One ``is None`` check when the shim is disarmed."""
+    if _MONITOR is not None:
+        _MONITOR.check_owner(domain)
+
+
+if os.environ.get("GRAFTSYNC_RUNTIME") == "1":  # pragma: no cover
+    activate()

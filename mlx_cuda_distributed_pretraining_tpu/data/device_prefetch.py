@@ -94,7 +94,7 @@ class DevicePrefetcher:
         # Captured before the worker starts fetching: a checkpoint taken
         # before anything is consumed must not see worker-advanced state.
         self._initial_state = loader.state_dict() if self._stateful else None
-        self._consumed_state: Optional[Dict[str, Any]] = None
+        self._consumed_state: Optional[Dict[str, Any]] = None  # graftsync: owner=trainer-thread
 
         self._sharding = None
         self._group_sharding = None
@@ -110,13 +110,14 @@ class DevicePrefetcher:
         # zero-copy — the device array aliases the host buffer, and a
         # refill would corrupt a group still in flight.
         self._reuse_group_bufs = jax.default_backend() != "cpu"
-        self._group_bufs: Dict[int, Dict[str, np.ndarray]] = {}
-        self._cursor = int(start_step) + 1  # next trainer step to feed
-        self._done = False
+        self._group_bufs: Dict[int, Dict[str, np.ndarray]] = {}  # graftsync: owner=prefetch-worker
+        # next trainer step to feed
+        self._cursor = int(start_step) + 1  # graftsync: owner=prefetch-worker
+        self._done = False  # graftsync: owner=prefetch-worker
         # Consumer-side latch: once an end/error item is consumed the worker
         # has exited, so a further queue.get() would block forever — repeat
         # the terminal outcome instead.
-        self._terminal: Optional[Dict[str, Any]] = None
+        self._terminal: Optional[Dict[str, Any]] = None  # graftsync: owner=trainer-thread
 
         self._queue: Optional[queue.Queue] = None
         self._stop_evt = threading.Event()
@@ -216,7 +217,7 @@ class DevicePrefetcher:
                 bufs[k][i] = v
         return bufs
 
-    def _worker(self) -> None:
+    def _worker(self) -> None:  # graftsync: owner=prefetch-worker
         while not self._stop_evt.is_set():
             item = self._produce_one()
             while not self._stop_evt.is_set():
@@ -230,7 +231,7 @@ class DevicePrefetcher:
 
     # -- consumer ------------------------------------------------------------
 
-    def get(self):
+    def get(self):  # graftsync: owner=trainer-thread
         """Next device-resident batch: ``(batch, tokens, waits)``.
 
         ``tokens`` is this host's non-pad target count (an int, or a list

@@ -75,17 +75,19 @@ def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
 class _Series:
     __slots__ = ("value",)
 
+    # The guarding lock lives on the *registry*, not the series — hence
+    # the suffix-form graftsync spec: any enclosing `with *._lock` counts.
     def __init__(self):
-        self.value = 0.0
+        self.value = 0.0  # graftsync: guarded-by=_lock
 
 
 class _HistSeries:
     __slots__ = ("counts", "sum", "count")
 
     def __init__(self, n_buckets: int):
-        self.counts = [0] * (n_buckets + 1)  # +1 for +Inf
-        self.sum = 0.0
-        self.count = 0
+        self.counts = [0] * (n_buckets + 1)  # graftsync: guarded-by=_lock
+        self.sum = 0.0  # graftsync: guarded-by=_lock
+        self.count = 0  # graftsync: guarded-by=_lock
 
 
 class _Metric:
@@ -99,7 +101,7 @@ class _Metric:
         self.help = help_text
         self.buckets: Tuple[float, ...] = tuple(buckets or DEFAULT_BUCKETS)
         self._registry = registry
-        self._series: Dict[Tuple[Tuple[str, str], ...], Any] = {}
+        self._series: Dict[Tuple[Tuple[str, str], ...], Any] = {}  # graftsync: guarded-by=_lock
 
     # All mutation goes through the registry lock: one lock for the whole
     # registry keeps the fast path to a single acquire and makes snapshot
@@ -158,9 +160,10 @@ class MetricsRegistry:
 
     def __init__(self, max_series_per_metric: int = DEFAULT_MAX_SERIES):
         self._lock = threading.Lock()
-        self._metrics: Dict[str, _Metric] = {}
+        self._metrics: Dict[str, _Metric] = {}  # graftsync: guarded-by=self._lock
         self.max_series_per_metric = int(max_series_per_metric)
-        self._dropped = 0  # label combos refused by the series bound
+        # label combos refused by the series bound
+        self._dropped = 0  # graftsync: guarded-by=self._lock
 
     def _declare(self, name: str, kind: str, help_text: str,
                  buckets: Optional[Iterable[float]] = None) -> _Metric:

@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from . import batch_step
+from ..analysis import sync_runtime
 from .kv_pool import PagedKVPool, SlotKVPool
 from .scheduler import (
     DECODE,
@@ -110,7 +111,7 @@ class EngineConfig:
 class BatchEngine:
     def __init__(self, params, args, tokenizer,
                  cfg: Optional[EngineConfig] = None, mesh=None):
-        self.params = params
+        self.params = params  # graftsync: owner=engine-thread
         self.args = args
         self.tokenizer = tokenizer
         self.cfg = cfg or EngineConfig()
@@ -156,17 +157,18 @@ class BatchEngine:
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._stats = None
-        self.iterations = 0
+        self.iterations = 0  # graftsync: owner=engine-thread
         # Cross-thread work: the engine thread is the SOLE mutator of pool
         # bookkeeping and self.params, so KV export/adopt and weight swaps
         # enqueue closures here and _iteration drains them between steps.
         self._tasks: "queue.Queue" = queue.Queue()
-        self.params_version = 0  # bumps on every applied weight swap
+        # bumps on every applied weight swap
+        self.params_version = 0  # graftsync: owner=engine-thread
         # sliding decode-throughput window + last-published snapshot
-        self._win_t0 = time.monotonic()
-        self._win_tokens = 0
-        self._last_publish = 0.0
-        self._metrics: Dict[str, Any] = {}
+        self._win_t0 = time.monotonic()  # graftsync: owner=engine-thread
+        self._win_tokens = 0  # graftsync: owner=engine-thread
+        self._last_publish = 0.0  # graftsync: owner=engine-thread
+        self._metrics: Dict[str, Any] = {}  # graftsync: owner=engine-thread
         # Per-request span tracer (obs/trace.py). Disabled is the default
         # and free: span() hands back a shared null span, and every call
         # site additionally guards on `.enabled` so the hot path allocates
@@ -240,13 +242,14 @@ class BatchEngine:
             "(exported/adopted/reused)")
         self._mc_swaps = reg.counter(
             "serve_weight_swaps_total", "weight swaps applied in place")
-        self._spec_proposed = 0
-        self._spec_accepted = 0
-        self._m_last = {"admitted": 0, "rejected": 0, "evicted": 0,
-                        "completed": 0, "preempted": 0, "iterations": 0,
-                        "spec_proposed": 0, "spec_accepted": 0,
-                        "prefix_hits": 0, "prefix_misses": 0,
-                        "prefix_evictions": 0}
+        self._spec_proposed = 0  # graftsync: owner=engine-thread
+        self._spec_accepted = 0  # graftsync: owner=engine-thread
+        self._m_last = {  # graftsync: owner=engine-thread
+            "admitted": 0, "rejected": 0, "evicted": 0,
+            "completed": 0, "preempted": 0, "iterations": 0,
+            "spec_proposed": 0, "spec_accepted": 0,
+            "prefix_hits": 0, "prefix_misses": 0,
+            "prefix_evictions": 0}
         self._metrics_server = None
         # Serving-mesh shape: set once (the mesh is fixed for the engine's
         # lifetime), labeled per axis so `serve_mesh_axis_size{axis="tp"}`
@@ -498,17 +501,15 @@ class BatchEngine:
 
     # -- metrics -------------------------------------------------------------
     def metrics(self) -> Dict[str, Any]:
-        s = self.scheduler
+        # One consistent locked snapshot of the scheduler counters —
+        # /metrics runs on HTTP handler threads while the engine thread
+        # mutates them under scheduler.lock.
+        sched = self.scheduler.counters()
         snap = {
             "iterations": self.iterations,
             "batch_occupancy": self.pool.num_used,
             "num_slots": self.pool.num_slots,
-            "queue_depth": s.queue_depth(),
-            "admitted": s.admitted,
-            "rejected": s.rejected,
-            "evicted": s.evicted,
-            "completed": s.completed,
-            "preempted": s.preempted,
+            **sched,
             "kv_backend": self.pool.kind,
             # Fleet fields: the router's poller reads these to learn pool
             # membership and swap progress.
@@ -576,8 +577,10 @@ class BatchEngine:
         # Registry mirror: gauges live, scheduler totals as counter deltas
         # (the scheduler keeps monotonic ints; Prometheus counters must
         # only ever be incremented).
+        sched = self.scheduler.counters()  # locked snapshot (engine thread
+        # races /metrics HTTP threads on these otherwise)
         self._mg_occupancy.set(self.pool.num_used)
-        self._mg_queue.set(self.scheduler.queue_depth())
+        self._mg_queue.set(sched["queue_depth"])
         self._mg_tok_s.set(tok_s)
         if self.pool.kind == "paged":
             self._mg_blocks_used.set(self.pool.blocks_in_use)
@@ -585,11 +588,11 @@ class BatchEngine:
             self._mg_free_watermark.set(self.pool.read_watermark())
             self._mg_fragmentation.set(self.pool.fragmentation())
         prefix = getattr(self.pool, "prefix", None)
-        cur = {"admitted": self.scheduler.admitted,
-               "rejected": self.scheduler.rejected,
-               "evicted": self.scheduler.evicted,
-               "completed": self.scheduler.completed,
-               "preempted": self.scheduler.preempted,
+        cur = {"admitted": sched["admitted"],
+               "rejected": sched["rejected"],
+               "evicted": sched["evicted"],
+               "completed": sched["completed"],
+               "preempted": sched["preempted"],
                "iterations": self.iterations,
                "spec_proposed": self._spec_proposed,
                "spec_accepted": self._spec_accepted,
@@ -630,7 +633,8 @@ class BatchEngine:
                 self.metrics(), **{"tok/s": round(tok_s, 2)}))
 
     # -- the iteration loop --------------------------------------------------
-    def _loop(self) -> None:
+    def _loop(self) -> None:  # graftsync: owner=engine-thread
+        sync_runtime.bind("engine-thread")
         while not self._stop.is_set():
             try:
                 busy = self._iteration()

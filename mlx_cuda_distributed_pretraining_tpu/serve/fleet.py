@@ -237,10 +237,14 @@ class FleetRouter(Router):
         sees ``canary_fraction`` of requests (preferred for those, so the
         gate actually exercises it) and none of the rest — unless the
         whole pool is canary, in which case gating would mean an outage."""
-        canaries = [r for r in cands if r.canary]
+        is_canary = {}
+        for r in cands:
+            with r.lock:
+                is_canary[r.id] = r.canary
+        canaries = [r for r in cands if is_canary[r.id]]
         if not canaries or len(canaries) == len(cands):
             return cands
-        rest = [r for r in cands if not r.canary]
+        rest = [r for r in cands if not is_canary[r.id]]
         take = (_hash64(f"canary:{trace_id}".encode()) % 10_000
                 < int(self.canary_fraction * 10_000))
         return canaries + rest if take else rest
@@ -271,21 +275,25 @@ class FleetRouter(Router):
             pre.url + "/prefill", data=payload,
             headers={"Content-Type": "application/json",
                      TRACE_HEADER: trace_id})
-        pre.inflight += 1
+        with pre.lock:
+            pre.inflight += 1
         try:
             with urllib.request.urlopen(
                     req, timeout=self.prefill_timeout_s) as resp:
                 out = json.loads(resp.read())
-            pre.ok_count += 1
+            with pre.lock:
+                pre.ok_count += 1
             self._mc_handoffs.inc(outcome="ok")
             return out
         except Exception as e:  # noqa: BLE001 - fallback path, not fatal
-            pre.err_count += 1
-            pre.last_error = f"handoff: {type(e).__name__}: {e}"
+            with pre.lock:
+                pre.err_count += 1
+                pre.last_error = f"handoff: {type(e).__name__}: {e}"
             self._mc_handoffs.inc(outcome="failed")
             return None
         finally:
-            pre.inflight -= 1
+            with pre.lock:
+                pre.inflight -= 1
 
     # -- dispatch -------------------------------------------------------------
     def dispatch(self, path: str, body: dict,
@@ -347,20 +355,28 @@ class FleetController:
         summed queue depth, and the worst free-KV-block watermark seen
         since the previous scrape, as a fraction of the arena."""
         pools: Dict[str, Dict[str, object]] = {}
-        for r in self.router.replicas.values():
+        for r in self.router._replica_list():
+            with r.lock:
+                live = r.up and not r.draining
+                depth = r.queue_depth
+                load = r.queue_depth + r.inflight
+                free = (r.kv_free_watermark
+                        if r.kv_free_watermark is not None
+                        else r.kv_blocks_free)
+                num_blocks = r.kv_num_blocks
             p = pools.setdefault(r.role, {
                 "live": 0, "queue_depth": 0, "load": 0,
-                "kv_free_frac": None, "replicas": []})
+                "kv_free_frac": None, "replicas": [],
+                "live_replicas": []})
             p["replicas"].append(r)
-            if not (r.up and not r.draining):
+            if not live:
                 continue
+            p["live_replicas"].append(r)
             p["live"] += 1
-            p["queue_depth"] += r.queue_depth
-            p["load"] += r.load
-            free = (r.kv_free_watermark if r.kv_free_watermark is not None
-                    else r.kv_blocks_free)
-            if free is not None and r.kv_num_blocks:
-                frac = free / r.kv_num_blocks
+            p["queue_depth"] += depth
+            p["load"] += load
+            if free is not None and num_blocks:
+                frac = free / num_blocks
                 cur = p["kv_free_frac"]
                 p["kv_free_frac"] = frac if cur is None else min(cur, frac)
         return pools
@@ -400,9 +416,7 @@ class FleetController:
                 self._idle_ticks[pool] = self._idle_ticks.get(pool, 0) + 1
                 if self._idle_ticks[pool] >= cfg.scale_down_idle_ticks:
                     self._idle_ticks[pool] = 0
-                    victim = max((r for r in p["replicas"]
-                                  if r.up and not r.draining),
-                                 key=lambda r: r.id)
+                    victim = max(p["live_replicas"], key=lambda r: r.id)
                     if self.drain_replica(victim.id):
                         if self.stop_fn:
                             self.stop_fn(victim.url)
@@ -421,14 +435,15 @@ class FleetController:
         the replica to stop admitting (``/admin/drain`` → it 503s fresh
         work), then wait for its queue, batch, and our in-flight count to
         hit zero. True = fully drained within the timeout."""
-        r = self.router.replicas[rid]
+        r = self.router.get_replica(rid)
         self.router.set_draining(rid, True)
         try:
             urllib.request.urlopen(urllib.request.Request(
                 r.url + "/admin/drain", data=b"{}", method="POST",
                 headers={"Content-Type": "application/json"}), timeout=5.0)
         except Exception as e:  # noqa: BLE001 - maybe already dead
-            r.last_error = f"drain: {type(e).__name__}: {e}"
+            with r.lock:
+                r.last_error = f"drain: {type(e).__name__}: {e}"
         deadline = time.monotonic() + (timeout_s if timeout_s is not None
                                        else self.cfg.drain_timeout_s)
         while time.monotonic() < deadline:
@@ -440,7 +455,9 @@ class FleetController:
                         + int(m.get("batch_occupancy", 0)))
             except Exception:  # noqa: BLE001 - gone = drained
                 busy = 0
-            if busy == 0 and r.inflight == 0:
+            with r.lock:
+                inflight = r.inflight
+            if busy == 0 and inflight == 0:
                 return True
             time.sleep(0.05)
         return False
@@ -468,12 +485,17 @@ class FleetController:
                            (("model_path", model_path),
                             ("run_dir", run_dir)) if v}).encode()
         out: Dict[str, list] = {"swapped": [], "failed": []}
-        order = [r for role in roles
-                 for r in sorted(self.router.replicas.values(),
-                                 key=lambda x: x.id)
-                 if r.role == role and r.up]
+        order = []
+        for role in roles:
+            for r in sorted(self.router._replica_list(),
+                            key=lambda x: x.id):
+                with r.lock:
+                    up = r.up
+                if r.role == role and up:
+                    order.append(r)
         for r in order:
-            ok0, err0 = r.ok_count, r.err_count
+            with r.lock:
+                ok0, err0 = r.ok_count, r.err_count
             try:
                 with urllib.request.urlopen(urllib.request.Request(
                         r.url + "/admin/swap_weights", data=body,
@@ -481,7 +503,8 @@ class FleetController:
                         method="POST"), timeout=600.0) as resp:
                     swapped = json.loads(resp.read())
             except Exception as e:  # noqa: BLE001 - halt the rollout
-                r.last_error = f"swap: {type(e).__name__}: {e}"
+                with r.lock:
+                    r.last_error = f"swap: {type(e).__name__}: {e}"
                 out["failed"].append({"replica": r.id, "error": str(e)})
                 self._log(f"[fleet] swap halted at {r.id}: {e}")
                 return out
@@ -489,20 +512,23 @@ class FleetController:
             deadline = time.monotonic() + canary_timeout_s
             try:
                 while time.monotonic() < deadline:
-                    if r.err_count > err0 \
-                            or r.ok_count - ok0 >= canary_requests:
+                    with r.lock:
+                        oks, errs = r.ok_count, r.err_count
+                    if errs > err0 or oks - ok0 >= canary_requests:
                         break
                     time.sleep(0.02)
             finally:
                 self.router.set_canary(r.id, False)
-            if r.err_count > err0:
+            with r.lock:
+                oks, errs = r.ok_count, r.err_count
+            if errs > err0:
                 out["failed"].append({
                     "replica": r.id,
-                    "error": f"canary saw {r.err_count - err0} errors"})
+                    "error": f"canary saw {errs - err0} errors"})
                 self._log(f"[fleet] swap halted: canary {r.id} errored")
                 return out
             out["swapped"].append({
-                "replica": r.id, "canary_ok": r.ok_count - ok0,
+                "replica": r.id, "canary_ok": oks - ok0,
                 "params_version": int(swapped.get("params_version", 0))})
             self._log(f"[fleet] {r.id} promoted "
                       f"(params_version={swapped.get('params_version')})")
@@ -520,7 +546,7 @@ class FleetController:
         actions = []
         view = read_fleet(self.fleet_dir,
                           stale_after_s=self.cfg.heartbeat_stale_s)
-        known = {r.url: r for r in self.router.replicas.values()}
+        known = {r.url: r for r in self.router._replica_list()}
         for m in view["members"]:
             url, role = str(m.get("url", "")), str(m.get("role", "any"))
             if not url:
@@ -529,11 +555,16 @@ class FleetController:
                 r = self.router.add_replica(url, role=role)
                 actions.append(f"adopt {r.id} {url}")
                 self._log(f"[fleet] adopted {role} member {url}")
-            elif not m["alive"] and url in known and known[url].up:
-                known[url].up = False
-                known[url].last_error = "heartbeat stale"
-                actions.append(f"reap {known[url].id}")
-                self._log(f"[fleet] reaped {url} (heartbeat stale)")
+            elif not m["alive"] and url in known:
+                r = known[url]
+                with r.lock:
+                    was_up = r.up
+                    if was_up:
+                        r.up = False
+                        r.last_error = "heartbeat stale"
+                if was_up:
+                    actions.append(f"reap {r.id}")
+                    self._log(f"[fleet] reaped {url} (heartbeat stale)")
         if actions:
             self.router._refresh_ring()
         return actions

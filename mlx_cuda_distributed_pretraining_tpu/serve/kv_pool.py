@@ -26,6 +26,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
+from ..analysis.sync_runtime import check_owner
 from ..models import llama
 from .prefix_cache import PrefixCache, chain_keys
 
@@ -66,8 +67,13 @@ def _place_cache(cache, mesh, num_kv_heads):
             for layer in cache]
 
 
-class SlotKVPool:
-    """Fixed pool of KV-cache slots with per-slot length state."""
+class SlotKVPool:  # graftsync: owner=engine-thread
+    """Fixed pool of KV-cache slots with per-slot length state.
+
+    Bookkeeping is engine-thread-owned (no locks): every mutator runs on
+    the engine loop, and cross-thread callers must ride
+    ``BatchEngine.call_in_loop``. ``check_owner`` asserts this under
+    ``GRAFTSYNC_RUNTIME=1`` and is a no-op otherwise."""
 
     kind = "slotted"
 
@@ -120,6 +126,7 @@ class SlotKVPool:
         ``need_tokens``/``token_ids`` are part of the shared pool interface
         — a slot always holds ``capacity`` tokens and has no prefix cache,
         so both are ignored here."""
+        check_owner("engine-thread")
         if not self._free:
             return None
         slot = self._free.pop()
@@ -131,6 +138,7 @@ class SlotKVPool:
         return length <= self.max_len
 
     def free(self, slot: int) -> None:
+        check_owner("engine-thread")
         if not 0 <= slot < self.num_slots:
             raise ValueError(f"slot {slot} out of range 0..{self.num_slots - 1}")
         if slot in self._free:
@@ -148,7 +156,7 @@ class SlotKVPool:
         return max((self.lengths[s] for s in slots), default=0)
 
 
-class PagedKVPool:
+class PagedKVPool:  # graftsync: owner=engine-thread
     """Paged KV pool (PagedAttention, Kwon et al. 2023): one global arena of
     fixed-size blocks per layer shared by every sequence, addressed through
     per-sequence block tables.
@@ -338,6 +346,7 @@ class PagedKVPool:
         adopted token count — the engine's chunked prefill resumes there.
         At least the final prompt token is always recomputed (its logits
         seed sampling), and nothing is mutated on refusal."""
+        check_owner("engine-thread")
         adopted: List[int] = []
         adopted_key: Optional[bytes] = None
         if self.prefix is not None and token_ids is not None \
@@ -418,6 +427,7 @@ class PagedKVPool:
         """Return the row; each mapped block's refcount drops, and blocks
         reaching zero either retire to the prefix LRU (registered) or
         rejoin the free list. O(mapped) list ops."""
+        check_owner("engine-thread")
         if not 0 <= seq < self.num_slots:
             raise ValueError(f"seq {seq} out of range 0..{self.num_slots - 1}")
         if seq in self._free_rows:
@@ -467,6 +477,7 @@ class PagedKVPool:
         Overlapping exports of the same blocks are fine — pins nest via
         the refcount. Call on the engine thread (``call_in_loop``); read
         ``cache`` wherever; release on the engine thread again."""
+        check_owner("engine-thread")
         if self.prefix is None:
             raise ValueError("export_blocks requires prefix_cache=True "
                              "(chain keys are the transfer addresses)")
@@ -492,6 +503,7 @@ class PagedKVPool:
         """Unpin an export's blocks (refcount--; zero retires registered
         blocks to the prefix LRU). Exactly once per export — a double
         release would corrupt refcounts, so it raises instead."""
+        check_owner("engine-thread")
         if export.released:
             raise ValueError("KV export already released (double release "
                              "would double-decrement block refcounts)")
@@ -525,6 +537,7 @@ class PagedKVPool:
         adopt-after-evict safe: a re-transfer simply re-installs. Runs out
         of arena space → stops at a chain prefix (``skipped`` counts the
         rest). Engine-thread only."""
+        check_owner("engine-thread")
         import numpy as np
 
         if self.prefix is None:

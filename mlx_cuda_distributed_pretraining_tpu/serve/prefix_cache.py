@@ -41,6 +41,8 @@ import hashlib
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..analysis.sync_runtime import check_owner
+
 __all__ = ["PrefixCache", "chain_keys"]
 
 
@@ -66,8 +68,12 @@ def chain_keys(token_ids: Sequence[int], block_size: int,
     return keys
 
 
-class PrefixCache:
-    """Key↔block map + LRU retire list + counters (host-side only)."""
+class PrefixCache:  # graftsync: owner=engine-thread
+    """Key↔block map + LRU retire list + counters (host-side only).
+
+    Unlocked by design: the owning pool is engine-thread-owned, and every
+    mutator here runs inside a pool mutator. ``check_owner`` asserts that
+    under ``GRAFTSYNC_RUNTIME=1`` (no-op otherwise)."""
 
     def __init__(self, block_size: int, min_hit_blocks: int = 1):
         if block_size < 1:
@@ -134,6 +140,7 @@ class PrefixCache:
         """Publish ``block`` under ``key``. False (no-op) when the key is
         already held — the first writer wins and the duplicate block
         stays private (frees through the plain free list)."""
+        check_owner("engine-thread")
         if key in self._by_key:
             return False
         self._by_key[key] = block
@@ -167,6 +174,7 @@ class PrefixCache:
     def evict_lru(self) -> Optional[int]:
         """Reclaim the least-recently-retired cached block for reuse:
         unpublish its key and hand it back as an ordinary free block."""
+        check_owner("engine-thread")
         if not self._lru:
             return None
         block, _ = self._lru.popitem(last=False)
@@ -177,6 +185,7 @@ class PrefixCache:
 
     def drop(self, block: int) -> None:
         """Unpublish a block without counting an eviction (pool reset)."""
+        check_owner("engine-thread")
         key = self._key_of.pop(block, None)
         if key is not None:
             self._by_key.pop(key, None)

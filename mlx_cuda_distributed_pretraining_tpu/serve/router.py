@@ -76,36 +76,53 @@ class _Ring:
 
 
 class Replica:
-    """Router-side view of one engine replica (no model state here)."""
+    """Router-side view of one engine replica (no model state here).
+
+    Mutable fields are shared between the poller thread, the HTTP
+    handler threads, and the fleet supervisor, so each instance carries
+    its own ``lock``. Hold it only around field reads/writes — never
+    across ``urlopen`` or any other blocking call — and never acquire
+    ``Router._lock`` while holding it (the consistent order is router
+    lock first, replica lock second)."""
 
     def __init__(self, rid: str, url: str, role: str = "any"):
         self.id = rid
         self.url = url.rstrip("/")
         self.role = role          # fleet pool: "prefill" | "decode" | "any"
-        self.up = True            # optimistic until a probe/dispatch fails
-        self.stale = False        # /metrics scrape slow; stats are old but
-        #                           the replica is NOT dead (keep routing)
-        self.scrape_timeouts = 0  # consecutive slow scrapes
-        self.draining = False     # finishing in-flight, admitting nothing
-        self.canary = False       # freshly swapped weights, gated traffic
-        self.queue_depth = 0
-        self.occupancy = 0
-        self.inflight = 0         # router-side: requests currently forwarded
-        self.kv_blocks_free: Optional[int] = None
-        self.kv_num_blocks: Optional[int] = None
-        self.kv_free_watermark: Optional[int] = None
-        self.params_version = 0
-        self.ok_count = 0         # responses fully delivered through us
-        self.err_count = 0        # dead / broken-stream / http-error
-        self.last_error: Optional[str] = None
+        self.lock = threading.Lock()
+        self.up = True            # graftsync: guarded-by=self.lock
+        #                           (optimistic until a probe/dispatch fails)
+        # /metrics scrape slow; stats are old but the replica is NOT dead
+        # (keep routing)
+        self.stale = False        # graftsync: guarded-by=self.lock
+        # consecutive slow scrapes
+        self.scrape_timeouts = 0  # graftsync: guarded-by=self.lock
+        # finishing in-flight, admitting nothing
+        self.draining = False     # graftsync: guarded-by=self.lock
+        # freshly swapped weights, gated traffic
+        self.canary = False       # graftsync: guarded-by=self.lock
+        self.queue_depth = 0      # graftsync: guarded-by=self.lock
+        self.occupancy = 0        # graftsync: guarded-by=self.lock
+        # router-side: requests currently forwarded
+        self.inflight = 0         # graftsync: guarded-by=self.lock
+        self.kv_blocks_free: Optional[int] = None  # graftsync: guarded-by=self.lock
+        self.kv_num_blocks: Optional[int] = None  # graftsync: guarded-by=self.lock
+        self.kv_free_watermark: Optional[int] = None  # graftsync: guarded-by=self.lock
+        self.params_version = 0   # graftsync: guarded-by=self.lock
+        # responses fully delivered through us
+        self.ok_count = 0         # graftsync: guarded-by=self.lock
+        # dead / broken-stream / http-error
+        self.err_count = 0        # graftsync: guarded-by=self.lock
+        self.last_error: Optional[str] = None  # graftsync: guarded-by=self.lock
 
     @property
     def load(self) -> int:
         """Dispatch-ordering load: replica queue + what we just sent it."""
-        return self.queue_depth + self.inflight
+        with self.lock:
+            return self.queue_depth + self.inflight
 
-    @property
-    def state(self) -> str:
+    def _state_locked(self) -> str:
+        """State label; caller holds ``self.lock``."""
         if not self.up:
             return "down"
         if self.draining:
@@ -116,16 +133,24 @@ class Replica:
             return "stale"
         return "active"
 
+    @property
+    def state(self) -> str:
+        with self.lock:
+            return self._state_locked()
+
     def snapshot(self) -> Dict[str, object]:
-        return {"url": self.url, "up": self.up, "role": self.role,
-                "state": self.state,
-                "queue_depth": self.queue_depth, "inflight": self.inflight,
-                "occupancy": self.occupancy,
-                "params_version": self.params_version,
-                "ok": self.ok_count, "err": self.err_count,
-                **({"kv_blocks_free": self.kv_blocks_free}
-                   if self.kv_blocks_free is not None else {}),
-                **({"last_error": self.last_error} if self.last_error else {})}
+        with self.lock:
+            return {"url": self.url, "up": self.up, "role": self.role,
+                    "state": self._state_locked(),
+                    "queue_depth": self.queue_depth,
+                    "inflight": self.inflight,
+                    "occupancy": self.occupancy,
+                    "params_version": self.params_version,
+                    "ok": self.ok_count, "err": self.err_count,
+                    **({"kv_blocks_free": self.kv_blocks_free}
+                       if self.kv_blocks_free is not None else {}),
+                    **({"last_error": self.last_error}
+                       if self.last_error else {})}
 
 
 def _is_scrape_timeout(e: BaseException) -> bool:
@@ -160,7 +185,7 @@ class Router:
         if len(roles) != len(replica_urls):
             raise ValueError(f"{len(roles)} roles for "
                              f"{len(replica_urls)} replicas")
-        self.replicas: Dict[str, Replica] = {
+        self.replicas: Dict[str, Replica] = {  # graftsync: guarded-by=self._lock
             f"r{i}": Replica(f"r{i}", u, role=role)
             for i, (u, role) in enumerate(zip(replica_urls, roles))}
         self.affinity = affinity
@@ -247,69 +272,104 @@ class Router:
         is only declared down after ``stale_down_after`` consecutive
         silent scrapes. Connection-level failures (refused, reset, DNS)
         mean nobody is listening: down immediately."""
-        for r in list(self.replicas.values()):
+        for r in self._replica_list():
             try:
+                # The scrape runs OUTSIDE the replica lock: a slow
+                # replica must not stall every reader of its fields.
                 with urllib.request.urlopen(
                         r.url + "/metrics",
                         timeout=self.scrape_timeout_s) as resp:
                     m = json.loads(resp.read())
-                r.queue_depth = int(m.get("queue_depth", 0))
-                r.occupancy = int(m.get("batch_occupancy", 0))
-                role = m.get("role")
-                if role and r.role == "any":
-                    r.role = str(role)  # replica self-reports its pool
-                r.draining = bool(m.get("draining", False))
-                r.params_version = int(m.get("params_version", 0))
-                if "kv_blocks_free" in m:
-                    r.kv_blocks_free = int(m["kv_blocks_free"])
-                if "kv_num_blocks" in m:
-                    r.kv_num_blocks = int(m["kv_num_blocks"])
-                if "kv_free_watermark" in m:
-                    r.kv_free_watermark = int(m["kv_free_watermark"])
-                r.up = True
-                r.stale = False
-                r.scrape_timeouts = 0
-                r.last_error = None
-            except Exception as e:  # noqa: BLE001 - classified below
-                if _is_scrape_timeout(e):
-                    r.scrape_timeouts += 1
-                    r.stale = True
-                    r.last_error = f"stale: {type(e).__name__}: {e}"
-                    if r.scrape_timeouts >= self.stale_down_after:
-                        r.up = False  # silent too long: stop routing to it
-                else:
-                    r.up = False
+                parsed = {
+                    "queue_depth": int(m.get("queue_depth", 0)),
+                    "occupancy": int(m.get("batch_occupancy", 0)),
+                    "role": m.get("role"),
+                    "draining": bool(m.get("draining", False)),
+                    "params_version": int(m.get("params_version", 0)),
+                    "kv_blocks_free": (int(m["kv_blocks_free"])
+                                       if "kv_blocks_free" in m else None),
+                    "kv_num_blocks": (int(m["kv_num_blocks"])
+                                      if "kv_num_blocks" in m else None),
+                    "kv_free_watermark": (int(m["kv_free_watermark"])
+                                          if "kv_free_watermark" in m
+                                          else None),
+                }
+                with r.lock:
+                    r.queue_depth = parsed["queue_depth"]
+                    r.occupancy = parsed["occupancy"]
+                    if parsed["role"] and r.role == "any":
+                        # replica self-reports its pool
+                        r.role = str(parsed["role"])
+                    r.draining = parsed["draining"]
+                    r.params_version = parsed["params_version"]
+                    for kv_key in ("kv_blocks_free", "kv_num_blocks",
+                                   "kv_free_watermark"):
+                        if parsed[kv_key] is not None:
+                            setattr(r, kv_key, parsed[kv_key])
+                    r.up = True
                     r.stale = False
                     r.scrape_timeouts = 0
-                    r.last_error = f"{type(e).__name__}: {e}"
-            self._mg_up.set(1.0 if r.up else 0.0, replica=r.id)
-            self._mg_stale.set(1.0 if r.stale else 0.0, replica=r.id)
-            self._mg_depth.set(r.queue_depth, replica=r.id)
-            self._mg_inflight.set(r.inflight, replica=r.id)
+                    r.last_error = None
+            except Exception as e:  # noqa: BLE001 - classified below
+                with r.lock:
+                    if _is_scrape_timeout(e):
+                        r.scrape_timeouts += 1
+                        r.stale = True
+                        r.last_error = f"stale: {type(e).__name__}: {e}"
+                        if r.scrape_timeouts >= self.stale_down_after:
+                            r.up = False  # silent too long: stop routing
+                    else:
+                        r.up = False
+                        r.stale = False
+                        r.scrape_timeouts = 0
+                        r.last_error = f"{type(e).__name__}: {e}"
+            with r.lock:
+                up, stale = r.up, r.stale
+                depth, inflight = r.queue_depth, r.inflight
+            self._mg_up.set(1.0 if up else 0.0, replica=r.id)
+            self._mg_stale.set(1.0 if stale else 0.0, replica=r.id)
+            self._mg_depth.set(depth, replica=r.id)
+            self._mg_inflight.set(inflight, replica=r.id)
         self._refresh_ring()
         self._publish_pool_gauges()
 
     def _publish_pool_gauges(self) -> None:
-        pools: Dict[str, List[Replica]] = {}
-        for r in self.replicas.values():
-            pools.setdefault(r.role, []).append(r)
+        rows = []
+        for r in self._replica_list():
+            with r.lock:
+                rows.append((r.role, r.up and not r.draining,
+                             r.queue_depth, r.kv_blocks_free))
+        pools: Dict[str, list] = {}
+        for role, live, depth, kv in rows:
+            pools.setdefault(role, []).append((live, depth, kv))
         for pool, rs in pools.items():
-            live = [r for r in rs if r.up and not r.draining]
+            live = [x for x in rs if x[0]]
             self._mg_pool_up.set(len(live), pool=pool)
-            self._mg_pool_depth.set(sum(r.queue_depth for r in live),
-                                    pool=pool)
-            kv = [r.kv_blocks_free for r in live
-                  if r.kv_blocks_free is not None]
+            self._mg_pool_depth.set(sum(d for _, d, _ in live), pool=pool)
+            kv = [k for _, _, k in live if k is not None]
             if kv:
                 self._mg_pool_kv_free.set(min(kv), pool=pool)
 
     # -- membership ----------------------------------------------------------
+    def _replica_list(self) -> List[Replica]:
+        """Stable copy of the replica set (the dict is lock-guarded; the
+        Replica objects themselves carry their own locks)."""
+        with self._lock:
+            return list(self.replicas.values())
+
+    def get_replica(self, rid: str) -> Replica:
+        with self._lock:
+            return self.replicas[rid]
+
     def _refresh_ring(self) -> None:
         """Rebuild the consistent-hash ring when the PUBLISHABLE set (up,
         not draining) changed — drain unpublishes a replica so new keys
         remap (~1/N of the space) while it finishes in-flight work."""
-        want = {rid for rid, r in self.replicas.items()
-                if r.up and not r.draining}
+        want = set()
+        for r in self._replica_list():
+            with r.lock:
+                if r.up and not r.draining:
+                    want.add(r.id)
         with self._lock:
             if want != self._published:
                 self._published = want
@@ -332,11 +392,15 @@ class Router:
         self._refresh_ring()
 
     def set_draining(self, rid: str, draining: bool = True) -> None:
-        self.replicas[rid].draining = draining
+        r = self.get_replica(rid)
+        with r.lock:
+            r.draining = draining
         self._refresh_ring()
 
     def set_canary(self, rid: str, canary: bool = True) -> None:
-        self.replicas[rid].canary = canary
+        r = self.get_replica(rid)
+        with r.lock:
+            r.canary = canary
 
     # -- routing -------------------------------------------------------------
     def routing_key(self, body: dict) -> Optional[bytes]:
@@ -368,19 +432,29 @@ class Router:
         replicas admit nothing. With ``role``, only that pool's replicas
         (plus role-"any" ones) qualify."""
         with self._lock:
-            alive = [r for r in self.replicas.values()
-                     if r.up and not r.draining
-                     and (role is None or r.role in (role, "any"))]
-            if not alive:
-                return []
-            order = sorted(alive, key=lambda r: (r.load, r.id))
-            primary = self._ring.lookup(key) if key is not None else None
-            if primary is not None and primary in self.replicas:
-                p = self.replicas[primary]
-                if p in order and p.queue_depth < self.spill_depth:
-                    order.remove(p)
-                    order.insert(0, p)
-            return order
+            reps = list(self.replicas.values())
+            ring = self._ring
+        ranked = []
+        for r in reps:
+            with r.lock:
+                if r.up and not r.draining \
+                        and (role is None or r.role in (role, "any")):
+                    ranked.append((r.queue_depth + r.inflight, r))
+        if not ranked:
+            return []
+        ranked.sort(key=lambda t: (t[0], t[1].id))
+        order = [r for _, r in ranked]
+        primary = ring.lookup(key) if key is not None else None
+        if primary is not None:
+            for i, r in enumerate(order):
+                if r.id != primary:
+                    continue
+                with r.lock:
+                    depth = r.queue_depth
+                if depth < self.spill_depth:
+                    order.insert(0, order.pop(i))
+                break
+        return order
 
     # -- dispatch ------------------------------------------------------------
     def dispatch(self, path: str, body: dict,
@@ -423,14 +497,16 @@ class Router:
                     self._mc_requests.inc(replica=r.id, outcome="saturated")
                     continue
                 self._mc_requests.inc(replica=r.id, outcome="http_error")
-                r.err_count += 1
+                with r.lock:
+                    r.err_count += 1
                 raise
             except Exception as e:  # noqa: BLE001 - connection-level death
-                r.up = False
-                r.last_error = f"{type(e).__name__}: {e}"
+                with r.lock:
+                    r.up = False
+                    r.last_error = f"{type(e).__name__}: {e}"
+                    r.err_count += 1
                 self._mg_up.set(0.0, replica=r.id)
                 self._mc_requests.inc(replica=r.id, outcome="dead")
-                r.err_count += 1
                 self._mc_retries.inc()
                 continue
         if saturated is not None:
@@ -440,16 +516,24 @@ class Router:
     def retry_after(self) -> int:
         """Seconds a 429'd client should wait: scaled to the shallowest
         queue across live replicas (capped — it is a hint, not a lease)."""
-        with self._lock:
-            depths = [r.queue_depth for r in self.replicas.values() if r.up]
+        depths = []
+        for r in self._replica_list():
+            with r.lock:
+                if r.up:
+                    depths.append(r.queue_depth)
         return max(1, min(30, min(depths, default=4) // 4 + 1))
 
+    def replica_snapshots(self) -> Dict[str, Dict[str, object]]:
+        """Point-in-time view of every replica (each snapshot is taken
+        under that replica's own lock)."""
+        return {r.id: r.snapshot() for r in self._replica_list()}
+
     def health(self) -> dict:
-        ups = sum(1 for r in self.replicas.values() if r.up)
+        snaps = self.replica_snapshots()
+        ups = sum(1 for s in snaps.values() if s["up"])
         return {"status": "ok" if ups else "unavailable",
                 "role": "router", "replicas_up": ups,
-                "replicas": {r.id: r.snapshot()
-                             for r in self.replicas.values()},
+                "replicas": snaps,
                 "affinity": self.affinity}
 
 
@@ -490,8 +574,7 @@ def make_router_handler(router: Router):
             elif path == "/metrics":
                 self._reply(200, {
                     "role": "router",
-                    "replicas": {r.id: r.snapshot()
-                                 for r in router.replicas.values()},
+                    "replicas": router.replica_snapshots(),
                 })
             elif path == "/trace":
                 # On-demand chrome-trace dump (?clear=1 drains the ring).
@@ -536,11 +619,13 @@ def make_router_handler(router: Router):
                 self.end_headers()
                 self.wfile.write(payload)
                 return
-            replica.inflight += 1
+            with replica.lock:
+                replica.inflight += 1
             try:
                 self._pipe(resp, replica, trace_id)
             finally:
-                replica.inflight -= 1
+                with replica.lock:
+                    replica.inflight -= 1
                 resp.close()
                 if router.tracer.enabled:
                     router.tracer.complete(
@@ -572,12 +657,14 @@ def make_router_handler(router: Router):
                         self.wfile.write(chunk)
                         self.wfile.flush()
                 router._mc_requests.inc(replica=replica.id, outcome="ok")
-                replica.ok_count += 1
+                with replica.lock:
+                    replica.ok_count += 1
             except Exception:  # noqa: BLE001 - replica died mid-stream
                 # Bytes already left for the client: cannot retry (the
                 # request would double-bill tokens); surface the break.
-                replica.up = False
-                replica.err_count += 1
+                with replica.lock:
+                    replica.up = False
+                    replica.err_count += 1
                 router._mc_requests.inc(replica=replica.id,
                                         outcome="broken_stream")
                 raise
@@ -599,7 +686,7 @@ def serve_router(router: Router, host: str = "127.0.0.1",
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("--replicas", required=True,
+    p.add_argument("--replicas", dest="replica_urls", required=True,
                    help="comma-separated replica base URLs "
                         "(http://host:port of infer.server processes)")
     p.add_argument("--host", default="127.0.0.1")
@@ -626,13 +713,13 @@ def main(argv=None) -> int:
                    help="fraction of requests traced (deterministic by "
                         "trace id, so router and replicas agree)")
     a = p.parse_args(argv)
-    router = Router([u for u in a.replicas.split(",") if u],
+    router = Router([u for u in a.replica_urls.split(",") if u],
                     affinity=a.affinity, block_size=a.block_size,
                     spill_depth=a.spill_depth,
                     poll_interval_s=a.poll_interval, retries=a.retries,
                     trace=a.trace, trace_sample=a.trace_sample)
     httpd = serve_router(router, a.host, a.port)
-    print(f"router over {len(router.replicas)} replicas "
+    print(f"router over {len(router.replica_snapshots())} replicas "
           f"on http://{a.host}:{httpd.server_address[1]}")
     try:
         httpd.serve_forever()

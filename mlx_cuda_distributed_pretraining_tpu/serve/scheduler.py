@@ -116,15 +116,15 @@ class Request:
 class Scheduler:
     def __init__(self, max_queue: int = 32):
         self.max_queue = max_queue
-        self.queue: Deque[Request] = deque()
-        self.running: Dict[int, Request] = {}  # slot -> request
+        self.queue: Deque[Request] = deque()  # graftsync: guarded-by=self.lock
+        self.running: Dict[int, Request] = {}  # graftsync: guarded-by=self.lock
         self.lock = threading.Lock()
         # monotonically increasing counters (metrics)
-        self.admitted = 0
-        self.rejected = 0
-        self.evicted = 0
-        self.completed = 0
-        self.preempted = 0
+        self.admitted = 0  # graftsync: guarded-by=self.lock
+        self.rejected = 0  # graftsync: guarded-by=self.lock
+        self.evicted = 0  # graftsync: guarded-by=self.lock
+        self.completed = 0  # graftsync: guarded-by=self.lock
+        self.preempted = 0  # graftsync: guarded-by=self.lock
 
     # -- admission -----------------------------------------------------------
     def submit(self, req: Request) -> Request:
@@ -179,6 +179,17 @@ class Scheduler:
     def queue_depth(self) -> int:
         with self.lock:
             return len(self.queue)
+
+    def counters(self) -> Dict[str, int]:
+        """Consistent snapshot of the monotonic counters + queue depth,
+        taken under the scheduler lock. The engine's metrics paths read
+        this instead of the raw attributes — those are guarded, and the
+        HTTP threads calling ``/metrics`` race the engine otherwise."""
+        with self.lock:
+            return {"admitted": self.admitted, "rejected": self.rejected,
+                    "evicted": self.evicted, "completed": self.completed,
+                    "preempted": self.preempted,
+                    "queue_depth": len(self.queue)}
 
     # -- leave ---------------------------------------------------------------
     def expire(self, pool, now: Optional[float] = None) -> List[Request]:

@@ -138,7 +138,7 @@ class Supervisor:
         self.heartbeat_file = heartbeat_path(run_dir, self.process_index)
         self.events_file = events_path(run_dir)
         self.restarts = 0
-        self.hangs = 0
+        self.hangs = 0  # graftsync: owner=hang-watchdog
         # Fleet generation of the CURRENT launch. 0 = not launched yet;
         # the run loop converges on the real number before every spawn
         # (joining an in-flight generation on the first pass, bumping past
@@ -146,8 +146,8 @@ class Supervisor:
         self.generation = 0
         self._child: Optional[subprocess.Popen] = None
         self._shutdown_signal: Optional[int] = None
-        self._hang_fired = False
-        self._peer_restart_fired = False
+        self._hang_fired = False  # graftsync: owner=hang-watchdog
+        self._peer_restart_fired = False  # graftsync: owner=hang-watchdog
         # Wall clock of the last known step progress of a dead child —
         # the anchor for the restart-lost goodput booked at relaunch.
         self._restart_anchor: Optional[float] = None
@@ -198,7 +198,8 @@ class Supervisor:
         return {"process_index": idx, "step": hb.get("step"),
                 "age_s": round(max(0.0, time.time() - float(hb.get("t", 0.0))), 3)}
 
-    def _watch_child(self, child: subprocess.Popen, spawned_at: float,
+    def _watch_child(self, child: subprocess.Popen,  # graftsync: owner=hang-watchdog
+                     spawned_at: float,
                      stop_evt: threading.Event) -> None:
         """Poll the heartbeat and (multi-host) the fleet restart marker;
         SIGTERM-then-SIGKILL the child once it has made no step progress
@@ -347,8 +348,11 @@ class Supervisor:
                             resume=tag, restarts=self.restarts,
                             generation=self.generation)
                     self._restart_anchor = None
-                self._hang_fired = False
-                self._peer_restart_fired = False
+                # Safe off-thread reset: the previous generation's watchdog
+                # was joined above (or never started), and this one has not
+                # spawned yet — no watchdog is alive to race these flags.
+                self._hang_fired = False  # graftsync: disable=sync-owned-attr
+                self._peer_restart_fired = False  # graftsync: disable=sync-owned-attr
                 child_env = dict(self.env if self.env is not None
                                  else os.environ)
                 child_env[ELASTIC_GENERATION_ENV] = str(self.generation)

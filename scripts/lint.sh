@@ -1,19 +1,25 @@
 #!/bin/sh
-# graftlint + graftaudit wrapper: static analysis over the package.
+# graftlint + graftsync + graftaudit wrapper: static analysis over the package.
 #
-#   scripts/lint.sh                 # AST lint + compiled-program audit
+#   scripts/lint.sh                 # AST lint + sync lint + compiled audit
 #   scripts/lint.sh path/to/file.py # lint specific paths (audit still runs)
-#   scripts/lint.sh --format json   # machine-readable findings (both tools)
+#   scripts/lint.sh --format json   # machine-readable findings (all tools)
 #
 # Exit codes: 0 clean (modulo baselines), nonzero otherwise.
-# Stage 1 (graftlint) is pure-AST source analysis; stage 2 (graftaudit)
-# AOT-lowers the real train/serve/decode programs of the sample config on
-# CPU and audits the jaxpr/HLO — donation gaps, collective census vs the
-# committed budget, fp32 creep, captured constants, replicated params.
-# LINT_AUDIT=0 skips stage 2 (e.g. while iterating on a broken model).
+# Stage 1 (graftlint) is pure-AST source analysis; stage 2 (graftsync)
+# checks the concurrency contracts — thread-ownership annotations,
+# guarded-by lock discipline, blocking calls under locks, lock-order
+# cycles; stage 3 (graftaudit) AOT-lowers the real train/serve/decode
+# programs of the sample config on CPU and audits the jaxpr/HLO —
+# donation gaps, collective census vs the committed budget, fp32 creep,
+# captured constants, replicated params.
+# LINT_SYNC=0 skips stage 2; LINT_AUDIT=0 skips stage 3.
 set -eu
 cd "$(dirname "$0")/.."
 JAX_PLATFORMS=cpu python -m mlx_cuda_distributed_pretraining_tpu.analysis.lint "$@"
+if [ "${LINT_SYNC:-1}" != "0" ]; then
+    JAX_PLATFORMS=cpu python -m mlx_cuda_distributed_pretraining_tpu.analysis.sync "$@"
+fi
 # Audit flags don't pass through (lint takes paths, audit takes --config);
 # run `python -m mlx_cuda_distributed_pretraining_tpu.analysis.audit` for those.
 if [ "${LINT_AUDIT:-1}" != "0" ]; then

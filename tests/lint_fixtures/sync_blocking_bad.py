@@ -1,0 +1,26 @@
+"""Fixture: blocking calls while holding a lock (bad) — a sleep and a
+queue get directly in the critical section, and one reached through a
+local helper."""
+
+import queue
+import threading
+import time
+
+_lock = threading.Lock()
+_q = queue.Queue()
+
+
+def drain():
+    with _lock:
+        time.sleep(0.1)  # BAD
+        item = _q.get()  # BAD
+    return item
+
+
+def _fetch():
+    return _q.get()
+
+
+def indirect():
+    with _lock:
+        return _fetch()  # BAD: reaches _q.get with the lock held

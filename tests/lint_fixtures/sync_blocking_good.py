@@ -1,0 +1,18 @@
+"""Fixture: blocking work hoisted out of the critical section (good) —
+the lock only covers the in-memory bookkeeping."""
+
+import queue
+import threading
+import time
+
+_lock = threading.Lock()
+_q = queue.Queue()
+_items = []
+
+
+def drain():
+    item = _q.get()
+    time.sleep(0.1)
+    with _lock:
+        _items.append(item)
+    return item

@@ -1,0 +1,13 @@
+"""Fixture: blocking-under-lock acknowledged in place (a one-time
+build step that deliberately serializes behind the lock)."""
+
+import subprocess
+import threading
+
+_lock = threading.Lock()
+
+
+def build_once():
+    with _lock:
+        # first caller builds; later callers wait for the artifact
+        subprocess.run(["true"])  # graftsync: disable=sync-blocking-under-lock

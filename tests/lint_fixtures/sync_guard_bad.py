@@ -1,0 +1,22 @@
+"""Fixture: guarded attribute accessed without its lock (bad) — once via
+``self`` inside the class, once via an outside reference (the required
+lock name follows the access base: ``c.count`` needs ``with c._lock``).
+"""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0  # graftsync: guarded-by=self._lock
+
+    def inc(self):
+        self.count += 1  # BAD: read-modify-write outside the lock
+
+    def value(self):
+        return self.count  # BAD: unguarded read
+
+
+def bump(c):
+    c.count += 1  # BAD: outside reference, no lock

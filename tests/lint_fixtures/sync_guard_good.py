@@ -1,0 +1,22 @@
+"""Fixture: every guarded access holds the lock (good)."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0  # graftsync: guarded-by=self._lock
+
+    def inc(self):
+        with self._lock:
+            self.count += 1
+
+    def value(self):
+        with self._lock:
+            return self.count
+
+
+def bump(c):
+    with c._lock:
+        c.count += 1

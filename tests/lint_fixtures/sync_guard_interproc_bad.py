@@ -1,0 +1,21 @@
+"""Fixture: interprocedural guarded access (bad) — the helper touches
+the guarded list without the lock, and one of its call sites doesn't
+hold it either, so the helper's access fires."""
+
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []  # graftsync: guarded-by=self._lock
+
+    def _append(self, x):
+        self.items.append(x)  # BAD: unlocked_add calls this bare
+
+    def locked_add(self, x):
+        with self._lock:
+            self._append(x)
+
+    def unlocked_add(self, x):
+        self._append(x)

@@ -1,0 +1,26 @@
+"""Fixture: interprocedural guarded access (good) — the helper itself is
+lock-free but every call site (two hops up) holds the lock."""
+
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []  # graftsync: guarded-by=self._lock
+
+    def _append(self, x):
+        self.items.append(x)
+
+    def _add_twice(self, x):
+        self._append(x)
+        self._append(x)
+
+    def locked_add(self, x):
+        with self._lock:
+            self._append(x)
+
+    def locked_bulk(self, xs):
+        with self._lock:
+            for x in xs:
+                self._add_twice(x)

@@ -1,0 +1,18 @@
+"""Fixture: unguarded access acknowledged in place (a single aligned
+read the author deems racy-but-benign)."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0  # graftsync: guarded-by=self._lock
+
+    def inc(self):
+        with self._lock:
+            self.count += 1
+
+    def peek(self):
+        # monotonic advisory read; staleness is fine for display
+        return self.count  # graftsync: disable=sync-guard

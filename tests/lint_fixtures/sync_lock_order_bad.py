@@ -1,0 +1,26 @@
+"""Fixture: three-lock acquisition cycle (bad) — A<B, B<C, C<A can
+deadlock three threads; the acquisition graph has a cycle."""
+
+import threading
+
+A = threading.Lock()
+B = threading.Lock()
+C = threading.Lock()
+
+
+def ab():
+    with A:
+        with B:
+            pass
+
+
+def bc():
+    with B:
+        with C:
+            pass
+
+
+def ca():
+    with C:
+        with A:
+            pass

@@ -1,0 +1,29 @@
+"""Fixture: consistent acquisition order (good) — every path takes the
+locks in the A < B < C order, including through a local helper."""
+
+import threading
+
+A = threading.Lock()
+B = threading.Lock()
+C = threading.Lock()
+
+
+def _with_c():
+    with C:
+        pass
+
+
+def ab():
+    with A:
+        with B:
+            pass
+
+
+def bc():
+    with B:
+        _with_c()
+
+
+def ac():
+    with A:
+        _with_c()

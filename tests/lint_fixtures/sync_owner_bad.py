@@ -1,0 +1,28 @@
+"""Fixture: thread-owned attribute mutated off the owner thread (bad).
+
+``swap_params`` runs on whatever thread calls it, but ``params`` and
+``iterations`` are owned by the engine thread — the mutation must go
+through ``call_in_loop``.
+"""
+
+
+class Engine:
+    def __init__(self):
+        self.params = {}  # graftsync: owner=engine-thread
+        self.iterations = 0  # graftsync: owner=engine-thread
+        self._tasks = []
+
+    def call_in_loop(self, fn):
+        self._tasks.append(fn)
+
+    def _loop(self):  # graftsync: owner=engine-thread
+        self._step()
+
+    def _step(self):
+        self.iterations += 1  # fine: reachable from the owner entry
+
+    def swap_params(self, new):
+        self.params = new  # BAD: caller-thread write to an owned attr
+
+    def reset(self):
+        self.iterations = 0  # BAD: not reachable from _loop

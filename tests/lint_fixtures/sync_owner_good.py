@@ -1,0 +1,26 @@
+"""Fixture: owned-attribute mutations funneled through call_in_loop
+(good) — both the lambda and the named-closure form are exempt."""
+
+
+class Engine:
+    def __init__(self):
+        self.params = {}  # graftsync: owner=engine-thread
+        self.iterations = 0  # graftsync: owner=engine-thread
+        self._tasks = []
+
+    def call_in_loop(self, fn):
+        self._tasks.append(fn)
+
+    def _loop(self):  # graftsync: owner=engine-thread
+        self._step()
+
+    def _step(self):
+        self.iterations += 1
+
+    def swap_params(self, new):
+        self.call_in_loop(lambda: setattr(self, "params", new))
+
+    def reset(self):
+        def _do():
+            self.iterations = 0
+        self.call_in_loop(_do)

@@ -1,0 +1,14 @@
+"""Fixture: off-owner mutation acknowledged in place — the suppression
+silences the finding but the runner still counts it."""
+
+
+class Engine:
+    def __init__(self):
+        self.params = {}  # graftsync: owner=engine-thread
+
+    def _loop(self):  # graftsync: owner=engine-thread
+        pass
+
+    def swap_params(self, new):
+        # loop not running yet in this phase; caller owns the object
+        self.params = new  # graftsync: disable=sync-owned-attr

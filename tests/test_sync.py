@@ -1,0 +1,532 @@
+"""graftsync gate + rule behavior + runtime-shim interleaving tests.
+
+Three jobs:
+  1. Gate the package: the merged tree must produce ZERO non-baselined
+     sync findings, and every baselined finding must carry a real reason
+     (mirrors the graftlint gate in test_lint.py).
+  2. Pin rule behavior: each concurrency rule fires at exact
+     (rule, line) positions in its bad fixture, stays silent on its good
+     fixture, and is silenced (but counted) by inline suppression.
+  3. Enforce the contracts dynamically: a deterministic two-thread
+     interleaving harness drives the engine-owned KV pool and prefix
+     cache from a "wrong" thread — the runtime shim must catch the
+     direct call, and the call_in_loop-style funnel must pass with
+     exact refcounts. Plus a regression pinning the metrics registry's
+     lock discipline under interleaved writers.
+
+The fixture files under tests/lint_fixtures/ are analyzed as text,
+never imported.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from mlx_cuda_distributed_pretraining_tpu.analysis import (
+    SYNC_SUPPRESS_RE,
+    all_sync_rules,
+    lint_file,
+    load_baseline,
+    package_lock_edges,
+    package_ownership,
+)
+from mlx_cuda_distributed_pretraining_tpu.analysis import sync_runtime
+from mlx_cuda_distributed_pretraining_tpu.analysis.sync import (
+    default_sync_baseline_path,
+    run_sync,
+)
+from mlx_cuda_distributed_pretraining_tpu.analysis.sync_runtime import (
+    SyncMonitor,
+    SyncViolation,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "mlx_cuda_distributed_pretraining_tpu")
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+
+EXPECTED_SYNC_RULE_IDS = {
+    "sync-owned-attr",
+    "sync-guard",
+    "sync-blocking-under-lock",
+    "sync-lock-order",
+}
+
+
+def _hits(path):
+    """(active findings, suppressed findings) for one fixture file."""
+    return lint_file(os.path.join(FIXTURES, path),
+                     rules=all_sync_rules(), suppress_re=SYNC_SUPPRESS_RE)
+
+
+def _rule_lines(findings, rule):
+    return sorted(f.line for f in findings if f.rule == rule)
+
+
+# -- the gate ---------------------------------------------------------------
+
+def test_registry_has_all_sync_rules():
+    assert set(all_sync_rules()) == EXPECTED_SYNC_RULE_IDS
+
+
+def test_package_has_no_new_sync_findings():
+    """The CI gate: the merged tree must be clean modulo the baseline."""
+    baseline = load_baseline(default_sync_baseline_path())
+    result = run_sync([PKG], baseline=baseline)
+    assert not result.new, "new graftsync findings:\n" + "\n".join(
+        f"  {f.path}:{f.line}: [{f.rule}] {f.message}" for f in result.new)
+
+
+def test_every_sync_baseline_entry_has_a_reason():
+    entries = load_baseline(default_sync_baseline_path())
+    assert entries, "sync_baseline.json should exist with triaged entries"
+    for e in entries:
+        reason = (e.get("reason") or "").strip()
+        assert reason, f"baseline entry without reason: {e}"
+        assert "REPLACE with a one-line justification" not in reason, (
+            f"placeholder reason left in baseline: {e['path']}:{e['line']}")
+
+
+def test_sync_baseline_entries_all_still_match():
+    baseline = load_baseline(default_sync_baseline_path())
+    result = run_sync([PKG], baseline=baseline)
+    assert not result.stale_baseline, (
+        "stale sync-baseline entries (fix was made — prune them):\n"
+        + "\n".join(f"  {e.get('path')}:{e.get('line')}: [{e.get('rule')}]"
+                    for e in result.stale_baseline))
+
+
+def test_package_ownership_covers_the_engine_domain():
+    """The annotations the runtime shim enforces actually exist."""
+    owners = package_ownership()
+    eng = owners.get("engine-thread")
+    assert eng, f"no engine-thread ownership derived: {sorted(owners)}"
+    assert "SlotKVPool" in eng["classes"]
+    assert "PagedKVPool" in eng["classes"]
+    assert "PrefixCache" in eng["classes"]
+
+
+# -- per-rule fixtures: bad fires at exact lines ----------------------------
+
+@pytest.mark.parametrize("fixture,rule,lines", [
+    ("sync_owner_bad.py", "sync-owned-attr", [25, 28]),
+    ("sync_guard_bad.py", "sync-guard", [15, 18, 22]),
+    ("sync_guard_interproc_bad.py", "sync-guard", [14]),
+    ("sync_blocking_bad.py", "sync-blocking-under-lock", [15, 16, 26]),
+    ("sync_lock_order_bad.py", "sync-lock-order", [12]),
+])
+def test_bad_fixture_fires_at_exact_lines(fixture, rule, lines):
+    active, _ = _hits(fixture)
+    assert _rule_lines(active, rule) == lines, (
+        f"{fixture}: expected {rule} at {lines}, got "
+        f"{[(f.rule, f.line) for f in active]}")
+
+
+def test_lock_order_cycle_names_all_three_locks():
+    active, _ = _hits("sync_lock_order_bad.py")
+    assert len(active) == 1, [(f.rule, f.line) for f in active]
+    msg = active[0].message
+    for lock in ("<module>.A", "<module>.B", "<module>.C"):
+        assert lock in msg, msg
+
+
+@pytest.mark.parametrize("fixture", [
+    "sync_owner_good.py",
+    "sync_guard_good.py",
+    "sync_guard_interproc_good.py",
+    "sync_blocking_good.py",
+    "sync_lock_order_good.py",
+])
+def test_good_fixture_is_clean(fixture):
+    active, suppressed = _hits(fixture)
+    assert not active, [(f.rule, f.line, f.message) for f in active]
+    assert not suppressed, "good fixtures must not rely on suppressions"
+
+
+@pytest.mark.parametrize("fixture,rule,line", [
+    ("sync_owner_suppressed.py", "sync-owned-attr", 14),
+    ("sync_guard_suppressed.py", "sync-guard", 18),
+    ("sync_blocking_suppressed.py", "sync-blocking-under-lock", 13),
+])
+def test_suppression_silences_but_counts(fixture, rule, line):
+    active, suppressed = _hits(fixture)
+    assert not active, [(f.rule, f.line) for f in active]
+    assert [(f.rule, f.line) for f in suppressed] == [(rule, line)]
+
+
+def test_graftlint_suppressions_do_not_silence_sync_rules():
+    """The two tools carry separate comment tags: a `# graftlint:
+    disable=` comment must not blanket-silence a concurrency finding."""
+    assert SYNC_SUPPRESS_RE.search("# graftsync: disable=sync-guard")
+    assert not SYNC_SUPPRESS_RE.search("# graftlint: disable=sync-guard")
+
+
+# -- CLI contract -----------------------------------------------------------
+
+def _run_cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m",
+         "mlx_cuda_distributed_pretraining_tpu.analysis.sync", *args],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120)
+
+
+def test_cli_exit_zero_on_package():
+    proc = _run_cli(PKG)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_exit_one_on_bad_fixture_and_json_shape():
+    proc = _run_cli("--format", "json", "--no-baseline",
+                    os.path.join(FIXTURES, "sync_guard_bad.py"))
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["tool"] == "graftsync"
+    assert {f["rule"] for f in doc["new"]} == {"sync-guard"}
+    assert sorted(f["line"] for f in doc["new"]) == [15, 18, 22]
+    for key in ("baselined", "suppressed", "stale_baseline"):
+        assert key in doc
+
+
+def test_cli_exit_two_on_missing_path():
+    proc = _run_cli(os.path.join(FIXTURES, "does_not_exist.py"))
+    assert proc.returncode == 2
+
+
+def test_cli_list_rules_names_every_rule():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    for rule_id in EXPECTED_SYNC_RULE_IDS:
+        assert rule_id in proc.stdout
+
+
+# -- runtime shim: ownership + lock order -----------------------------------
+
+@pytest.fixture
+def monitor():
+    """A fresh armed monitor; always disarmed afterwards so the shim
+    stays a no-op for every other test in the session."""
+    mon = sync_runtime.activate(SyncMonitor())
+    yield mon
+    sync_runtime.deactivate()
+
+
+def test_shim_is_noop_when_disarmed():
+    assert sync_runtime.active() is None
+    sync_runtime.bind("engine-thread")        # must not raise or arm
+    sync_runtime.check_owner("engine-thread")
+    assert sync_runtime.active() is None
+
+
+def test_check_owner_enforces_binding_thread(monitor):
+    monitor.bind("engine-thread")
+    monitor.check_owner("engine-thread")      # owner thread: fine
+    monitor.check_owner("never-bound")        # unclaimed domain: fine
+    caught = []
+
+    def intruder():
+        try:
+            monitor.check_owner("engine-thread")
+        except SyncViolation as e:
+            caught.append(e)
+
+    t = threading.Thread(target=intruder, name="intruder")
+    t.start()
+    t.join(timeout=5.0)
+    assert len(caught) == 1
+    assert "engine-thread" in str(caught[0])
+    assert monitor.violations
+
+
+def test_lock_order_inversion_raises_not_deadlocks():
+    """A monitor seeded with the static edge A->B must refuse B-then-A
+    at the acquisition site — on the FIRST inverted interleaving, not
+    the unlucky one that actually deadlocks."""
+    mon = SyncMonitor(static_order=[("A", "B")])
+    a = mon.wrap_lock("A")
+    b = mon.wrap_lock("B")
+    with a:
+        with b:
+            pass  # consistent with the static order
+    with pytest.raises(SyncViolation, match="lock-order violation"):
+        with b:
+            with a:
+                pass
+
+
+def test_lock_order_learned_dynamically():
+    """Edges observed at run time count too: A-then-B in one thread
+    forbids B-then-A later even with no static seed."""
+    mon = SyncMonitor()
+    a, b = mon.wrap_lock("A"), mon.wrap_lock("B")
+    with a:
+        with b:
+            pass
+    with pytest.raises(SyncViolation):
+        with b:
+            with a:
+                pass
+
+
+def test_activate_seeds_from_static_edges():
+    """activate() with no monitor derives its seed graph from the
+    package's statically extracted acquisition edges."""
+    edges = package_lock_edges()
+    try:
+        mon = sync_runtime.activate()
+        assert sync_runtime.active() is mon
+        for src, dst, _path, _line in edges:
+            assert dst in mon._graph.get(src, set())
+    finally:
+        sync_runtime.deactivate()
+
+
+# -- deterministic two-thread interleaving harness --------------------------
+
+class Interleave:
+    """Run two actors' steps in an exact, scripted order.
+
+    Each actor is a REAL thread (thread identity is what the ownership
+    shim checks) but only ever runs the single step the driver releases,
+    so every schedule is reproducible. Exceptions are captured per
+    actor; the driver re-joins both threads before returning."""
+
+    def __init__(self, steps_a, steps_b):
+        self._steps = {"a": list(steps_a), "b": list(steps_b)}
+        self._go = {"a": threading.Event(), "b": threading.Event()}
+        self._done = threading.Event()
+        self.errors = {"a": [], "b": []}
+        self._threads = {
+            name: threading.Thread(target=self._actor, args=(name,),
+                                   name=f"interleave-{name}", daemon=True)
+            for name in ("a", "b")}
+
+    def _actor(self, name):
+        for step in self._steps[name]:
+            self._go[name].wait()
+            self._go[name].clear()
+            try:
+                step()
+            except Exception as e:  # noqa: BLE001 - delivered to driver
+                self.errors[name].append(e)
+            self._done.set()
+
+    def run(self, order):
+        """``order`` is a string over {'a','b'}: which actor executes its
+        next step at each point. Must consume every step exactly once."""
+        assert sorted(order) == sorted("a" * len(self._steps["a"])
+                                       + "b" * len(self._steps["b"]))
+        for t in self._threads.values():
+            t.start()
+        for name in order:
+            self._done.clear()
+            self._go[name].set()
+            assert self._done.wait(timeout=10.0), f"step of '{name}' hung"
+        for t in self._threads.values():
+            t.join(timeout=10.0)
+        return self
+
+
+class Funnel:
+    """Minimal call_in_loop stand-in: closures enqueued by any thread,
+    drained only by the owner actor's steps (exceptions re-raise at the
+    submitting call's ``result()``)."""
+
+    def __init__(self):
+        self._items = []
+        self._lock = threading.Lock()
+
+    def submit(self, fn):
+        box = {}
+        with self._lock:
+            self._items.append((fn, box))
+        return box
+
+    def drain(self):
+        with self._lock:
+            items, self._items = self._items, []
+        for fn, box in items:
+            try:
+                box["result"] = fn()
+            except Exception as e:  # noqa: BLE001 - delivered to caller
+                box["error"] = e
+
+
+def _result(box):
+    if "error" in box:
+        raise box["error"]
+    return box["result"]
+
+
+def _paged_pool():
+    jax = pytest.importorskip("jax")
+    del jax
+    from mlx_cuda_distributed_pretraining_tpu.models.llama import LlamaArgs
+    from mlx_cuda_distributed_pretraining_tpu.serve import PagedKVPool
+
+    args = LlamaArgs(vocab_size=64, hidden_size=16, intermediate_size=32,
+                     num_layers=1, num_heads=2, num_kv_heads=1, head_dim=8,
+                     max_position_embeddings=128)
+    return PagedKVPool(args, num_seqs=2, max_len=128, block_size=32,
+                       num_blocks=8, prefix_cache=True)
+
+
+@pytest.mark.slow
+def test_kv_pool_direct_offthread_call_is_caught(monitor):
+    """Seeded violation: an 'HTTP handler' actor frees an engine-owned
+    slot directly. The shim must raise BEFORE any bookkeeping mutates —
+    refcounts stay exactly as the engine left them."""
+    pool = _paged_pool()
+    ids = list(range(64))  # two full blocks
+    state = {}
+
+    def a_alloc():
+        sync_runtime.bind("engine-thread")
+        state["seq"] = pool.allocate(need_tokens=64, token_ids=ids)
+        pool.lengths[state["seq"]] = 64
+        pool.register_upto(state["seq"], ids)
+        state["blocks"] = [int(pool.tables[state["seq"], i])
+                           for i in range(2)]
+
+    def b_free_direct():
+        pool.free(state["seq"])  # wrong thread, no funnel
+
+    def a_check():
+        assert all(pool._ref[b] == 1 for b in state["blocks"])
+
+    il = Interleave([a_alloc, a_check], [b_free_direct]).run("aba")
+    assert not il.errors["a"], il.errors["a"]
+    assert len(il.errors["b"]) == 1
+    assert isinstance(il.errors["b"][0], SyncViolation)
+    assert "engine-thread" in str(il.errors["b"][0])
+
+
+@pytest.mark.slow
+def test_kv_pool_export_adopt_free_refcounts_through_funnel(monitor):
+    """Fixed code passes: the same off-thread actor routes every pool
+    mutation through the owner funnel; refcounts are exact at every
+    interleaving point (export pins +1, free drops the row's ref,
+    release retires to the prefix LRU)."""
+    pool = _paged_pool()
+    ids = list(range(64))
+    funnel = Funnel()
+    state = {}
+
+    def a_alloc():
+        sync_runtime.bind("engine-thread")
+        seq = pool.allocate(need_tokens=64, token_ids=ids)
+        pool.lengths[seq] = 64
+        pool.register_upto(seq, ids)
+        state["seq"] = seq
+        state["blocks"] = [int(pool.tables[seq, i]) for i in range(2)]
+
+    def b_submit_export():
+        state["export_box"] = funnel.submit(
+            lambda: pool.export_blocks(ids))
+
+    def a_drain():
+        funnel.drain()
+
+    def b_check_export():
+        export = _result(state["export_box"])
+        state["export"] = export
+        assert export.blocks == state["blocks"]
+        # live row + export pin
+        assert all(pool._ref[b] == 2 for b in export.blocks)
+        state["free_box"] = funnel.submit(
+            lambda: pool.free(state["seq"]))
+
+    def b_release():
+        assert _result(state["free_box"]) is None
+        # export pin only, row gone
+        assert all(pool._ref[b] == 1 for b in state["export"].blocks)
+        state["rel_box"] = funnel.submit(
+            lambda: pool.release_export(state["export"]))
+
+    def a_final_check():
+        assert "error" not in state["rel_box"]
+        # refcount 0 and registered: retired to the prefix LRU, adoptable
+        assert all(pool._ref[b] == 0 for b in state["export"].blocks)
+        assert pool.prefix.retired_blocks == 2
+
+    il = Interleave(
+        [a_alloc, a_drain, a_drain, a_drain, a_final_check],
+        [b_submit_export, b_check_export, b_release],
+    ).run("abababaa")
+    assert not il.errors["a"], il.errors["a"]
+    assert not il.errors["b"], il.errors["b"]
+
+
+@pytest.mark.slow
+def test_prefix_cache_register_evict_interleaved(monitor):
+    """PrefixCache mutators are engine-owned: direct off-thread register
+    raises; the funneled register/evict sequence lands exact counts."""
+    from mlx_cuda_distributed_pretraining_tpu.serve.prefix_cache import (
+        PrefixCache,
+    )
+
+    cache = PrefixCache(block_size=32)
+    funnel = Funnel()
+    state = {}
+
+    def a_bind():
+        sync_runtime.bind("engine-thread")
+        assert cache.register(b"k0", 1)
+        cache.retire(1)
+
+    def b_direct_register():
+        cache.register(b"k1", 2)  # wrong thread
+
+    def b_funneled():
+        state["reg"] = funnel.submit(lambda: cache.register(b"k1", 2))
+        state["evict"] = funnel.submit(cache.evict_lru)
+
+    def a_drain():
+        funnel.drain()
+
+    def a_check():
+        assert _result(state["reg"]) is True
+        assert _result(state["evict"]) == 1  # LRU end: the k0 block
+        assert cache.cached_blocks == 1      # k1 remains
+        assert cache.evictions == 1
+
+    il = Interleave([a_bind, a_drain, a_check],
+                    [b_direct_register, b_funneled]).run("abbaa")
+    assert not il.errors["a"], il.errors["a"]
+    assert len(il.errors["b"]) == 1
+    assert isinstance(il.errors["b"][0], SyncViolation)
+
+
+def test_metrics_registry_interleaved_writers_exact_totals():
+    """Regression for the metrics lock discipline: counter increments
+    and histogram observations from two interleaved threads must land
+    exactly — the registry's single lock covers every RMW (bucket
+    increments, sums, counts, series creation)."""
+    from mlx_cuda_distributed_pretraining_tpu.obs.metrics import (
+        MetricsRegistry,
+    )
+
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", "test counter")
+    h = reg.histogram("t_lat", "test histogram", buckets=(0.5, 1.5))
+    n = 200
+
+    def writer():
+        for i in range(n):
+            c.inc()
+            h.observe(i % 2)  # alternates the two finite buckets
+
+    threads = [threading.Thread(target=writer) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    assert c.value() == 2 * n
+    snap = reg.snapshot()["t_lat"]["series"][0]
+    assert snap["count"] == 2 * n
+    assert snap["buckets"][-1] == ["+Inf", 2 * n]
+    # cumulative: n zeros in the 0.5 bucket, everything by 1.5
+    assert snap["buckets"][0] == [0.5, n]
+    assert snap["buckets"][1] == [1.5, 2 * n]
