@@ -1352,6 +1352,169 @@ def bench_serve_fleet_case(name="serve_fleet"):
     }
 
 
+def bench_serve_chaos_case(name="serve_chaos"):
+    """graftchaos drill: a 1 prefill + 1 decode fleet under a mixed flood
+    while the fault plane (serve/faults.py) tears at it — the decode
+    replica's connections refused for a window (injected kill), a KV
+    push corrupted and another dropped, /metrics scrapes timing out.
+
+    Everything runs IN-PROCESS (engines, services, router) so one armed
+    rule set covers every hop, and the drill replays deterministically.
+    The acceptance bars are robustness, not speed: every request must
+    complete or cleanly 429/504 (zero hung, zero transport errors
+    surfaced to clients), greedy seeded output must be byte-identical
+    before vs after the chaos window (wrong-token check), the decode
+    replica's circuit breaker must transition open -> recovered, and
+    decode-class TTFT p99 must stay within 3x + 0.5s of the fault-free
+    flood on the same fleet."""
+    import importlib.util
+    import os
+    import threading
+
+    import jax
+
+    from mlx_cuda_distributed_pretraining_tpu.config import DataConfig
+    from mlx_cuda_distributed_pretraining_tpu.infer.server import (
+        InferenceService,
+        request_generate,
+        serve,
+    )
+    from mlx_cuda_distributed_pretraining_tpu.models import llama
+    from mlx_cuda_distributed_pretraining_tpu.serve import (
+        BatchEngine,
+        EngineConfig,
+        FleetRouter,
+        PolicyConfig,
+        faults,
+        serve_router,
+    )
+    from mlx_cuda_distributed_pretraining_tpu.tokenizer import TokenizerManager
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    spec = importlib.util.spec_from_file_location(
+        "load_gen", os.path.join(repo, "scripts", "load_gen.py"))
+    load_gen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(load_gen)
+
+    MIX = "prefill-heavy:decode-heavy"
+    SHAPES = {"prefill-heavy": (192, 8), "decode-heavy": (16, 48)}
+    FLOOD, CONC = 24, 6
+
+    tok = TokenizerManager(DataConfig())
+    args = llama.LlamaArgs(vocab_size=tok.vocab_size,
+                           max_position_embeddings=256,
+                           **SCALES["2m"]["shape"])
+    params = llama.init_params(jax.random.PRNGKey(0), args)
+
+    def replica(role):
+        svc = InferenceService(params, args, tok, run_name="chaos")
+        svc.engine = BatchEngine(
+            params, args, tok,
+            EngineConfig(num_slots=8, max_len=256, prefill_chunk=64,
+                         max_queue=128, kv_backend="paged", block_size=32,
+                         prefix_cache=True, role=role)).start()
+        httpd = serve(svc, port=0)
+        return svc, httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    faults.reset()
+    pre_svc, pre_httpd, pre_url = replica("prefill")
+    dec_svc, dec_httpd, dec_url = replica("decode")
+    # 128: prefill-heavy prompts (~192 bytes) hand their KV off — the
+    # corrupt/drop faults need real pushes to bite — while decode-heavy
+    # ones (~16 bytes) prefill locally.
+    router = FleetRouter([pre_url], [dec_url], poll_interval_s=0.2,
+                         handoff_min_prompt_bytes=128,
+                         policy=PolicyConfig(breaker_open_s=0.5))
+    rhttpd = serve_router(router, port=0)
+    rurl = f"http://127.0.0.1:{rhttpd.server_address[1]}"
+
+    def flood():
+        return load_gen.run_load(
+            rurl, concurrency=CONC, requests=FLOOD, prompt="",
+            max_tokens=8, temperature=0.0, deadline_s=30.0,
+            timeout=600.0, mix=MIX, mix_shapes=SHAPES)
+
+    def await_breaker(state, budget_s=8.0):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < budget_s:
+            if router.policy.breaker_state(dec_url) == state:
+                return True
+            time.sleep(0.02)
+        return False
+
+    PARITY = {"prompt": "chaos parity probe: the fleet must answer the "
+                        "same tokens before and after the storm",
+              "max_tokens": 16, "temperature": 0.0, "seed": 7}
+    try:
+        # Warm every compile variant, then the fault-free reference run.
+        load_gen.run_load(rurl, concurrency=2, requests=4, prompt="",
+                          max_tokens=8, temperature=0.0, deadline_s=None,
+                          timeout=600.0, mix=MIX, mix_shapes=SHAPES)
+        text_before = request_generate(rurl, timeout=120.0, **PARITY)["text"]
+        clean = flood()
+
+        # Chaos window. The KV faults fire inside the prefill service's
+        # push (same process, same registry); the HTTP faults fire at the
+        # router's egress choke point against the decode replica.
+        faults.inject("kv_transfer.corrupt", nth=1)
+        faults.inject("kv_transfer.drop", nth=1)
+        faults.inject("scrape.timeout", every=3, times=3,
+                      match=dec_url + "/metrics")
+        result = {}
+        t = threading.Thread(target=lambda: result.update(chaos=flood()))
+        t.start()
+        time.sleep(0.3)  # flood in flight before the replica "dies"
+        # times=30: KV pushes to the dead replica ALSO match (they feed
+        # kv_transfer's own policy, not the router's), so the window
+        # must outlast that dilution for the router-side scrape stream
+        # alone to reach the breaker threshold.
+        kill = faults.inject("http.connect_refused", times=30, every=1,
+                             match=dec_url)
+        breaker_opened = await_breaker("open")
+        breaker_recovered = await_breaker("closed", budget_s=15.0)
+        t.join()
+        chaos = result["chaos"]
+        fault_fires = faults.counts()
+        faults.reset()
+        text_after = request_generate(rurl, timeout=120.0, **PARITY)["text"]
+    finally:
+        faults.reset()
+        rhttpd.shutdown()
+        rhttpd.server_close()
+        router.stop()
+        for svc, httpd in ((pre_svc, pre_httpd), (dec_svc, dec_httpd)):
+            httpd.shutdown()
+            httpd.server_close()
+            svc.close()
+
+    def dec_p99(s):
+        v = s["mix"]["decode-heavy"]["ttft_p99_s"]
+        return v if v is not None else 0.0
+
+    out = chaos["outcomes"]
+    no_hung = chaos["completed"] == FLOOD
+    all_clean = out["ok"] + out["429"] + out["504"] == FLOOD
+    parity = text_before == text_after
+    ttft_bound_s = round(3.0 * dec_p99(clean) + 0.5, 3)
+    ttft_ok = dec_p99(chaos) <= ttft_bound_s
+    return {
+        "case": name, "requests": FLOOD, "concurrency": CONC, "mix": MIX,
+        "outcomes": out, "outcomes_clean": clean["outcomes"],
+        "fault_fires": fault_fires, "replica_kill_fires": kill.fires,
+        "no_hung_requests": bool(no_hung),
+        "all_clean_status": bool(all_clean),
+        "token_parity": bool(parity),
+        "breaker_opened": bool(breaker_opened),
+        "breaker_recovered": bool(breaker_recovered),
+        "decode_ttft_p99_s_clean": dec_p99(clean),
+        "decode_ttft_p99_s_chaos": dec_p99(chaos),
+        "decode_ttft_p99_bound_s": ttft_bound_s,
+        "ttft_within_bound": bool(ttft_ok),
+        "bar_met": bool(no_hung and all_clean and parity and breaker_opened
+                        and breaker_recovered and ttft_ok),
+    }
+
+
 _SERVE_TP_WORKER = """
 import json, sys, time
 sys.path.insert(0, {repo!r})
@@ -2288,6 +2451,12 @@ def build_plan(vocab, steps):
         # a mixed flood — bar is decode-class TTFT p99 (isolation) plus
         # a zero-failed live canary weight swap mid-flood.
         ("serve_fleet", "serve", lambda: bench_serve_fleet_case(), 420),
+        # serve_chaos: graftchaos fault drill — mixed flood through an
+        # in-process fleet while injected faults kill the decode replica,
+        # corrupt/drop KV pushes, and stall scrapes; bar is zero hung /
+        # unclean requests, token parity across the storm, and breaker
+        # open -> recovered.
+        ("serve_chaos", "serve", lambda: bench_serve_chaos_case(), 420),
         # serve_tp: GSPMD tensor-parallel engine, tp=2 vs tp=1 on two
         # forced host devices — token-identical greedy, unchanged
         # per-step host-sync count, layout-overhead tok/s + TTFT.

@@ -39,6 +39,7 @@ from __future__ import annotations
 import argparse
 import json
 import queue as queue_mod
+import struct
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -46,6 +47,7 @@ from typing import Optional
 
 from ..models import llama
 from ..obs.trace import TRACE_HEADER
+from ..serve.policy import Deadline
 from ..serve.scheduler import QueueFullError
 from .generate import generate_text
 
@@ -259,7 +261,13 @@ class InferenceService:
                                  deadline_s=(float(dl) if dl is not None
                                              else None),
                                  trace_id=trace_id, prefill_only=True)
-        if not req.wait(timeout=float(body.get("timeout_s", 300.0))):
+        # The host-side wait is derived from the request's own budget
+        # when the caller did not pin one: waiting longer than the
+        # deadline the engine will evict at just burns a handler thread.
+        wait_s = body.get("timeout_s")
+        if wait_s is None:
+            wait_s = float(dl) + 5.0 if dl is not None else 300.0
+        if not req.wait(timeout=float(wait_s)):
             raise TimeoutError("prefill did not complete in time")
         if req.error is not None:
             raise TimeoutError(req.error)
@@ -277,7 +285,16 @@ class InferenceService:
             from ..serve.kv_transfer import push_payload
 
             t0 = time.perf_counter()
-            stats = push_payload(target, payload, trace_id=trace_id)
+            try:
+                stats = push_payload(target, payload, trace_id=trace_id)
+            except Exception as e:  # noqa: BLE001 - degradation, not death
+                # Ladder rung 2: a failed push is an OPTIMIZATION lost,
+                # never an error surfaced to the client — the decode
+                # replica cache-misses and prefills locally. Count it and
+                # report the prefill as done.
+                self.engine.note_kv_failure("push")
+                out["transfer_error"] = f"{type(e).__name__}: {e}"
+                return out
             dur = time.perf_counter() - t0
             if self.engine.tracer.enabled:
                 # The span that joins the two replicas' trees in
@@ -292,13 +309,36 @@ class InferenceService:
 
     def adopt_kv(self, data: bytes, trace_id: Optional[str] = None) -> dict:
         """POST /adopt_kv: install a pushed KV payload into this
-        replica's arena (decode side of the handoff)."""
+        replica's arena (decode side of the handoff). A payload that
+        fails the integrity gate is refused (400) AND its claimed chain
+        keys are quarantined out of the prefix cache — cached blocks a
+        corrupt sender vouched for must not serve future admissions."""
         if self.engine is None:
             raise ValueError("/adopt_kv requires --engine batch")
         from ..serve.kv_transfer import KVTransferPayload
 
-        payload = KVTransferPayload.from_bytes(data)
+        try:
+            payload = KVTransferPayload.from_bytes(data)
+        except ValueError:
+            self._quarantine_claimed_keys(data)
+            raise
         return self.engine.adopt_kv(payload, trace_id=trace_id)
+
+    def _quarantine_claimed_keys(self, data: bytes) -> None:
+        """Best-effort: pull the chain keys a refused payload CLAIMED
+        from its (possibly damaged) header and drop them from the prefix
+        cache. Unparseable headers still count the failure."""
+        keys = []
+        try:
+            (hlen,) = struct.unpack_from("<I", data, 4)
+            header = json.loads(data[8:8 + hlen].decode())
+            keys = [bytes.fromhex(k) for k in header.get("keys", [])]
+        except Exception:  # noqa: BLE001 - header itself may be the damage
+            pass
+        if keys:
+            self.engine.quarantine_kv(keys, reason="corrupt")
+        else:
+            self.engine.note_kv_failure("corrupt")
 
     def swap_weights(self, body: dict) -> dict:
         """POST /admin/swap_weights: reshard a checkpoint straight into
@@ -398,6 +438,23 @@ def make_handler(service: InferenceService):
             self.wfile.write(b"data: " + json.dumps(obj).encode() + b"\n\n")
             self.wfile.flush()
 
+        def _deadline_s(self, body: dict) -> Optional[float]:
+            """Effective request budget in seconds. An upstream
+            ``X-Deadline-Ms`` (stamped by the router/fleet policy layer)
+            is end-to-end: it wins over — or tightens — the body's own
+            ``deadline_s``. A budget already spent raises immediately
+            (-> 504) instead of admitting work the scheduler will only
+            evict."""
+            dl = body.get("deadline_s")
+            local = float(dl) if dl is not None else None
+            d = Deadline.from_header(self.headers)
+            if d is None:
+                return local
+            rem = d.remaining_s()
+            if rem <= 0.0:
+                raise TimeoutError("deadline exhausted before admission")
+            return min(local, rem) if local is not None else rem
+
         def _stream_generate(self, req: dict, prompt: str,
                              effective_max: int,
                              deadline_s: Optional[float],
@@ -423,11 +480,17 @@ def make_handler(service: InferenceService):
                 self._sse({"done": True, **out})
                 return
             self._sse_begin()
+            # Inter-token gap bound derived from the request's own budget
+            # (the engine evicts at the deadline, so the queue resolves
+            # shortly after it — waiting 600s for a 2s request is a hung
+            # handler thread, exactly what graceful degradation forbids).
+            gap_s = (deadline_s + 30.0 if deadline_s is not None
+                     else 600.0)
             toks: list = []
             prev = ""
             while True:
                 try:
-                    tok = sreq.stream_q.get(timeout=600.0)
+                    tok = sreq.stream_q.get(timeout=gap_s)
                 except queue_mod.Empty:
                     self._sse({"done": True, "error": "stream timeout"})
                     return
@@ -522,6 +585,9 @@ def make_handler(service: InferenceService):
                     if not isinstance(body, dict) or "prompt" not in body:
                         raise ValueError(
                             "body must be a JSON object with 'prompt'")
+                    eff = self._deadline_s(body)
+                    if eff is not None:
+                        body["deadline_s"] = eff
                     self._reply(200, service.prefill_handoff(
                         body, trace_id=self.headers.get(TRACE_HEADER)))
                 except QueueFullError as e:
@@ -552,14 +618,13 @@ def make_handler(service: InferenceService):
                 effective_max = max(
                     1, min(int(req.get("max_tokens", 64)),
                            service.max_tokens_limit))
-                dl = req.get("deadline_s")
+                dl_s = self._deadline_s(req)
                 # Router-minted (or client-supplied) trace id: the engine
                 # keys this request's spans by it.
                 trace_id = self.headers.get(TRACE_HEADER)
                 if req.get("stream"):
                     self._stream_generate(req, prompt, effective_max,
-                                          float(dl) if dl is not None
-                                          else None, trace_id=trace_id)
+                                          dl_s, trace_id=trace_id)
                     return
                 out = service.generate(
                     prompt=prompt,
@@ -569,7 +634,7 @@ def make_handler(service: InferenceService):
                     min_p=float(req.get("min_p", 0.0)),
                     repetition_penalty=float(rp) if rp is not None else None,
                     seed=int(req.get("seed", 0)),
-                    deadline_s=float(dl) if dl is not None else None,
+                    deadline_s=dl_s,
                     trace_id=trace_id,
                 )
                 if path == "/v1/completions":

@@ -221,6 +221,22 @@ class MetricsRegistry:
             out["_dropped_series"] = self._dropped
         return out
 
+    def sum_series(self, name: str, **labels) -> float:
+        """Sum a counter/gauge across every series whose labels include
+        ``labels`` (subset match; no labels = all series). The chaos
+        harness asserts totals like "all injected faults fired" without
+        enumerating label combinations."""
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None or m.kind == "histogram":
+                return 0.0
+            want = {(k, str(v)) for k, v in labels.items()}
+            total = 0.0
+            for key, s in m._series.items():
+                if want <= set(key):
+                    total += s.value
+            return total
+
     def flat(self) -> Dict[str, float]:
         """Label-flattened scalar view for the stats WebSocket hub: gauges
         and counters only, keys ``name`` or ``name{k=v,...}``."""

@@ -34,19 +34,41 @@ many requests concurrently from ONE compiled decode step:
   prefixes cross the wire at most once, receivers verify the chain);
 - ``fleet``     — disaggregated prefill/decode pools over the router:
   KV handoff dispatch, heartbeat membership, queue/KV-pressure
-  autoscaling, graceful drain, and canary-gated rolling weight swaps.
+  autoscaling, graceful drain, and canary-gated rolling weight swaps;
+- ``faults``    — deterministic fault injection for the serving plane
+  (graftchaos): named points over ONE HTTP egress choke point plus
+  engine-side hooks, armed by tests and chaos drills;
+- ``policy``    — the unified outbound-call policy every serving-plane
+  HTTP call rides: end-to-end ``X-Deadline-Ms`` deadlines, capped
+  jittered backoff, per-replica retry budgets and circuit breakers.
 """
 
+from . import faults
 from .engine import BatchEngine, EngineConfig, QueueFullError
 from .fleet import FleetConfig, FleetController, FleetRouter
 from .kv_pool import KVExport, PagedKVPool, SlotKVPool
 from .kv_transfer import KVTransferPayload
+from .policy import (
+    DEADLINE_HEADER,
+    AdmissionRefusedError,
+    BreakerOpenError,
+    CallPolicy,
+    Deadline,
+    DeadlineExceeded,
+    PolicyConfig,
+)
 from .prefix_cache import PrefixCache
 from .router import Router, serve_router
 from .scheduler import Request, Scheduler
 
 __all__ = [
+    "AdmissionRefusedError",
     "BatchEngine",
+    "BreakerOpenError",
+    "CallPolicy",
+    "DEADLINE_HEADER",
+    "Deadline",
+    "DeadlineExceeded",
     "EngineConfig",
     "FleetConfig",
     "FleetController",
@@ -54,11 +76,13 @@ __all__ = [
     "KVExport",
     "KVTransferPayload",
     "PagedKVPool",
+    "PolicyConfig",
     "PrefixCache",
     "QueueFullError",
     "Request",
     "Router",
     "Scheduler",
     "SlotKVPool",
+    "faults",
     "serve_router",
 ]

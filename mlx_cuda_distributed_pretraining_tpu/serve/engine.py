@@ -29,7 +29,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from . import batch_step
+from . import batch_step, faults
 from ..analysis import sync_runtime
 from .kv_pool import PagedKVPool, SlotKVPool
 from .scheduler import (
@@ -58,6 +58,10 @@ class EngineConfig:
     num_blocks: int = 0         # paged: KV arena size; 0 = slotted-equivalent
     spec_draft_len: int = 0     # paged: drafts verified per decode step; 0 off
     spec_max_ngram: int = 3     # paged: prompt-lookup suffix n-gram bound
+    # Degradation ladder rung 1: below this free-block fraction the next
+    # decode step runs without speculation (draft tokens burn arena blocks
+    # for speculative positions; under pressure certainty beats speed).
+    spec_off_kv_free_frac: float = 0.05
     prefix_cache: bool = True   # paged: content-hash block reuse (off = oracle)
     prefix_min_hit_blocks: int = 1  # shortest cached chain worth adopting
     default_deadline_s: Optional[float] = None  # per-request unless overridden
@@ -152,6 +156,7 @@ class BatchEngine:
         self.draft_len = (max(0, int(self.cfg.spec_draft_len))
                           if self.cfg.kv_backend == "paged" else 0)
         self.scheduler = Scheduler(max_queue=self.cfg.max_queue)
+        self.scheduler.concurrency = self.cfg.num_slots
         self.chunk = max(1, min(self.cfg.prefill_chunk, self.cfg.max_len))
         self._stop = threading.Event()
         self._wake = threading.Event()
@@ -242,8 +247,14 @@ class BatchEngine:
             "(exported/adopted/reused)")
         self._mc_swaps = reg.counter(
             "serve_weight_swaps_total", "weight swaps applied in place")
+        self._mc_kv_fail = reg.counter(
+            "serve_kv_transfer_failures_total",
+            "refused/failed KV transfers by reason "
+            "(corrupt/mismatch/push/adopt)")
         self._spec_proposed = 0  # graftsync: owner=engine-thread
         self._spec_accepted = 0  # graftsync: owner=engine-thread
+        # decode steps that ran unspeculated under arena pressure
+        self._spec_off_steps = 0  # graftsync: owner=engine-thread
         self._m_last = {  # graftsync: owner=engine-thread
             "admitted": 0, "rejected": 0, "evicted": 0,
             "completed": 0, "preempted": 0, "iterations": 0,
@@ -354,6 +365,11 @@ class BatchEngine:
         pointer over between two iterations. Requests straddling the
         cutover decode their remaining tokens on the new weights; nothing
         is evicted, nothing fails. Returns the new params_version."""
+        if faults.take("engine.swap_fail", self.cfg.worker_id) is not None:
+            # Before any placement or cutover: a failed swap must leave
+            # the serving weights untouched (the rolling-swap driver's
+            # canary/rollback path handles the error).
+            raise RuntimeError("injected swap failure")
         placed = (self._place_params(new_params, self.mesh)
                   if self.mesh is not None else new_params)
 
@@ -414,6 +430,24 @@ class BatchEngine:
         if self.tracer.enabled:
             self.tracer.instant("kv_adopt", trace_id=trace_id, **stats)
         return stats
+
+    def quarantine_kv(self, keys, reason: str = "corrupt") -> int:
+        """Degradation ladder rung 2: a refused/corrupt transfer's chain
+        keys are unpublished from the local prefix cache (kv_pool
+        .quarantine) so a poisoned chain can never be adopted by later
+        prompts — the request that needed those blocks falls back to
+        local prefill. Bumps ``serve_kv_transfer_failures_total{reason}``
+        and returns the number of keys actually dropped."""
+        self._mc_kv_fail.inc(reason=reason)
+        pool = self.pool
+        if pool.kind != "paged" or getattr(pool, "prefix", None) is None:
+            return 0
+        return self.call_in_loop(lambda: pool.quarantine(list(keys)))
+
+    def note_kv_failure(self, reason: str) -> None:
+        """Count a KV-transfer failure with nothing local to quarantine
+        (e.g. the prefill side's push died)."""
+        self._mc_kv_fail.inc(reason=reason)
 
     def warmup(self, prompt_ids: Optional[List[int]] = None) -> None:
         """Pay the prefill/decode jit compiles before traffic arrives."""
@@ -483,14 +517,27 @@ class BatchEngine:
         self._wake.set()
         return req
 
+    # Grace past the engine deadline before the caller forces eviction:
+    # the engine's own expiry normally fires first (this is the backstop).
+    WAIT_GRACE_S = 5.0
+
     def generate(self, prompt: str, max_tokens: int = 64,
                  temperature: float = 0.0, seed: int = 0,
                  deadline_s: Optional[float] = None,
-                 timeout: float = 600.0,
+                 timeout: Optional[float] = None,
                  trace_id: Optional[str] = None) -> dict:
-        """Blocking convenience used by the HTTP front end."""
+        """Blocking convenience used by the HTTP front end.
+
+        The caller-side wait derives from the request's own deadline
+        (explicit ``deadline_s`` or the engine default) plus a short
+        grace — a 5s-deadline request must never park its HTTP thread
+        for the old fixed 600s. An explicit ``timeout`` still wins."""
         req = self.submit(prompt, max_tokens, temperature, seed, deadline_s,
                           trace_id=trace_id)
+        if timeout is None:
+            eff = deadline_s if deadline_s is not None \
+                else self.cfg.default_deadline_s
+            timeout = eff + self.WAIT_GRACE_S if eff is not None else 600.0
         if not req.wait(timeout):
             req.deadline = 0.0  # force eviction next iteration
             self._wake.set()
@@ -535,7 +582,13 @@ class BatchEngine:
                 "spec_accepted": self._spec_accepted,
                 "spec_acceptance_rate": round(
                     self._spec_accepted / max(self._spec_proposed, 1), 4),
+                "spec_off_steps": self._spec_off_steps,
             })
+        # Injected-fault fires (graftchaos): absent entirely when nothing
+        # ever fired, so injection-off metrics are byte-identical.
+        fc = faults.counts()
+        if fc:
+            snap["faults_injected"] = fc
         prefix = getattr(self.pool, "prefix", None)
         snap["prefix_cache"] = prefix is not None
         if prefix is not None:
@@ -823,7 +876,11 @@ class BatchEngine:
         i = 0
         while i < len(active):
             r = active[i]
-            if pool.ensure_capacity(r.slot, pool.lengths[r.slot] + S):
+            # arena.exhaust: exercise the preemption/degradation path
+            # without actually filling device memory.
+            forced = faults.take("arena.exhaust") is not None
+            if not forced and pool.ensure_capacity(
+                    r.slot, pool.lengths[r.slot] + S):
                 i += 1
                 continue
             victim = active.pop()
@@ -831,13 +888,29 @@ class BatchEngine:
             # victim == r: it was the youngest itself; it re-queues.
         return active
 
+    def _effective_draft_len(self) -> int:
+        """Speculation for the NEXT decode step: configured draft length,
+        or 0 when paged free blocks dip under ``spec_off_kv_free_frac``
+        (degradation ladder rung 1 — a verify window maps draft_len extra
+        positions per row, exactly the blocks a pressured arena lacks;
+        an unspeculated step is slower but never preempts for drafts)."""
+        k = self.draft_len
+        if not k:
+            return 0
+        pool = self.pool
+        if pool.free_blocks < self.cfg.spec_off_kv_free_frac \
+                * max(pool.num_blocks, 1):
+            self._spec_off_steps += 1
+            return 0
+        return k
+
     def _decode_paged(self, dec: List[Request]) -> None:
         import jax
 
         from ..infer.generate import _prompt_lookup_draft
 
         pool, cfg = self.pool, self.cfg
-        k = self.draft_len
+        k = self._effective_draft_len()
         S = k + 1
         dec = self._grow_or_preempt(dec, S)
         if not dec:

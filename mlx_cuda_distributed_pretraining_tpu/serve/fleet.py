@@ -53,12 +53,12 @@ import os
 import re
 import threading
 import time
-import urllib.request
 from dataclasses import dataclass, fields
 from typing import Callable, Dict, List, Optional
 
 from ..obs.trace import TRACE_HEADER, new_trace_id
 from ..parallel.elastic import _atomic_write_json, _read_json
+from .policy import Deadline
 from .router import Replica, Router, _hash64, serve_router
 
 __all__ = ["FleetConfig", "FleetRouter", "FleetController",
@@ -260,27 +260,36 @@ class FleetRouter(Router):
                 and len(prompt.encode()) >= self.handoff_min_prompt_bytes)
 
     def _handoff(self, pre: Replica, dec: Replica, body: dict,
-                 trace_id: str) -> Optional[dict]:
+                 trace_id: str,
+                 deadline: Optional[Deadline] = None) -> Optional[dict]:
         """Best-effort prefill + KV push ahead of the decode dispatch.
         Returns the prefill replica's summary, or None on any failure —
-        the decode replica then prefills locally (slower, never wrong)."""
+        the decode replica then prefills locally (slower, never wrong).
+        The POST rides the shared outbound-call policy (breaker gate,
+        deadline-clamped timeout + ``X-Deadline-Ms``), but with a single
+        attempt: retrying a best-effort optimization wastes budget the
+        decode dispatch may still need."""
+        timeout_s = self.prefill_timeout_s
+        if deadline is not None:
+            # The replica-side wait must not outlive the caller's budget.
+            timeout_s = min(timeout_s, max(deadline.remaining_s(), 0.01))
         payload = json.dumps({
             "prompt": body.get("prompt"),
             "transfer_to": dec.url,
-            "timeout_s": self.prefill_timeout_s,
+            "timeout_s": timeout_s,
             **({"deadline_s": body["deadline_s"]}
                if "deadline_s" in body else {}),
         }).encode()
-        req = urllib.request.Request(
-            pre.url + "/prefill", data=payload,
-            headers={"Content-Type": "application/json",
-                     TRACE_HEADER: trace_id})
         with pre.lock:
             pre.inflight += 1
         try:
-            with urllib.request.urlopen(
-                    req, timeout=self.prefill_timeout_s) as resp:
-                out = json.loads(resp.read())
+            raw = self.policy.call(
+                pre.url + "/prefill", data=payload,
+                headers={"Content-Type": "application/json",
+                         TRACE_HEADER: trace_id},
+                timeout=self.prefill_timeout_s, deadline=deadline,
+                method="POST", max_attempts=1, backoff_key=trace_id)
+            out = json.loads(raw)
             with pre.lock:
                 pre.ok_count += 1
             self._mc_handoffs.inc(outcome="ok")
@@ -296,16 +305,14 @@ class FleetRouter(Router):
                 pre.inflight -= 1
 
     # -- dispatch -------------------------------------------------------------
-    def dispatch(self, path: str, body: dict,
-                 trace_id: Optional[str] = None):
-        """Fleet dispatch: pick the decode replica FIRST (affinity +
+    def plan(self, path: str, body: dict, trace_id: str,
+             deadline: Optional[Deadline] = None) -> List[Replica]:
+        """Fleet planning: pick the decode replica FIRST (affinity +
         canary gate — the transfer target must be the dispatch target,
         or the shipped KV lands on the wrong arena), run the prefill
-        handoff against the least-loaded prefill replica, then forward
-        the original request to the decode pool through the shared
-        retry/backpressure machinery."""
-        if trace_id is None:
-            trace_id = new_trace_id()
+        handoff against the least-loaded prefill replica, then hand the
+        decode pool to the shared retry/backpressure machinery (both
+        ``dispatch`` and the HTTP handler's retrying pipe call here)."""
         key = self.routing_key(body)
         decode = self._gate_canary(self.candidates(key, role="decode"),
                                    trace_id)
@@ -313,16 +320,16 @@ class FleetRouter(Router):
             # Decode pool empty (all draining/down): degrade to the whole
             # live fleet rather than failing — prefill replicas CAN serve
             # end-to-end, they are just worse at decode.
-            return self._dispatch_to(self.candidates(key), path, body,
-                                     trace_id)
+            return self.candidates(key)
         if self._worth_handoff(path, body):
             pre = [r for r in self.candidates(key, role="prefill")
                    if r.role == "prefill"]
             if pre:
-                self._handoff(pre[0], decode[0], body, trace_id)
+                self._handoff(pre[0], decode[0], body, trace_id,
+                              deadline=deadline)
             else:
                 self._mc_handoffs.inc(outcome="skipped")
-        return self._dispatch_to(decode, path, body, trace_id)
+        return decode
 
 
 # -- lifecycle control -------------------------------------------------------
@@ -438,9 +445,13 @@ class FleetController:
         r = self.router.get_replica(rid)
         self.router.set_draining(rid, True)
         try:
-            urllib.request.urlopen(urllib.request.Request(
-                r.url + "/admin/drain", data=b"{}", method="POST",
-                headers={"Content-Type": "application/json"}), timeout=5.0)
+            # Admin calls share the outbound-call policy (breaker +
+            # fault choke point) with dispatch: a replica the breaker
+            # already knows is dead is skipped, not re-probed.
+            self.router.policy.call(
+                r.url + "/admin/drain", data=b"{}",
+                headers={"Content-Type": "application/json"},
+                timeout=5.0, method="POST", max_attempts=1)
         except Exception as e:  # noqa: BLE001 - maybe already dead
             with r.lock:
                 r.last_error = f"drain: {type(e).__name__}: {e}"
@@ -448,9 +459,8 @@ class FleetController:
                                        else self.cfg.drain_timeout_s)
         while time.monotonic() < deadline:
             try:
-                with urllib.request.urlopen(r.url + "/metrics",
-                                            timeout=2.0) as resp:
-                    m = json.loads(resp.read())
+                m = self.router.policy.call_json(
+                    r.url + "/metrics", timeout=2.0, max_attempts=1)
                 busy = (int(m.get("queue_depth", 0))
                         + int(m.get("batch_occupancy", 0)))
             except Exception:  # noqa: BLE001 - gone = drained
@@ -497,11 +507,13 @@ class FleetController:
             with r.lock:
                 ok0, err0 = r.ok_count, r.err_count
             try:
-                with urllib.request.urlopen(urllib.request.Request(
-                        r.url + "/admin/swap_weights", data=body,
-                        headers={"Content-Type": "application/json"},
-                        method="POST"), timeout=600.0) as resp:
-                    swapped = json.loads(resp.read())
+                # Through the shared policy choke point (single attempt:
+                # a swap is not idempotent transport — a failure halts
+                # the rollout instead of being silently replayed).
+                swapped = json.loads(self.router.policy.call(
+                    r.url + "/admin/swap_weights", data=body,
+                    headers={"Content-Type": "application/json"},
+                    timeout=600.0, method="POST", max_attempts=1))
             except Exception as e:  # noqa: BLE001 - halt the rollout
                 with r.lock:
                     r.last_error = f"swap: {type(e).__name__}: {e}"

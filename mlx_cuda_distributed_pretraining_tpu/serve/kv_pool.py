@@ -594,6 +594,35 @@ class PagedKVPool:  # graftsync: owner=engine-thread
         return {"adopted": adopted, "reused": reused,
                 "skipped": len(keys) - adopted - reused}
 
+    def quarantine(self, keys: Sequence[bytes]) -> int:
+        """Unpublish suspect chain keys (graftchaos degradation ladder):
+        a refused/corrupt KV transfer must not leave its keys adoptable.
+
+        Each published key is dropped from the prefix index; a retired
+        (refcount-0) block rejoins the free list immediately, while a
+        block still referenced by live rows merely loses its key — those
+        rows keep decoding on their own bytes and the block frees
+        normally when they release it (unregistered blocks free outright
+        in ``_release_block``). Unknown keys are ignored: quarantine is
+        idempotent and safe to call on a chain that never adopted.
+        Returns the number of keys actually dropped. Engine-thread only."""
+        check_owner("engine-thread")
+        if self.prefix is None:
+            return 0
+        dropped = 0
+        for key in keys:
+            b = self.prefix.lookup(key)
+            if b is None:
+                continue
+            self.prefix.drop(b)
+            if self._ref[b] == 0:
+                # Was retired on the LRU: drop() removed it from the LRU
+                # and key maps, so it must rejoin the allocatable supply
+                # here or the block leaks.
+                self._free_blocks.append(b)
+            dropped += 1
+        return dropped
+
     def _write_blocks(self, block_ids: Sequence[int], blocks_data) -> None:
         """Scatter transferred bytes into the arena: one batched
         ``.at[ids].set`` per layer tensor (a single device write each, not

@@ -32,11 +32,12 @@ import json
 import struct
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
-from urllib.request import Request, urlopen
 
 import numpy as np
 
 from ..obs.trace import TRACE_HEADER
+from . import faults
+from .policy import CallPolicy, Deadline
 from .prefix_cache import chain_keys
 
 __all__ = ["KVTransferPayload", "build_payload", "push_payload"]
@@ -154,15 +155,55 @@ def build_payload(export, token_ids: Sequence[int], block_size: int,
         keys=list(export.keys), blocks=blocks)
 
 
+def _corrupt(data: bytes) -> bytes:
+    """Same-length in-flight corruption for the ``kv_transfer.corrupt``
+    fault: flip the first hex digit of the first chain key inside the
+    JSON header, so the receiver's ``verify_keys`` refusal path fires
+    (block offsets stay valid — only the advertised address lies). When
+    the marker is absent (empty chain) the magic is clobbered instead —
+    either way the receiver must refuse, never adopt."""
+    marker = b'"keys": ["'
+    i = data.find(marker)
+    if i < 0:
+        return b"GKV0" + data[4:]
+    j = i + len(marker)
+    flipped = b"1" if data[j:j + 1] == b"0" else b"0"
+    return data[:j] + flipped + data[j + 1:]
+
+
+# Pushes made outside any service (tests, tools) share this policy; the
+# serving processes pass their own so breaker/budget state is unified
+# with the rest of their outbound calls.
+_default_policy = CallPolicy()
+
+
 def push_payload(url: str, payload: KVTransferPayload,
                  timeout: float = 30.0,
-                 trace_id: Optional[str] = None) -> Dict[str, int]:
+                 trace_id: Optional[str] = None,
+                 deadline: Optional[Deadline] = None,
+                 policy: Optional[CallPolicy] = None) -> Dict[str, int]:
     """POST a payload to a decode replica's ``/adopt_kv``; returns its
-    adopt stats (``{"adopted": n, "reused": n, "skipped": n}``)."""
+    adopt stats (``{"adopted": n, "reused": n, "skipped": n}``).
+
+    Runs under the outbound-call policy: the socket timeout is clamped
+    to the request's remaining deadline budget and a connection-level
+    failure gets at most ONE budgeted replay (KV re-transfer is cheap to
+    retry once — the receiver dedups by chain key — but must not storm a
+    sick decode replica; on final failure the caller falls back to local
+    prefill, so giving up is always safe)."""
+    data = payload.to_bytes()
+    if faults.take("kv_transfer.corrupt", url) is not None:
+        data = _corrupt(data)
+    if faults.take("kv_transfer.drop", url) is not None:
+        # Vanishes in flight but reports success: the decode side simply
+        # has a cache miss and prefills locally — token parity holds.
+        return {"adopted": 0, "reused": 0, "skipped": 0}
     headers = {"Content-Type": "application/octet-stream"}
     if trace_id:
         headers[TRACE_HEADER] = trace_id
-    req = Request(url.rstrip("/") + "/adopt_kv", data=payload.to_bytes(),
-                  headers=headers, method="POST")
-    with urlopen(req, timeout=timeout) as resp:
-        return json.loads(resp.read().decode())
+    pol = policy if policy is not None else _default_policy
+    body = pol.call(url.rstrip("/") + "/adopt_kv", data=data,
+                    headers=headers, timeout=timeout, deadline=deadline,
+                    method="POST", max_attempts=2,
+                    backoff_key=trace_id or url)
+    return json.loads(body.decode())

@@ -47,6 +47,14 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
 from ..obs.trace import TRACE_HEADER, Tracer, new_trace_id
+from . import faults
+from .policy import (
+    DEADLINE_HEADER,
+    CallPolicy,
+    Deadline,
+    DeadlineExceeded,
+    PolicyConfig,
+)
 from .prefix_cache import chain_keys
 
 __all__ = ["Router", "Replica", "serve_router"]
@@ -173,7 +181,9 @@ class Router:
                  request_timeout_s: float = 600.0,
                  scrape_timeout_s: float = 2.0,
                  stale_down_after: int = 4,
+                 first_byte_timeout_s: float = 30.0,
                  roles: Optional[List[str]] = None,
+                 policy: Optional[PolicyConfig] = None,
                  trace: bool = False, trace_sample: float = 1.0,
                  trace_capacity: int = 16384):
         if not replica_urls:
@@ -195,6 +205,11 @@ class Router:
         self.retries = max(0, retries)
         self.request_timeout_s = request_timeout_s
         self.scrape_timeout_s = scrape_timeout_s
+        # How long an accepted (non-streaming-committed) request may sit
+        # with ZERO response bytes before the router gives up on this
+        # replica and replays elsewhere (pre-first-byte failures are the
+        # retryable kind — nothing reached the client yet).
+        self.first_byte_timeout_s = first_byte_timeout_s
         # Consecutive slow scrapes tolerated before a stale replica is
         # finally declared down (it stopped proving liveness entirely).
         self.stale_down_after = max(1, stale_down_after)
@@ -239,6 +254,10 @@ class Router:
         self._mg_pool_kv_free = reg.gauge(
             "serve_router_pool_kv_blocks_free",
             "minimum free KV blocks across the pool's live replicas")
+        # Outbound-call policy (graftchaos): per-replica circuit breaker
+        # + retry budget shared by dispatch, scrapes, and (via the fleet
+        # controller) admin calls; its gauges land on this registry.
+        self.policy = CallPolicy(policy, registry=self.metrics_registry)
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "Router":
@@ -276,8 +295,10 @@ class Router:
             try:
                 # The scrape runs OUTSIDE the replica lock: a slow
                 # replica must not stall every reader of its fields.
-                with urllib.request.urlopen(
-                        r.url + "/metrics",
+                # faults.urlopen is the injection choke point (the
+                # scrape.timeout / http.* points land here too).
+                with faults.urlopen(
+                        urllib.request.Request(r.url + "/metrics"),
                         timeout=self.scrape_timeout_s) as resp:
                     m = json.loads(resp.read())
                 parsed = {
@@ -310,9 +331,13 @@ class Router:
                     r.stale = False
                     r.scrape_timeouts = 0
                     r.last_error = None
+                # The poller is the breaker's recovery path: a replica
+                # answering its scrape closes the circuit again.
+                self.policy.record(r.url, True)
             except Exception as e:  # noqa: BLE001 - classified below
+                timed_out = _is_scrape_timeout(e)
                 with r.lock:
-                    if _is_scrape_timeout(e):
+                    if timed_out:
                         r.scrape_timeouts += 1
                         r.stale = True
                         r.last_error = f"stale: {type(e).__name__}: {e}"
@@ -323,6 +348,12 @@ class Router:
                         r.stale = False
                         r.scrape_timeouts = 0
                         r.last_error = f"{type(e).__name__}: {e}"
+                if not timed_out:
+                    # Connection-level death feeds the breaker; a timeout
+                    # does NOT — slow is not dead, and tripping the
+                    # circuit on slowness would dump a healthy replica's
+                    # queue onto the rest of the fleet.
+                    self.policy.record(r.url, False)
             with r.lock:
                 up, stale = r.up, r.stale
                 depth, inflight = r.queue_depth, r.inflight
@@ -332,6 +363,7 @@ class Router:
             self._mg_inflight.set(inflight, replica=r.id)
         self._refresh_ring()
         self._publish_pool_gauges()
+        self.policy.publish()  # breaker/budget/fault gauges, once per poll
 
     def _publish_pool_gauges(self) -> None:
         rows = []
@@ -457,41 +489,86 @@ class Router:
         return order
 
     # -- dispatch ------------------------------------------------------------
+    def plan(self, path: str, body: dict, trace_id: str,
+             deadline: Optional[Deadline] = None) -> List[Replica]:
+        """The ordered candidate list one request should try. Subclasses
+        (FleetRouter) override this with role-aware planning — canary
+        gating, prefill handoff — so BOTH ``dispatch`` and the HTTP
+        handler's retrying pipe go through the same routing brain."""
+        return self.candidates(self.routing_key(body))
+
     def dispatch(self, path: str, body: dict,
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None,
+                 deadline: Optional[Deadline] = None):
         """Forward ``body`` to the best replica; returns the open HTTP
         response (caller reads/streams it) plus the replica. Connection
         failures mark the replica down and replay on the next candidate
         (idempotent: sampling is seeded); replica 429s propagate after
         every candidate rejected. ``trace_id`` (minted here when absent)
-        rides the X-Trace-Id header so replica spans join this trace."""
-        return self._dispatch_to(self.candidates(self.routing_key(body)),
-                                 path, body, trace_id)
+        rides the X-Trace-Id header so replica spans join this trace.
+        ``deadline`` clamps every socket timeout to the request's
+        remaining budget and forwards it via ``X-Deadline-Ms``."""
+        if trace_id is None:
+            trace_id = new_trace_id()
+        return self._dispatch_to(self.plan(path, body, trace_id, deadline),
+                                 path, body, trace_id, deadline=deadline)
 
     def _dispatch_to(self, cands: List[Replica], path: str, body: dict,
-                     trace_id: Optional[str] = None):
+                     trace_id: Optional[str] = None,
+                     deadline: Optional[Deadline] = None):
         """Try an ordered candidate list (the shared retry/backpressure
-        machinery under both homogeneous and fleet dispatch)."""
+        machinery under both homogeneous and fleet dispatch).
+
+        Per candidate: circuit-breaker gate (an open circuit skips the
+        replica without a connection attempt), deadline-clamped socket
+        timeout + ``X-Deadline-Ms``. A REPLAY after a connection failure
+        additionally needs a retry-budget token for the next candidate
+        and waits the capped jittered backoff — a saturation hop (429)
+        does neither: the replica answered, immediate failover is free
+        and correct."""
         if not cands:
             raise NoReplicaError("no live replica")
         if trace_id is None:
             trace_id = new_trace_id()
         data = json.dumps(body).encode()
         tried = 0
+        replay = False  # previous candidate died at the connection level
         saturated: Optional[urllib.error.HTTPError] = None
         for r in cands:
             if tried > self.retries + 1:
                 break
+            if not self.policy.allow(r.url):
+                self._mc_requests.inc(replica=r.id, outcome="breaker_open")
+                continue
+            if replay:
+                if not self.policy.try_retry(r.url):
+                    self._mc_requests.inc(replica=r.id,
+                                          outcome="retry_budget")
+                    continue
+                delay = self.policy.backoff(tried, key=trace_id)
+                if deadline is not None:
+                    delay = min(delay, max(deadline.remaining_s(), 0.0))
+                if delay > 0.0:
+                    time.sleep(delay)
             tried += 1
-            req = urllib.request.Request(
-                r.url + path, data=data,
-                headers={"Content-Type": "application/json",
-                         TRACE_HEADER: trace_id})
+            headers = {"Content-Type": "application/json",
+                       TRACE_HEADER: trace_id}
+            timeout = self.request_timeout_s
+            if deadline is not None:
+                try:
+                    timeout = deadline.clamp(timeout)
+                except DeadlineExceeded:
+                    self.policy.note_deadline_exhausted()
+                    raise
+                headers[DEADLINE_HEADER] = deadline.header_value()
+            req = urllib.request.Request(r.url + path, data=data,
+                                         headers=headers)
             try:
-                resp = urllib.request.urlopen(
-                    req, timeout=self.request_timeout_s)
+                resp = faults.urlopen(req, timeout=timeout)
+                self.policy.record(r.url, True)
                 return resp, r
             except urllib.error.HTTPError as e:
+                self.policy.record(r.url, True)  # it answered
                 if e.code == 429:  # replica queue full: try the next one
                     saturated = e
                     self._mc_requests.inc(replica=r.id, outcome="saturated")
@@ -501,6 +578,7 @@ class Router:
                     r.err_count += 1
                 raise
             except Exception as e:  # noqa: BLE001 - connection-level death
+                self.policy.record(r.url, False)
                 with r.lock:
                     r.up = False
                     r.last_error = f"{type(e).__name__}: {e}"
@@ -508,6 +586,7 @@ class Router:
                 self._mg_up.set(0.0, replica=r.id)
                 self._mc_requests.inc(replica=r.id, outcome="dead")
                 self._mc_retries.inc()
+                replay = True
                 continue
         if saturated is not None:
             raise BackpressureError(self.retry_after())
@@ -572,6 +651,24 @@ def make_router_handler(router: Router):
                 h = router.health()
                 self._reply(200 if h["replicas_up"] else 503, h)
             elif path == "/metrics":
+                # ?format=prom renders the router's own registry (request/
+                # retry counters, breaker state, retry-budget tokens, fault
+                # fires) as Prometheus text; the default JSON shape feeds
+                # the fleet poller and stays unchanged.
+                qs = urllib.parse.parse_qs(parts.query)
+                if qs.get("format", [""])[0] == "prom":
+                    from ..obs.prometheus import render_prometheus
+
+                    router.policy.publish()  # fresh gauges at scrape time
+                    body = render_prometheus(
+                        router.metrics_registry.snapshot()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 self._reply(200, {
                     "role": "router",
                     "replicas": router.replica_snapshots(),
@@ -599,17 +696,45 @@ def make_router_handler(router: Router):
             # Honor a client-supplied trace id, else mint one; the replica
             # sees it via X-Trace-Id and the client gets it echoed back.
             trace_id = self.headers.get(TRACE_HEADER) or new_trace_id()
-            t0 = time.perf_counter()
+            # End-to-end budget: an upstream X-Deadline-Ms wins, else the
+            # body's own deadline_s starts the clock at this hop.
+            deadline = Deadline.from_header(self.headers)
+            if deadline is None:
+                try:
+                    dl = float(body.get("deadline_s") or 0.0)
+                except (TypeError, ValueError):
+                    dl = 0.0
+                if dl > 0.0:
+                    deadline = Deadline.after(dl)
             try:
-                resp, replica = router.dispatch(path, body,
-                                                trace_id=trace_id)
+                cands = router.plan(path, body, trace_id, deadline)
+                try:
+                    self._dispatch_and_pipe(cands, path, body, trace_id,
+                                            deadline)
+                except NoReplicaError:
+                    # The planned candidate set can go ENTIRELY dead
+                    # mid-request (a fleet's decode pool, say) while the
+                    # wider fleet still has capacity: re-plan ONCE
+                    # against the updated liveness view — the fleet
+                    # planner then degrades to the surviving pool — and
+                    # charge the replay a retry-budget token.
+                    fresh = router.plan(path, body, trace_id, deadline)
+                    if not fresh \
+                            or [c.id for c in fresh] == [c.id for c in cands] \
+                            or not router.policy.try_retry(fresh[0].url):
+                        raise
+                    router._mc_retries.inc()
+                    self._dispatch_and_pipe(fresh, path, body, trace_id,
+                                            deadline)
             except BackpressureError as e:
                 self._reply(429, {"error": str(e)},
                             headers={"Retry-After": str(e.retry_after_s)})
-                return
             except NoReplicaError as e:
                 self._reply(503, {"error": str(e)})
-                return
+            except TimeoutError as e:
+                # DeadlineExceeded (budget spent before/while dispatching)
+                # answers 504 immediately instead of burning a replica.
+                self._reply(504, {"error": str(e) or "deadline exceeded"})
             except urllib.error.HTTPError as e:
                 # Replica-side 4xx/5xx: pass status and body through.
                 payload = e.read()
@@ -618,25 +743,95 @@ def make_router_handler(router: Router):
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)
-                return
-            with replica.lock:
-                replica.inflight += 1
-            try:
-                self._pipe(resp, replica, trace_id)
-            finally:
-                with replica.lock:
-                    replica.inflight -= 1
-                resp.close()
-                if router.tracer.enabled:
-                    router.tracer.complete(
-                        "route", time.perf_counter() - t0,
-                        trace_id=trace_id, replica=replica.id, path=path)
 
-        def _pipe(self, resp, replica, trace_id=None) -> None:
+        def _dispatch_and_pipe(self, cands, path, body, trace_id,
+                               deadline) -> None:
+            """Dispatch and forward, replaying on the next candidate as
+            long as the failed replica produced ZERO response bytes — a
+            pre-first-byte death is indistinguishable from a connection
+            failure to the client, so it is just as retryable (sampling
+            is seeded; a replayed request returns identical tokens)."""
+            while True:
+                t0 = time.perf_counter()
+                resp, replica = router._dispatch_to(cands, path, body,
+                                                    trace_id,
+                                                    deadline=deadline)
+                with replica.lock:
+                    replica.inflight += 1
+                try:
+                    delivered = self._pipe(resp, replica, trace_id,
+                                           deadline)
+                finally:
+                    with replica.lock:
+                        replica.inflight -= 1
+                    resp.close()
+                    if router.tracer.enabled:
+                        router.tracer.complete(
+                            "route", time.perf_counter() - t0,
+                            trace_id=trace_id, replica=replica.id,
+                            path=path)
+                if delivered:
+                    return
+                # Retry past the dead replica: only candidates after it
+                # remain eligible, and the replay spends a retry-budget
+                # token against the next one.
+                idx = next((i for i, c in enumerate(cands)
+                            if c.id == replica.id), None)
+                cands = cands[idx + 1:] if idx is not None else []
+                if not cands:
+                    raise NoReplicaError(
+                        "replica failed before first byte; no candidate "
+                        "left to retry")
+                if not router.policy.try_retry(cands[0].url):
+                    self._reply(502, {"error": "replica failed before "
+                                      "first byte; retry budget exhausted"})
+                    return
+                router._mc_retries.inc()
+
+        @staticmethod
+        def _set_read_timeout(resp, timeout_s) -> None:
+            """Tighten the socket read timeout of an open response (the
+            first-byte deadline). Best-effort: reaches through the
+            http.client response to the raw socket; silently a no-op on
+            exotic response objects."""
+            try:
+                resp.fp.raw._sock.settimeout(timeout_s)
+            except AttributeError:
+                pass
+
+        def _pipe(self, resp, replica, trace_id=None, deadline=None) -> bool:
             """Forward the replica response verbatim — one buffered body
-            for JSON, unbuffered chunks for SSE streams."""
+            for JSON, unbuffered chunks for SSE streams.
+
+            Nothing is sent to the client until the replica's body bytes
+            actually arrive (full body for sized responses, first chunk
+            for streams, bounded by the first-byte deadline), so a
+            replica dying BEFORE its first byte returns False — the
+            caller replays on the next candidate. After the first byte
+            is committed a failure is terminal (a replay would
+            double-bill tokens): mark down, raise."""
             ctype = resp.headers.get("Content-Type", "application/json")
             clen = resp.headers.get("Content-Length")
+            try:
+                if clen is not None:
+                    first = resp.read(int(clen))
+                else:
+                    fb = router.first_byte_timeout_s
+                    if deadline is not None:
+                        fb = min(fb, max(deadline.remaining_s(), 0.01))
+                    self._set_read_timeout(resp, fb)
+                    first = resp.read1(8192)
+                    self._set_read_timeout(resp, router.request_timeout_s)
+            except Exception as e:  # noqa: BLE001 - died with 0 bytes sent
+                with replica.lock:
+                    replica.up = False
+                    replica.err_count += 1
+                    replica.last_error = f"{type(e).__name__}: {e}"
+                router.policy.record(replica.url, False)
+                router._mg_up.set(0.0, replica=replica.id)
+                router._mc_requests.inc(replica=replica.id,
+                                        outcome="dead_prestream")
+                return False
             self.send_response(resp.status)
             self.send_header("Content-Type", ctype)
             if clen is not None:
@@ -645,9 +840,9 @@ def make_router_handler(router: Router):
                 self.send_header(TRACE_HEADER, trace_id)
             self.end_headers()
             try:
-                if clen is not None:
-                    self.wfile.write(resp.read(int(clen)))
-                else:
+                self.wfile.write(first)
+                if clen is None:
+                    self.wfile.flush()
                     # SSE: read1 returns whatever the replica has flushed
                     # (read(n) would block for a full buffer mid-stream).
                     while True:
@@ -668,6 +863,7 @@ def make_router_handler(router: Router):
                 router._mc_requests.inc(replica=replica.id,
                                         outcome="broken_stream")
                 raise
+            return True
 
     return Handler
 

@@ -40,6 +40,11 @@ class QueueFullError(Exception):
     """Admission queue at max depth — the HTTP layer returns 429."""
 
 
+# AdmissionRefusedError (policy.py) subclasses TimeoutError, so every
+# existing deadline->504 mapping covers admission refusal for free.
+from .policy import AdmissionRefusedError  # noqa: E402  (exception only)
+
+
 class Request:
     """One in-flight generation request (host-side state + waiter)."""
 
@@ -125,10 +130,39 @@ class Scheduler:
         self.evicted = 0  # graftsync: guarded-by=self.lock
         self.completed = 0  # graftsync: guarded-by=self.lock
         self.preempted = 0  # graftsync: guarded-by=self.lock
+        # deadline-unmeetable refusals at submit (graftchaos admission)
+        self.refused = 0  # graftsync: guarded-by=self.lock
+        # EWMA of admit->finish service time, warmed over the first few
+        # completions — the queue-wait estimator admission control uses.
+        self._ewma_service_s = 0.0  # graftsync: guarded-by=self.lock
+        self._ewma_n = 0  # graftsync: guarded-by=self.lock
+        # Decode batch width (the engine sets this): queued requests
+        # drain roughly `concurrency` at a time, so the wait estimate
+        # divides by it instead of assuming serial service.
+        self.concurrency = 1
+
+    EWMA_ALPHA = 0.2
+    EWMA_WARMUP = 4  # completions before the estimator gates admission
 
     # -- admission -----------------------------------------------------------
     def submit(self, req: Request) -> Request:
         with self.lock:
+            if req.deadline is not None and self._ewma_n >= self.EWMA_WARMUP:
+                # Degradation ladder rung 3: refuse a request whose
+                # deadline cannot be met at the current queue depth —
+                # a clean immediate 504 beats queueing work that will
+                # only be evicted after burning prefill compute. The
+                # estimator stays silent until warmed, so a fresh engine
+                # admits everything (already-expired deadlines then take
+                # the classic eviction path, same as before graftchaos).
+                wait_est = (len(self.queue) * self._ewma_service_s
+                            / max(self.concurrency, 1))
+                if time.monotonic() + wait_est > req.deadline:
+                    self.refused += 1
+                    raise AdmissionRefusedError(
+                        f"deadline unmeetable: ~{wait_est:.2f}s queue wait "
+                        f"({len(self.queue)} ahead) exceeds the remaining "
+                        "budget")
             if len(self.queue) >= self.max_queue:
                 self.rejected += 1
                 raise QueueFullError(
@@ -188,7 +222,7 @@ class Scheduler:
         with self.lock:
             return {"admitted": self.admitted, "rejected": self.rejected,
                     "evicted": self.evicted, "completed": self.completed,
-                    "preempted": self.preempted,
+                    "preempted": self.preempted, "refused": self.refused,
                     "queue_depth": len(self.queue)}
 
     # -- leave ---------------------------------------------------------------
@@ -243,6 +277,16 @@ class Scheduler:
                 del self.running[req.slot]
                 pool.free(req.slot)
             self.completed += 1
+            # Feed the admission estimator: slot-bound -> finished is the
+            # service time a queued request waits (per concurrency lane).
+            if req.admitted_at is not None:
+                dur = max(time.monotonic() - req.admitted_at, 0.0)
+                if self._ewma_n == 0:
+                    self._ewma_service_s = dur
+                else:
+                    self._ewma_service_s += self.EWMA_ALPHA \
+                        * (dur - self._ewma_service_s)
+                self._ewma_n += 1
         req.finish_reason = reason
 
     def drain(self, pool, error: str = "engine stopped") -> None:

@@ -1,0 +1,70 @@
+#!/usr/bin/env bash
+# Run the SERVING-plane chaos drill (graftchaos): bench.py's serve_chaos
+# case stands up an in-process disaggregated fleet (1 prefill + 1 decode
+# replica behind the fleet router), floods it, then arms the
+# fault-injection registry (serve/faults.py) mid-flood:
+#
+#   kv_transfer.corrupt   — one KV payload bit-flipped on the wire
+#                           (decode replica must refuse + quarantine,
+#                           router falls back to local prefill)
+#   kv_transfer.drop      — one KV push swallowed (same fallback)
+#   scrape.timeout        — decode-replica /metrics scrapes time out
+#                           (poller must NOT mark the replica dead)
+#   http.connect_refused  — decode replica hard-down for a window (the
+#                           router's circuit breaker must open, traffic
+#                           degrades to the surviving pool, breaker
+#                           closes after recovery)
+#
+# PASS bars (all deterministic; the drill re-runs bit-identically):
+#   - every flooded request resolves 200/429/504 — none hang, none 5xx
+#   - greedy token parity: the same probe prompt decodes to the same
+#     text before and after the chaos window
+#   - the decode-replica breaker OPENED during the kill window and
+#     RECOVERED (closed) after it
+#   - decode TTFT p99 stays within 3x the clean-window p99 (+0.5s)
+#
+# Usage: scripts/chaos_serve.sh [out.json]
+#   Exit 0 iff the drill ran and bar_met=true; the case row (bars,
+#   per-outcome counts, fault-fire counts) lands in out.json (default
+#   /tmp/chaos_serve.json) and is summarized on stdout.
+#
+# This is the manual form of tests/test_serve_chaos.py (slow marker).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT="${1:-/tmp/chaos_serve.json}"
+LOG="${OUT%.json}.log"
+
+echo "chaos_serve: running serve_chaos drill (log: $LOG)"
+RC=0
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+  python bench.py --one serve_chaos >"$LOG" 2>&1 || RC=$?
+if [ "$RC" -ne 0 ]; then
+  echo "chaos_serve: FAIL — bench.py exited rc=$RC; tail of log:" >&2
+  tail -20 "$LOG" >&2
+  exit "$RC"
+fi
+
+python - "$LOG" "$OUT" <<'EOF'
+import json
+import sys
+
+MARK = "BENCHCASE "
+row = None
+for line in open(sys.argv[1]):
+    if line.startswith(MARK):
+        row = json.loads(line[len(MARK):])
+if row is None:
+    sys.exit("chaos_serve: FAIL — no case row in log")
+json.dump(row, open(sys.argv[2], "w"), indent=2, sort_keys=True)
+bars = {k: row.get(k) for k in (
+    "no_hung_requests", "all_clean_status", "token_parity",
+    "breaker_opened", "breaker_recovered", "ttft_within_bound")}
+print(f"chaos_serve: outcomes={row.get('outcomes')}")
+print(f"chaos_serve: fault_fires={row.get('fault_fires')}")
+for k, v in bars.items():
+    print(f"chaos_serve:   {'PASS' if v else 'FAIL'}  {k}")
+if not row.get("bar_met"):
+    sys.exit("chaos_serve: FAIL — bar_met=false")
+print(f"chaos_serve: PASS (row: {sys.argv[2]})")
+EOF

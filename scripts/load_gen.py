@@ -187,6 +187,15 @@ def run_load(url: str, concurrency: int, requests: int, prompt: str,
     by_status: dict = {}
     for r in results:
         by_status[str(r["status"])] = by_status.get(str(r["status"]), 0) + 1
+    # Chaos-drill rollup: every request must land in exactly one bucket
+    # (ok + refused + expired + error == requests — nothing hung). 429
+    # and 504 are the CLEAN degradation outcomes; "error" is anything
+    # else (5xx, connection failures, client timeouts).
+    outcomes = {"ok": 0, "429": 0, "504": 0, "error": 0}
+    for r in results:
+        s = r["status"]
+        key = ("ok" if s == 200 else str(s) if s in (429, 504) else "error")
+        outcomes[key] += 1
     ok = [r for r in results if r["status"] == 200]
     lats = sorted(r["latency_s"] for r in ok)
     ttfts = sorted(r["ttft_s"] for r in ok if r["ttft_s"] is not None)
@@ -210,6 +219,8 @@ def run_load(url: str, concurrency: int, requests: int, prompt: str,
         "url": url, "concurrency": concurrency, "requests": requests,
         "max_tokens": max_tokens, "wall_s": round(wall, 2),
         "by_status": by_status,
+        "outcomes": outcomes,
+        "completed": len(results),
         "ok": by_status.get("200", 0),
         "latency_p50_s": pct(lats, 0.50), "latency_p90_s": pct(lats, 0.90),
         "latency_p95_s": pct(lats, 0.95), "latency_p99_s": pct(lats, 0.99),

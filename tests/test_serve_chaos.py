@@ -1,0 +1,44 @@
+"""The serving-plane chaos drill as a test (graftchaos, slow tier).
+
+Runs scripts/chaos_serve.sh, which drives bench.py's ``serve_chaos``
+case: an in-process 1 prefill + 1 decode fleet behind the fleet router,
+flooded while the fault registry tears KV pushes (corrupt + drop),
+times out metrics scrapes, and hard-kills the decode replica for a
+window. The script exits 0 only when every bar held: no hung requests,
+every outcome a clean 200/429/504, greedy token parity across the chaos
+window, the circuit breaker opened AND recovered, and TTFT stayed
+bounded. The drill is deterministic (seeded faults, greedy decode), so
+a failure here is a regression, not flake."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_chaos_serve_drill_meets_every_bar(tmp_path):
+    out_json = str(tmp_path / "chaos_serve.json")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "chaos_serve.sh"), out_json],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, (
+        f"chaos drill failed (rc={proc.returncode}):\n"
+        f"{proc.stdout[-4000:]}\n{proc.stderr[-4000:]}")
+    row = json.loads(open(out_json).read())
+    assert row["bar_met"] is True
+    # The drill actually exercised every armed fault point.
+    fires = row["fault_fires"]
+    assert fires.get("kv_transfer.corrupt", 0) >= 1
+    assert fires.get("kv_transfer.drop", 0) >= 1
+    assert fires.get("http.connect_refused", 0) >= 1
+    # Every flooded request resolved with a clean status.
+    outcomes = row["outcomes"]
+    assert outcomes["error"] == 0
+    assert outcomes["ok"] > 0
+    sys.stdout.write(proc.stdout[-1500:])

@@ -1,0 +1,530 @@
+"""Outbound-call policy (serve/policy.py) + fault injection
+(serve/faults.py) for the serving plane — graftchaos.
+
+Pure-policy tests pin the primitives (Deadline arithmetic, deterministic
+backoff, token-bucket retry budget, the circuit-breaker state machine)
+with no device and no sockets. The HTTP tests run a stub replica (or the
+real tiny-model replicas, test_serve.py-style) and drive failures
+through the ONE fault-injection choke point instead of monkeypatching:
+pre-first-byte stream retry, deadline-header propagation, retry-budget
+exhaustion, and the KV-corrupt -> quarantine -> local-prefill-fallback
+degradation rung."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import pytest
+
+from mlx_cuda_distributed_pretraining_tpu.config import DataConfig
+from mlx_cuda_distributed_pretraining_tpu.infer.server import (
+    InferenceService,
+    request_generate,
+    request_stream,
+    serve,
+)
+from mlx_cuda_distributed_pretraining_tpu.models import llama
+from mlx_cuda_distributed_pretraining_tpu.models.llama import LlamaArgs
+from mlx_cuda_distributed_pretraining_tpu.serve import (
+    AdmissionRefusedError,
+    BatchEngine,
+    BreakerOpenError,
+    CallPolicy,
+    DEADLINE_HEADER,
+    Deadline,
+    DeadlineExceeded,
+    EngineConfig,
+    FleetRouter,
+    PolicyConfig,
+    Request,
+    Router,
+    Scheduler,
+    SlotKVPool,
+    faults,
+    serve_router,
+)
+from mlx_cuda_distributed_pretraining_tpu.serve.policy import (
+    CircuitBreaker,
+    TokenBucket,
+    backoff_s,
+)
+from mlx_cuda_distributed_pretraining_tpu.tokenizer import TokenizerManager
+
+TOK = TokenizerManager(DataConfig())
+ARGS = LlamaArgs(
+    vocab_size=TOK.vocab_size, hidden_size=32, intermediate_size=64,
+    num_layers=2, num_heads=4, num_kv_heads=2, head_dim=8,
+    max_position_embeddings=128,
+)
+PARAMS = llama.init_params(jax.random.PRNGKey(0), ARGS)
+MAX_LEN = 128
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.reset()
+
+
+def _engine(**kw):
+    cfg = EngineConfig(**{"num_slots": 2, "max_len": MAX_LEN,
+                          "prefill_chunk": 16, **kw})
+    return BatchEngine(PARAMS, ARGS, TOK, cfg)
+
+
+def _replica(**kw):
+    service = InferenceService(PARAMS, ARGS, TOK, run_name="tiny")
+    service.engine = _engine(**kw).start()
+    httpd = serve(service, port=0)
+    return service, httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+# -- deadline arithmetic (no device, no sockets) ------------------------------
+
+def test_deadline_header_roundtrip_and_clamp():
+    dl = Deadline.after(1.0)
+    assert 0.0 < dl.remaining_s() <= 1.0
+    hv = float(dl.header_value())
+    assert 0.0 < hv <= 1000.0
+    # round trip: the next hop's parsed budget never exceeds what was sent
+    hop2 = Deadline.from_header({DEADLINE_HEADER: dl.header_value()})
+    assert hop2 is not None and hop2.remaining_ms() <= hv
+    # clamp bounds the socket timeout by the remaining budget
+    assert dl.clamp(30.0) <= 1.0
+    assert dl.clamp(0.05) == 0.05
+    # absent / malformed headers mean "no deadline", never an error
+    assert Deadline.from_header({}) is None
+    assert Deadline.from_header(None) is None
+    assert Deadline.from_header({DEADLINE_HEADER: "soon-ish"}) is None
+    gone = Deadline.after(0.0)
+    assert gone.expired() and gone.header_value() == "0"
+    with pytest.raises(DeadlineExceeded):
+        gone.clamp(5.0)
+    # the exception taxonomy every HTTP 504 mapping relies on
+    assert issubclass(DeadlineExceeded, TimeoutError)
+    assert issubclass(AdmissionRefusedError, DeadlineExceeded)
+    assert issubclass(BreakerOpenError, ConnectionError)
+
+
+def test_backoff_deterministic_jittered_and_capped():
+    assert backoff_s(1, key="t1") == backoff_s(1, key="t1")  # replayable
+    assert backoff_s(1, key="t1") != backoff_s(1, key="t2")  # decorrelated
+    assert backoff_s(1, key="t1") != backoff_s(2, key="t1")
+    for attempt in range(1, 12):
+        raw = min(2.0, 0.05 * 2.0 ** (attempt - 1))
+        v = backoff_s(attempt, base=0.05, cap=2.0, key="x")
+        assert 0.5 * raw <= v < raw  # jitter window, growth capped
+
+
+def test_token_bucket_spend_and_refill():
+    tb = TokenBucket(capacity=2.0, refill_per_s=20.0)
+    assert tb.try_take() and tb.try_take()
+    assert not tb.try_take()  # spent
+    time.sleep(0.11)
+    assert tb.try_take()  # refilled (bounded by capacity)
+    frozen = TokenBucket(capacity=1.0, refill_per_s=0.0)
+    assert frozen.try_take()
+    assert not frozen.try_take() and frozen.tokens() == 0.0
+
+
+def test_circuit_breaker_state_machine():
+    br = CircuitBreaker(threshold=2, open_for_s=0.08)
+    assert br.state == "closed" and br.allow()
+    br.record(False)
+    assert br.state == "closed"  # below threshold
+    br.record(False)
+    assert br.state == "open" and not br.allow()
+    time.sleep(0.1)
+    assert br.allow()  # hold-off elapsed: the single half-open probe
+    assert br.state == "half_open"
+    assert not br.allow()  # second caller refused while the probe is out
+    br.record(False)  # probe failed
+    assert br.state == "open"
+    time.sleep(0.1)
+    assert br.allow() and br.state == "half_open"
+    br.record(True)  # probe answered
+    assert br.state == "closed" and br.allow()
+    br.record(False)  # success reset the failure streak
+    assert br.state == "closed"
+    assert br.state_code() == 0
+
+
+# -- fault registry (no sockets) ----------------------------------------------
+
+def test_faults_triggers_match_times_and_reset():
+    # disarmed = pure passthrough, nothing counted
+    assert faults.take("http.connect_refused", "x") is None
+    assert faults.counts() == {}
+    rule = faults.inject("http.connect_refused", nth=2, match="target")
+    assert faults.take("http.connect_refused", "elsewhere") is None
+    assert rule.calls == 0  # non-matching labels are not even counted
+    assert faults.take("http.connect_refused", "target/a") is None
+    assert faults.take("http.connect_refused", "target/b") is rule  # nth=2
+    assert faults.take("http.connect_refused", "target/c") is None
+    assert (rule.calls, rule.fires) == (3, 1)
+    assert faults.counts() == {"http.connect_refused": 1}
+    faults.reset()
+    assert faults.counts() == {}
+    every = faults.inject("scrape.timeout", every=2, times=2)
+    hits = [faults.take("scrape.timeout") is not None for _ in range(8)]
+    assert hits == [False, True, False, True, False, False, False, False]
+    assert every.fires == 2  # times cap held
+    with pytest.raises(ValueError):
+        faults.inject("no.such.point")
+    with pytest.raises(ValueError):
+        faults.inject("arena.exhaust", nth=1, every=2)  # one trigger only
+
+
+def test_faults_seeded_rate_replays_exactly():
+    def pattern(seed):
+        faults.reset()
+        faults.inject("kv_transfer.drop", rate=0.5, seed=seed)
+        return [faults.take("kv_transfer.drop") is not None
+                for _ in range(16)]
+
+    first = pattern(9)
+    assert first == pattern(9)  # same seed: bit-identical replay
+    assert any(first) and not all(first)
+    assert first != pattern(10)  # different seed: different drill
+
+
+def test_faults_active_context_disarms_only_its_rule():
+    keep = faults.inject("arena.exhaust", every=1)
+    with faults.active("engine.swap_fail") as rule:
+        assert faults.take("engine.swap_fail") is rule
+    assert faults.take("engine.swap_fail") is None  # context disarmed it
+    assert faults.take("arena.exhaust") is keep  # the other rule survives
+
+
+# -- stub replica (records what each dispatch arrived with) -------------------
+
+def _stub_replica():
+    state = {"deadlines": [], "hits": 0}
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *a):
+            pass
+
+        def _reply(self, payload):
+            body = json.dumps(payload).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            self._reply({"queue_depth": 0, "batch_occupancy": 0})
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", "0")))
+            state["hits"] += 1
+            raw = self.headers.get(DEADLINE_HEADER)
+            state["deadlines"].append(None if raw is None else float(raw))
+            self._reply({"text": "stub", "tokens": 1})
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True,
+                     name="stub-replica").start()
+    return httpd, state, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def test_policy_call_stamps_strictly_decreasing_deadline():
+    httpd, state, url = _stub_replica()
+    try:
+        pol = CallPolicy()
+        dl = Deadline.after(3.0)
+        pol.call(url + "/generate", data=b"{}", deadline=dl, method="POST")
+        time.sleep(0.01)
+        pol.call(url + "/generate", data=b"{}", deadline=dl, method="POST")
+        v1, v2 = state["deadlines"]
+        assert 0.0 < v2 < v1 <= 3000.0  # each hop forwards LESS budget
+        # a spent budget is refused locally: the wire is never touched
+        hits = state["hits"]
+        with pytest.raises(DeadlineExceeded):
+            pol.call(url + "/generate", data=b"{}",
+                     deadline=Deadline.after(0.0), method="POST")
+        assert state["hits"] == hits
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_router_propagates_deadline_header_to_replica():
+    httpd, state, url = _stub_replica()
+    router = Router([url], poll_interval_s=30.0)
+    rhttpd = serve_router(router, port=0)
+    rurl = f"http://127.0.0.1:{rhttpd.server_address[1]}"
+    try:
+        def post(body, headers=None):
+            req = urllib.request.Request(
+                rurl + "/generate", data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json",
+                         **(headers or {})})
+            with urllib.request.urlopen(req, timeout=30.0) as resp:
+                return resp.status
+
+        # client header -> router -> replica: strictly shrinking budget
+        assert post({"prompt": "x", "max_tokens": 1},
+                    headers={DEADLINE_HEADER: "2000"}) == 200
+        assert 0.0 < state["deadlines"][-1] < 2000.0
+        # a body deadline_s starts the clock at the router hop
+        assert post({"prompt": "x", "max_tokens": 1,
+                     "deadline_s": 5.0}) == 200
+        assert 0.0 < state["deadlines"][-1] <= 5000.0
+        # an exhausted upstream budget answers 504 without a dispatch
+        hits = state["hits"]
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            post({"prompt": "x", "max_tokens": 1},
+                 headers={DEADLINE_HEADER: "0"})
+        assert exc.value.code == 504
+        assert state["hits"] == hits
+
+        # The policy gauges are scrapeable as Prometheus text from the
+        # router itself; the bare /metrics JSON shape is untouched.
+        with urllib.request.urlopen(rurl + "/metrics?format=prom",
+                                    timeout=10.0) as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            text = resp.read().decode()
+        assert "# TYPE serve_breaker_state gauge" in text
+        assert "serve_retry_budget_tokens" in text
+        assert "serve_router_requests_total" in text
+        with urllib.request.urlopen(rurl + "/metrics",
+                                    timeout=10.0) as resp:
+            assert json.loads(resp.read())["role"] == "router"
+    finally:
+        rhttpd.shutdown()
+        rhttpd.server_close()
+        router.stop()
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_policy_retry_budget_exhaustion_under_injected_refusal():
+    httpd, state, url = _stub_replica()
+    try:
+        pol = CallPolicy(PolicyConfig(
+            max_attempts=10, base_backoff_s=0.0, max_backoff_s=0.0,
+            retry_budget=2.0, retry_refill_per_s=0.0,
+            breaker_threshold=100))
+        rule = faults.inject("http.connect_refused", every=1, match=url)
+        with pytest.raises(urllib.error.URLError):
+            pol.call(url + "/generate", data=b"{}", timeout=5.0,
+                     method="POST")
+        # 1 initial try + exactly the 2 budgeted replays, then surface —
+        # max_attempts=10 did NOT mean 10 connection attempts.
+        assert rule.fires == 3
+        assert pol.tokens(url) == 0.0
+        assert state["hits"] == 0
+        # budget empty: the next call gets its single unbudgeted attempt
+        with pytest.raises(urllib.error.URLError):
+            pol.call(url + "/generate", data=b"{}", timeout=5.0,
+                     method="POST")
+        assert rule.fires == 4
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_policy_breaker_opens_and_refuses_locally():
+    httpd, state, url = _stub_replica()
+    try:
+        pol = CallPolicy(PolicyConfig(breaker_threshold=1,
+                                      breaker_open_s=60.0, max_attempts=1))
+        with faults.active("http.connect_refused", every=1, match=url):
+            with pytest.raises(urllib.error.URLError):
+                pol.call(url + "/generate", data=b"{}", timeout=5.0,
+                         method="POST")
+            assert pol.breaker_state(url) == "open"
+            # circuit open: refused locally, no socket, no fault fire
+            with pytest.raises(BreakerOpenError):
+                pol.call(url + "/generate", data=b"{}", timeout=5.0,
+                         method="POST")
+        assert state["hits"] == 0
+        # an HTTP error status is a LIVE destination: breaker stays shut
+        pol2 = CallPolicy(PolicyConfig(breaker_threshold=1, max_attempts=1))
+        with pytest.raises(urllib.error.HTTPError):
+            pol2.call(url + "/nope", data=b"{}", timeout=5.0, method="PUT")
+        assert pol2.breaker_state(url) == "closed"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_faults_choke_point_slow_read_and_truncate():
+    httpd, state, url = _stub_replica()
+    try:
+        with faults.active("http.slow_read", delay_s=0.15, match="/metrics"):
+            t0 = time.monotonic()
+            with faults.urlopen(
+                    urllib.request.Request(url + "/metrics"),
+                    timeout=5.0) as resp:
+                body = resp.read()
+            assert time.monotonic() - t0 >= 0.15
+            assert json.loads(body)["queue_depth"] == 0  # content intact
+        with faults.active("http.truncate_body", truncate_bytes=2,
+                           match="/metrics"):
+            with faults.urlopen(
+                    urllib.request.Request(url + "/metrics"),
+                    timeout=5.0) as resp:
+                assert len(resp.read(2)) == 2  # budget served
+                with pytest.raises(ConnectionResetError):
+                    resp.read(1)  # then the connection "tears"
+        with faults.active("http.connect_refused", match="/metrics") as r:
+            with pytest.raises(urllib.error.URLError):
+                faults.urlopen(urllib.request.Request(url + "/metrics"),
+                               timeout=5.0)
+            assert r.fires == 1
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# -- admission control (scheduler, no device) ---------------------------------
+
+def test_admission_refuses_unmeetable_deadline_only_after_warmup():
+    pool = SlotKVPool(ARGS, num_slots=1, max_len=MAX_LEN)
+    # a COLD scheduler admits even an already-lapsed deadline — the
+    # classic eviction path handles it (pre-graftchaos behavior).
+    cold = Scheduler(max_queue=8)
+    cold.submit(Request([1], max_tokens=2, deadline_s=1e-6))
+    assert cold.refused == 0
+
+    sched = Scheduler(max_queue=8)
+    for _ in range(Scheduler.EWMA_WARMUP):
+        r = Request([1], max_tokens=2)
+        sched.submit(r)
+        sched.admit(pool)
+        time.sleep(0.005)
+        sched.finish(pool, r, "stop")
+    assert sched._ewma_n >= Scheduler.EWMA_WARMUP
+    assert sched._ewma_service_s > 0.0
+    # occupy the slot and queue one request so the wait estimate is real
+    blocker = Request([1], max_tokens=2)
+    sched.submit(blocker)
+    sched.admit(pool)
+    sched.submit(Request([1], max_tokens=2))
+    with pytest.raises(AdmissionRefusedError):
+        sched.submit(Request([1], max_tokens=2, deadline_s=1e-6))
+    assert sched.refused == 1
+    assert sched.counters()["refused"] == 1
+    # a roomy deadline still admits at the same queue depth
+    sched.submit(Request([1], max_tokens=2, deadline_s=60.0))
+    assert sched.queue_depth() == 2
+
+
+# -- engine wait derivation (tiny model) --------------------------------------
+
+def test_generate_wait_derives_from_default_deadline(monkeypatch):
+    # Spy on the waiter: the caller-side park must be deadline + grace
+    # (the old behavior was a fixed 600s regardless of the deadline).
+    waits = []
+    orig_wait = Request.wait
+
+    def spy(self, timeout=None):
+        waits.append(timeout)
+        return orig_wait(self, timeout)
+
+    monkeypatch.setattr(Request, "wait", spy)
+    eng = _engine(default_deadline_s=60.0).start()
+    try:
+        eng.generate("config default", max_tokens=2)
+        assert waits[-1] == 60.0 + BatchEngine.WAIT_GRACE_S
+        eng.generate("explicit deadline wins", max_tokens=2, deadline_s=5.0)
+        assert waits[-1] == 5.0 + BatchEngine.WAIT_GRACE_S
+        eng.generate("explicit timeout wins", max_tokens=2, deadline_s=5.0,
+                     timeout=42.0)
+        assert waits[-1] == 42.0
+    finally:
+        eng.stop()
+
+
+# -- router stream retry through the choke point (tiny model) -----------------
+
+def test_router_stream_retries_before_first_byte_on_truncation():
+    sa, ha, ua = _replica()
+    sb, hb, ub = _replica()
+    router = Router([ua, ub], poll_interval_s=30.0, retries=2)
+    rhttpd = serve_router(router, port=0)
+    rurl = f"http://127.0.0.1:{rhttpd.server_address[1]}"
+    try:
+        # The FIRST /generate dispatch (whichever replica wins the plan)
+        # tears before its first body byte; the router must replay on the
+        # other candidate and the client must see one clean stream.
+        rule = faults.inject("http.truncate_body", nth=1,
+                             truncate_bytes=0, match="/generate")
+        events = list(request_stream(rurl, "stream survives a torn hop",
+                                     max_tokens=5, timeout=120.0))
+        assert rule.fires == 1
+        assert events[-1].get("done") is True
+        deltas = "".join(e.get("text", "") for e in events[:-1])
+        assert deltas == events[-1]["text"]
+        assert router._mc_retries.value() >= 1
+        dead = sum(router._mc_requests.value(replica=rid,
+                                             outcome="dead_prestream")
+                   for rid in router.replicas)
+        assert dead == 1
+        # disarmed: the identical stream replays bit-for-bit (greedy)
+        again = list(request_stream(rurl, "stream survives a torn hop",
+                                    max_tokens=5, timeout=120.0))
+        assert again[-1]["text"] == events[-1]["text"]
+    finally:
+        rhttpd.shutdown()
+        rhttpd.server_close()
+        router.stop()
+        for s, h in ((sa, ha), (sb, hb)):
+            s.close()
+            h.shutdown()
+            h.server_close()
+
+
+# -- KV corrupt/drop -> quarantine -> local-prefill fallback (tiny model) -----
+
+def test_kv_corrupt_quarantined_then_local_prefill_fallback():
+    pre_s, pre_h, pre_url = _replica(prefix_cache=True, block_size=16,
+                                     role="prefill")
+    dec_s, dec_h, dec_url = _replica(prefix_cache=True, block_size=16,
+                                     role="decode")
+    router = FleetRouter([pre_url], [dec_url], poll_interval_s=30.0,
+                         handoff_min_prompt_bytes=32)
+    rhttpd = serve_router(router, port=0)
+    rurl = f"http://127.0.0.1:{rhttpd.server_address[1]}"
+    prompt = "the chained keys must refuse a payload torn in flight"
+    try:
+        # Corrupt the one KV push: the decode replica must refuse the
+        # payload (verify_keys), quarantine the claimed chain, count the
+        # failure — and still serve the request via local prefill.
+        rule = faults.inject("kv_transfer.corrupt", nth=1)
+        out = request_generate(rurl, prompt, timeout=300.0, max_tokens=8,
+                               temperature=0.0, seed=0)
+        assert rule.fires == 1
+        assert out["tokens"] == 8
+        assert dec_s.engine._mc_kv_fail.value(reason="corrupt") >= 1
+        assert dec_s.engine.metrics()["completed"] == 1
+        faults.reset()
+        # Token parity: the same prompt served CLEAN (handoff lands this
+        # time) decodes to the same greedy text — the degraded path was
+        # slower, never wrong, and the quarantined chain did not poison
+        # the cache.
+        clean = request_generate(rurl, prompt, timeout=300.0, max_tokens=8,
+                                 temperature=0.0, seed=0)
+        assert clean["text"] == out["text"]
+        # Dropped push: the prefill side reports ok, the decode replica
+        # never sees the chain — a plain cache miss, same fallback.
+        drop = faults.inject("kv_transfer.drop", nth=1)
+        prompt2 = prompt + " and a silently swallowed push is a miss"
+        out2 = request_generate(rurl, prompt2, timeout=300.0, max_tokens=8,
+                                temperature=0.0, seed=0)
+        assert drop.fires == 1 and out2["tokens"] == 8
+    finally:
+        rhttpd.shutdown()
+        rhttpd.server_close()
+        router.stop()
+        for s, h in ((pre_s, pre_h), (dec_s, dec_h)):
+            s.close()
+            h.shutdown()
+            h.server_close()
