@@ -56,7 +56,8 @@ from .core import (
 _ANALYSIS_DIR = os.path.dirname(os.path.abspath(__file__))
 _PKG_PARENT = os.path.dirname(os.path.dirname(_ANALYSIS_DIR))
 
-PROGRAM_NAMES = ("train_step", "serve_decode", "stream_decode", "lr_probe")
+PROGRAM_NAMES = ("train_step", "serve_decode", "serve_decode_w8",
+                 "serve_decode_w4", "stream_decode", "lr_probe")
 
 # Fixed serving-shape knobs: the audit wants ONE representative lowering
 # per program, not a sweep — these match the smallest shapes the serve
@@ -292,12 +293,21 @@ def build_programs(config_path: str,
         prog.flag_backend = _xf.guess_backend()
         programs.append(prog)
 
-    if "serve_decode" in wanted:
+    # serve_decode audits the fp serving step; the _w8/_w4 variants lower
+    # the SAME step over quantize_weights-shaped abstract params (int8 /
+    # packed-int4 weight_q(4) + weight_s leaves) — what the engine actually
+    # runs under serving.weight_dtype — so dequant-materialization and the
+    # collective budget see the quantized program, not a proxy.
+    serve_variants = [v for v in ("serve_decode", "serve_decode_w8",
+                                  "serve_decode_w4") if v in wanted]
+    if serve_variants:
         if args.is_moe:
-            notes.append("serve_decode: skipped (paged serving is audited "
-                         "dense-only; MoE serve needs the grouped-dispatch "
-                         "mesh context)")
+            for v in serve_variants:
+                notes.append(f"{v}: skipped (paged serving is audited "
+                             "dense-only; MoE serve needs the "
+                             "grouped-dispatch mesh context)")
         else:
+            from ..models.quantize import quantize_weights
             from ..serve.batch_step import paged_decode_step
 
             table_w = _SERVE_ATTEND // _SERVE_BLOCK
@@ -313,16 +323,21 @@ def build_programs(config_path: str,
                                      attend_len=_SERVE_ATTEND,
                                      table_width=table_w,
                                      block_size=_SERVE_BLOCK)
-            programs.append(_trace_program(
-                "serve_decode", config_name, step,
-                (params_abs, cache_abs,
-                 jax.ShapeDtypeStruct((_SERVE_SLOTS, 1), jnp.int32),
-                 jax.ShapeDtypeStruct((_SERVE_SLOTS,), jnp.int32),
-                 jax.ShapeDtypeStruct((_SERVE_SLOTS, table_w), jnp.int32),
-                 jax.ShapeDtypeStruct((_SERVE_SLOTS,), jnp.float32),
-                 jax.ShapeDtypeStruct((_SERVE_SLOTS, 2), jnp.uint32)),
-                arg_names=("params", "cache", "tokens", "pos", "tables",
-                           "temps", "keys")))
+            for variant in serve_variants:
+                wd = {"serve_decode": "fp", "serve_decode_w8": "int8",
+                      "serve_decode_w4": "int4"}[variant]
+                p_abs = (params_abs if wd == "fp" else jax.eval_shape(
+                    lambda p, _wd=wd: quantize_weights(p, _wd), params_abs))
+                programs.append(_trace_program(
+                    variant, config_name, step,
+                    (p_abs, cache_abs,
+                     jax.ShapeDtypeStruct((_SERVE_SLOTS, 1), jnp.int32),
+                     jax.ShapeDtypeStruct((_SERVE_SLOTS,), jnp.int32),
+                     jax.ShapeDtypeStruct((_SERVE_SLOTS, table_w), jnp.int32),
+                     jax.ShapeDtypeStruct((_SERVE_SLOTS,), jnp.float32),
+                     jax.ShapeDtypeStruct((_SERVE_SLOTS, 2), jnp.uint32)),
+                    arg_names=("params", "cache", "tokens", "pos", "tables",
+                               "temps", "keys")))
 
     if "stream_decode" in wanted:
         if args.is_moe:

@@ -31,6 +31,17 @@ Rules:
   fully replicated while parallel/sharding_rules.py::param_pspec names a
   sharded axis for it: the sharding annotation was lost on the way to
   the compiler.
+- ``dequant-materialization`` — a quantized weight tensor (int8
+  ``weight_q`` / packed-int4 ``weight_q4`` input leaf) whose dequantized
+  fp copy the program MATERIALIZES: the int→fp convert's result escapes
+  as an output, is reused by several consumers, or feeds anything other
+  than a single contraction. The healthy lowering keeps the fp copy a
+  transient operand of exactly one dot (unpack+scale fused into the
+  matmul epilogue); a resident fp copy (≥ 2x the int bytes) forfeits the
+  bandwidth win weight-only quantization exists for. Reads the jaxpr,
+  not the HLO: XLA:CPU spells the per-matmul convert as a standalone
+  fusion (transient scratch, not a resident copy), so fusion-level HLO
+  would false-positive on every CPU-hosted audit.
 - ``sync-collectives``   — the config requested a latency-hiding XLA
   flag set (``system.xla.flag_set``) yet the train program's
   overlap-relevant collectives (all-gather / reduce-scatter /
@@ -409,6 +420,138 @@ class ReplicatedParam:
                     f"the in_shardings wiring dropped it")
 
 
+_DEQUANT_MIN_BYTES = 64 * 1024
+# Layout-only ops an fp weight may pass through on its way into the one
+# contraction that consumes it (transpose for `x @ w.T`-style applies).
+_DEQUANT_PASS_THROUGH = ("transpose", "reshape", "broadcast_in_dim",
+                         "squeeze", "expand_dims")
+_CONTRACTION_PRIMS = ("dot_general", "conv_general_dilated")
+
+
+class DequantMaterialization:
+    id = "dequant-materialization"
+    description = ("quantized weight dequantized into a resident fp copy "
+                   "instead of a transient single-contraction operand")
+
+    def check(self, prog: AuditProgram) -> Iterable[Finding]:
+        jaxpr = prog.closed_jaxpr.jaxpr
+        if len(jaxpr.invars) != len(prog.arg_leaves):
+            return
+        taint = {}
+        for var, leaf in zip(jaxpr.invars, prog.arg_leaves):
+            base = leaf.path.rsplit(".", 1)[-1]
+            if base in ("weight_q", "weight_q4") and "int" in leaf.dtype:
+                taint[var] = leaf.path
+        if not taint:
+            return
+        self._seen: set = set()
+        yield from self._walk(prog, jaxpr, taint)
+
+    # -- taint walk ----------------------------------------------------------
+
+    @staticmethod
+    def _is_var(v) -> bool:
+        return type(v).__name__ not in ("Literal", "DropVar")
+
+    def _walk(self, prog, jaxpr, taint) -> Iterable[Finding]:
+        consumers: Dict[Any, List[Any]] = defaultdict(list)
+        for eqn in jaxpr.eqns:
+            for v in eqn.invars:
+                if self._is_var(v):
+                    consumers[v].append(eqn)
+        outset = {v for v in jaxpr.outvars if self._is_var(v)}
+
+        for eqn in jaxpr.eqns:
+            hit = [v for v in eqn.invars if self._is_var(v) and v in taint]
+            if hit:
+                src_path = taint[hit[0]]
+                prim = eqn.primitive.name
+                out_dtypes = [getattr(v.aval, "dtype", None)
+                              for v in eqn.outvars if hasattr(v, "aval")]
+                if (prim == "convert_element_type" and out_dtypes
+                        and all(d is not None and d.kind == "f"
+                                for d in out_dtypes)):
+                    yield from self._check_convert(
+                        prog, eqn, hit[0], src_path, consumers, outset)
+                elif out_dtypes and all(d is not None and d.kind in "iu"
+                                        for d in out_dtypes):
+                    # still the int plane (int4 unpack shifts/concat,
+                    # slicing, layout): keep following it.
+                    for v in eqn.outvars:
+                        if self._is_var(v):
+                            taint[v] = src_path
+            # descend into call-like sub-jaxprs (pjit, remat, scan bodies)
+            # where the positional invar mapping is 1:1.
+            for pv in eqn.params.values():
+                for sub in (pv if isinstance(pv, (list, tuple)) else (pv,)):
+                    inner = getattr(sub, "jaxpr", sub)
+                    if not hasattr(inner, "eqns") or not hasattr(inner, "invars"):
+                        continue
+                    if len(inner.invars) != len(eqn.invars):
+                        continue
+                    inner_taint = {
+                        iv: taint[ov]
+                        for iv, ov in zip(inner.invars, eqn.invars)
+                        if self._is_var(ov) and ov in taint}
+                    if inner_taint:
+                        yield from self._walk(prog, inner, inner_taint)
+
+    def _check_convert(self, prog, eqn, src_var, src_path, consumers,
+                       outset) -> Iterable[Finding]:
+        out = eqn.outvars[0]
+        in_aval, out_aval = src_var.aval, out.aval
+        in_bytes = in_aval.size * in_aval.dtype.itemsize
+        out_bytes = out_aval.size * out_aval.dtype.itemsize
+        if out_bytes < max(2 * in_bytes, _DEQUANT_MIN_BYTES):
+            return
+        why = self._materialized(out, consumers, outset)
+        if why is None:
+            return
+        frame = eqn_frame(eqn)
+        if frame is None:
+            path, line, where = prog.synthetic_path, 0, prog.name
+        else:
+            path, line, where = (normalize_path(frame[0]), frame[1],
+                                 f"`{frame[2]}`")
+        key = (path, line, src_path)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        yield Finding(
+            self.id, path, line, 0,
+            f"program `{prog.name}`: quantized weight `{src_path}` "
+            f"({fmt_bytes(in_bytes)} int) is dequantized into a resident "
+            f"{fmt_bytes(out_bytes)} fp copy at {where} — {why}; keep the "
+            f"fp form a transient operand of exactly one matmul so the "
+            f"convert fuses into the contraction epilogue")
+
+    def _materialized(self, var, consumers, outset) -> Optional[str]:
+        """None if the fp copy is a transient single-contraction operand;
+        else the reason it must stay resident."""
+        for _ in range(8):  # bounded pass-through chain
+            if var in outset:
+                return "it escapes as a program output"
+            cons = consumers.get(var, [])
+            if not cons:
+                return None  # dead value: DCE's problem, not HBM's
+            if len(cons) > 1:
+                return f"it is reused by {len(cons)} consumers"
+            prim = cons[0].primitive.name
+            if prim in _CONTRACTION_PRIMS:
+                return None
+            if prim not in _DEQUANT_PASS_THROUGH:
+                # A call-like consumer (scan/pjit body) re-enters _walk via
+                # the int plane when the convert lives inside; an fp weight
+                # handed ACROSS the boundary was converted too early.
+                if any(hasattr(getattr(s, "jaxpr", s), "eqns")
+                       for pv in cons[0].params.values()
+                       for s in (pv if isinstance(pv, (list, tuple)) else (pv,))):
+                    return None  # conservative: don't flag call boundaries
+                return f"it feeds `{prim}`, not a contraction"
+            var = cons[0].outvars[0]
+        return "its consumer chain never reaches a contraction"
+
+
 class SyncCollectives:
     id = "sync-collectives"
     description = ("overlap-relevant collectives lowered synchronous although "
@@ -464,7 +607,7 @@ def _keypath_str(kp) -> str:
 
 _AUDIT_RULES = [DonationGap(), CollectiveCensus(), DtypeUpcast(),
                 LargeConstantCapture(), ReplicatedParam(),
-                SyncCollectives()]
+                DequantMaterialization(), SyncCollectives()]
 
 
 def all_audit_rules() -> Dict[str, Any]:

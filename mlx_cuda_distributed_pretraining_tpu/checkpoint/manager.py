@@ -106,6 +106,28 @@ def _crc32_file(path: str, chunk_size: int = 1 << 20) -> int:
             crc = zlib.crc32(chunk, crc)
 
 
+def _quantize_flat_np(arrays: Dict[str, np.ndarray],
+                      weight_dtype: str) -> Dict[str, np.ndarray]:
+    """Host-side quantization of a flat checkpoint dict (the no-mesh load
+    path; the mesh path quantizes per-device slices in shard_arrays)."""
+    from ..models.quantize import (channel_scales, quantize_slice_np,
+                                   quantized_key_shapes)
+
+    out: Dict[str, np.ndarray] = {}
+    for k, v in arrays.items():
+        arr = np.asarray(v)
+        qk = quantized_key_shapes(k, arr.shape, weight_dtype)
+        if not qk:
+            out[k] = v
+            continue
+        scales = channel_scales(arr, 8 if weight_dtype == "int8" else 4)
+        for qkey in qk:
+            out[qkey] = (scales if qkey.endswith(".weight_s") else
+                         quantize_slice_np(arr, scales, (slice(None),),
+                                           weight_dtype))
+    return out
+
+
 def _step_sort_key(tag: str) -> Tuple[int, int]:
     """Newest-first candidate order: "final" outranks any numeric step
     (matching latest_step()); numeric steps descend; unknown tags last."""
@@ -470,17 +492,43 @@ class CheckpointManager:
 
     @staticmethod
     def load_params(model_path: str, like: Optional[Any] = None,
-                    mesh: Optional[Any] = None) -> Any:
+                    mesh: Optional[Any] = None,
+                    weight_dtype: str = "fp") -> Any:
         """Tolerant load (reference: models/llama.py:414-477): extra keys in
         the file are dropped, missing keys keep the ``like`` value.
 
         With ``mesh``, this is reshard-on-load: the on-disk checkpoint is
         mesh-agnostic (full host arrays, whatever mesh trained it), and each
         leaf lands directly in the mesh's ``NamedSharding`` per
-        ``parallel/sharding_rules.param_pspec``."""
+        ``parallel/sharding_rules.param_pspec``.
+
+        ``weight_dtype`` "int8"/"int4" quantizes the linear weights at the
+        load boundary (models/quantize.py): the fp safetensors file stays
+        canonical and — on the mesh path — each device quantizes only its
+        own slice, so no fp replica of a quantized weight ever touches a
+        device. When ``like`` is already a quantized tree (hot-swap into a
+        serving engine running int8/int4), the dtype is inferred from its
+        leaf names, so fleet rolling swaps need no extra plumbing."""
+        from ..models.quantize import (check_weight_dtype, quantize_weights,
+                                       weight_dtype_of)
+
+        wd = check_weight_dtype(weight_dtype)
+        if wd == "fp" and like is not None:
+            wd = weight_dtype_of(like)
+        elif wd != "fp" and isinstance(like, dict) and "layers" in like \
+                and weight_dtype_of(like) == "fp":
+            # Explicit weight_dtype with an fp reference tree: the merge
+            # below keys off ``like``'s leaf names, so it must see the
+            # quantized layout (weight_q/weight_q4 + weight_s) — otherwise
+            # every quantized file key would be dropped as "extra" and the
+            # fp ``like`` values silently served instead.
+            like = quantize_weights(like, wd)
         arrays, _ = load_safetensors(model_path)
         if mesh is not None:
-            arrays = CheckpointManager.shard_arrays(arrays, mesh)
+            arrays = CheckpointManager.shard_arrays(arrays, mesh,
+                                                    weight_dtype=wd)
+        elif wd != "fp":
+            arrays = _quantize_flat_np(arrays, wd)
         nested = unflatten_dict(arrays)
         if like is None:
             return nested
@@ -510,7 +558,8 @@ class CheckpointManager:
 
     @staticmethod
     def shard_arrays(arrays: Dict[str, np.ndarray], mesh: Any,
-                     pspec_fn: Optional[Any] = None) -> Dict[str, Any]:
+                     pspec_fn: Optional[Any] = None,
+                     weight_dtype: str = "fp") -> Dict[str, Any]:
         """Place a flat ``{dotted.path: host array}`` dict onto ``mesh`` per
         the training param rules — reshard-on-load.
 
@@ -520,20 +569,49 @@ class CheckpointManager:
         The checkpoint on disk is always full host arrays, so a file saved
         under fsdp=2, tp=1, or a single device reshards identically.
 
+        ``weight_dtype`` "int8"/"int4" rewrites each quantizable linear key
+        into its quantized leaves (models/quantize.py convention) ON THE
+        WAY to the devices: per-channel scales are a cheap host-side global
+        reduction computed once per tensor; every device's callback then
+        quantizes only its own slice, so the device only ever receives the
+        int bytes + its scale shard — never an fp copy of the weight.
+
         ``pspec_fn(key, shape, mesh)`` overrides the placement rule (default
         ``parallel.sharding_rules.param_pspec``)."""
         from jax.sharding import NamedSharding
 
+        from ..models.quantize import (channel_scales, check_weight_dtype,
+                                       quantize_slice_np,
+                                       quantized_key_shapes)
         from ..parallel.sharding_rules import param_pspec
 
+        wd = check_weight_dtype(weight_dtype)
         if pspec_fn is None:
             pspec_fn = param_pspec
+
+        def place(key, host_arr, shape, cb):
+            sharding = NamedSharding(mesh, pspec_fn(key, shape, mesh))
+            return jax.make_array_from_callback(tuple(shape), sharding, cb)
+
         placed: Dict[str, Any] = {}
         for k, v in arrays.items():
             arr = np.asarray(v)
-            sharding = NamedSharding(mesh, pspec_fn(k, arr.shape, mesh))
-            placed[k] = jax.make_array_from_callback(
-                arr.shape, sharding, lambda idx, a=arr: a[idx])
+            qk = (quantized_key_shapes(k, arr.shape, wd)
+                  if wd != "fp" else None)
+            if not qk:
+                placed[k] = place(k, arr, arr.shape,
+                                  lambda idx, a=arr: a[idx])
+                continue
+            scales = channel_scales(arr, 8 if wd == "int8" else 4)
+            for qkey, qshape in qk.items():
+                if qkey.endswith(".weight_s"):
+                    placed[qkey] = place(qkey, scales, scales.shape,
+                                         lambda idx, s=scales: s[idx])
+                else:
+                    placed[qkey] = place(
+                        qkey, arr, qshape,
+                        lambda idx, a=arr, s=scales: quantize_slice_np(
+                            a, s, idx, wd))
         return placed
 
     @staticmethod

@@ -68,6 +68,11 @@ class ModelConfig:
     # cheap FFN elementwise work). Takes precedence over the legacy
     # system.remat / system.gradient_checkpointing knobs when set.
     remat_policy: Optional[str] = None
+    # Opt-in low-precision training matmuls: None/"fp32" | "bf16" |
+    # "int8" (ops/flash_attention.py MATMUL_PRECISIONS). int8 tracks
+    # per-row/per-channel amax scales on the forward matmuls and keeps
+    # the backward pass in fp; loss-parity is gated vs bf16 in tests.
+    matmul_precision: Optional[str] = None
 
     def __post_init__(self):
         if self.remat_policy is not None:
@@ -78,6 +83,15 @@ class ModelConfig:
                     f"unknown model.remat_policy: {self.remat_policy!r} "
                     f"(expected one of {valid})")
             object.__setattr__(self, "remat_policy", norm)
+        if self.matmul_precision is not None:
+            norm = str(self.matmul_precision).lower()
+            if norm in ("", "none", "fp", "fp32"):
+                norm = None
+            elif norm not in ("bf16", "int8"):
+                raise ValueError(
+                    f"unknown model.matmul_precision: {self.matmul_precision!r} "
+                    f"(expected one of (None, 'fp32', 'bf16', 'int8'))")
+            object.__setattr__(self, "matmul_precision", norm)
 
     @property
     def hidden_size(self) -> int:

@@ -103,11 +103,13 @@ class InferenceService:
     def from_run(cls, run: str, runs_root: str = "runs",
                  kv_quant: bool = False, max_tokens_limit: int = 4096,
                  speculative: bool = False,
-                 draft_len: int = 8, mesh=None) -> "InferenceService":
+                 draft_len: int = 8, mesh=None,
+                 weight_dtype: str = "fp") -> "InferenceService":
         from ..train.trainer import load_trained
 
         params, args, tok, _cfg = load_trained(run, runs_root=runs_root,
-                                               mesh=mesh)
+                                               mesh=mesh,
+                                               weight_dtype=weight_dtype)
         return cls(params, args, tok, kv_quant=kv_quant, run_name=run,
                    max_tokens_limit=max_tokens_limit,
                    speculative=speculative, draft_len=draft_len)
@@ -776,6 +778,13 @@ def main(argv=None) -> int:
                         "step over the device mesh; the checkpoint "
                         "reshards straight into it on load (yaml: "
                         "serving.mesh)")
+    p.add_argument("--weight-dtype", choices=("fp", "int8", "int4"),
+                   default="fp",
+                   help="weight-only quantization of the serving weights "
+                        "(models/quantize.py): per-output-channel scales, "
+                        "quantized at checkpoint load — the fp safetensors "
+                        "file stays canonical; embeddings/norms stay fp "
+                        "(yaml: serving.weight_dtype)")
     p.add_argument("--role", choices=("any", "prefill", "decode"),
                    default="any",
                    help="fleet pool this replica serves (surfaced via "
@@ -795,11 +804,14 @@ def main(argv=None) -> int:
         from ..parallel import build_serve_mesh
 
         mesh = build_serve_mesh(a.mesh)
+    if a.weight_dtype != "fp" and a.engine != "batch":
+        p.error("--weight-dtype requires --engine batch")
     service = InferenceService.from_run(a.run, a.runs_root,
                                         kv_quant=a.kv_quant,
                                         max_tokens_limit=a.max_tokens_limit,
                                         speculative=a.spec,
-                                        draft_len=a.draft_len, mesh=mesh)
+                                        draft_len=a.draft_len, mesh=mesh,
+                                        weight_dtype=a.weight_dtype)
     if a.engine == "batch":
         from ..parallel import parse_mesh_spec
         from ..serve import EngineConfig
@@ -814,6 +826,7 @@ def main(argv=None) -> int:
             prefix_min_hit_blocks=a.prefix_min_hit_blocks,
             default_deadline_s=a.deadline_s, stats_url=a.stats_url,
             trace=a.trace, trace_sample=a.trace_sample, role=a.role,
+            weight_dtype=a.weight_dtype,
             mesh=parse_mesh_spec(a.mesh) if a.mesh else None), mesh=mesh)
     httpd = ThreadingHTTPServer((a.host, a.port), make_handler(service))
     if a.fleet_dir:

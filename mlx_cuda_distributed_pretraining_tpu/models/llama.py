@@ -149,6 +149,11 @@ class LlamaArgs:
     # Static per-destination send slots for the ep all-to-all, as a
     # fraction of local selections: <= 0 means worst-case (dropless).
     moe_ep_capacity_factor: float = 0.0
+    # Opt-in low-precision training matmuls (model.matmul_precision):
+    # None/fp32 | bf16 | int8 — threaded into ops/flash_attention.py and
+    # ops/grouped_matmul.py (amax/scale-tracked int8 forward, fp backward;
+    # loss-parity gated vs bf16 in the test suite).
+    matmul_precision: Optional[str] = None
 
     @property
     def is_moe(self) -> bool:
@@ -195,6 +200,7 @@ class LlamaArgs:
             moe_group_size=int(moe.get("group_size", 256) or 256),
             moe_impl=str(moe.get("impl", "grouped") or "grouped"),
             moe_ep_capacity_factor=float(moe.get("ep_capacity_factor", 0.0) or 0.0),
+            matmul_precision=getattr(model_cfg, "matmul_precision", None),
         )
 
 
@@ -270,7 +276,17 @@ def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
 
 
 def _linear(x: jnp.ndarray, p: Params) -> jnp.ndarray:
-    if "weight_q" in p:
+    if "weight_q4" in p:
+        # int4 weight-only quantization (models/quantize.py): two values
+        # per byte along the contraction dim. The nibble unpack is two
+        # arithmetic shifts XLA fuses into the matmul's operand read, and
+        # the per-output-channel scale lands in the epilogue — the weight
+        # crosses HBM at 0.5 byte/elem, no fp copy is materialized.
+        from .quantize import unpack_int4
+
+        w = unpack_int4(p["weight_q4"])
+        y = (x @ w.astype(x.dtype)) * p["weight_s"].astype(x.dtype)
+    elif "weight_q" in p:
         # int8 weight-only quantization (quantize_params_int8): the
         # per-output-channel scale factors OUT of the contraction, so
         # dequant happens after the matmul on the [.., out] result — the
@@ -285,42 +301,18 @@ def _linear(x: jnp.ndarray, p: Params) -> jnp.ndarray:
 
 def quantize_params_int8(params: Params) -> Params:
     """Weight-only int8 quantization for inference (per-output-channel
-    symmetric scales on every layer linear: wq/wk/wv/wo and the dense
-    MLP). Embeddings, the output head, norms and biases stay full
-    precision (they set logit quality); MoE expert banks are left
-    unquantized (they run through einsum, not _linear). Composes with the
-    int8 KV cache: weights AND cache both cross HBM at 1 byte/elem.
+    symmetric scales on every layer linear: wq/wk/wv/wo, the dense MLP
+    and MoE expert banks). Embeddings, the output head, norms, biases
+    and MoE routers stay full precision (they set logit quality).
+    Composes with the int8 KV cache: weights AND cache both cross HBM
+    at 1 byte/elem. Thin wrapper over models/quantize.py, which also
+    implements packed int4 and the quantize-on-load checkpoint path.
 
     The reference has no weight quantization (its only quant surface is
     the optional KV cache quant, core/generation_lite.py:75-89)."""
+    from .quantize import quantize_weights
 
-    def quant(w):
-        s = jnp.max(jnp.abs(w), axis=0) / 127.0
-        s = jnp.where(s == 0, 1.0, s).astype(jnp.float32)
-        q = jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)
-        return q, s
-
-    def walk_linear(p):
-        if "weight" not in p or p["weight"].ndim != 2:
-            return dict(p)
-        q, s = quant(p["weight"].astype(jnp.float32))
-        out = {k: v for k, v in p.items() if k != "weight"}
-        out["weight_q"], out["weight_s"] = q, s
-        return out
-
-    out = {k: v for k, v in params.items() if k != "layers"}
-    new_layers = []
-    for layer in params["layers"]:
-        nl = dict(layer)
-        nl["attention"] = {k: walk_linear(v) if isinstance(v, dict) else v
-                           for k, v in layer["attention"].items()}
-        ff = layer["feed_forward"]
-        if "w_gate" in ff:  # dense MLP (expert banks pass through)
-            nl["feed_forward"] = {k: walk_linear(v) if isinstance(v, dict) else v
-                                  for k, v in ff.items()}
-        new_layers.append(nl)
-    out["layers"] = new_layers
-    return out
+    return quantize_weights(params, "int8")
 
 
 def rope_cos_sin(
@@ -446,7 +438,10 @@ def attention_block(
             from ..ops.flash_attention import flash_attention
 
             out = flash_attention(q, k, v, mask_type=args.mask_type,
-                                  window_size=args.window_size, prefix_len=args.prefix_len)
+                                  window_size=args.window_size,
+                                  prefix_len=args.prefix_len,
+                                  precision=getattr(args, "matmul_precision",
+                                                    None))
         elif impl == "ring":
             # Sequence/context parallelism: exact causal attention with KV
             # shards rotating over the sp mesh axis (ops/ring_attention.py).

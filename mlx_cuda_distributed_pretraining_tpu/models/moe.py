@@ -170,6 +170,41 @@ def router_z_loss(router_logits: jnp.ndarray) -> jnp.ndarray:
     return jnp.mean(z * z)
 
 
+# -- quantized expert banks ---------------------------------------------------
+def _expert_bank(experts: Params, name: str, dtype):
+    """Resolve one expert bank leaf to ``(weights, scales | None)``.
+
+    Quantized banks (models/quantize.py: ``weight_q`` int8 [E, in, out]
+    or packed ``weight_q4`` [E, in//2, out], scales [E, out]) store at
+    <= 1 byte/elem; the unpack/cast happens here at the dispatch site
+    and the per-(expert, out-channel) scale is applied by the caller on
+    the matmul RESULT — after the grouped GEMM / einsum, never as a
+    scaled fp weight copy."""
+    leaf = experts[name]
+    if "weight_q4" in leaf:
+        from .quantize import unpack_int4
+
+        return unpack_int4(leaf["weight_q4"]).astype(dtype), leaf["weight_s"]
+    if "weight_q" in leaf:
+        return leaf["weight_q"].astype(dtype), leaf["weight_s"]
+    return leaf["weight"], None
+
+
+def _maybe_dequant_experts(p: Params) -> Params:
+    """fp view of a (possibly quantized) expert subtree — only for paths
+    that ship the banks through shard_map operands (expert-parallel),
+    where threading separate scale operands isn't worth the wiring."""
+    experts = p["experts"]
+    if not any(("weight_q" in leaf or "weight_q4" in leaf)
+               for leaf in experts.values() if isinstance(leaf, dict)):
+        return p
+    from .quantize import dequantize_leaf
+
+    return {**p, "experts": {
+        name: {"weight": dequantize_leaf(leaf)}
+        for name, leaf in experts.items()}}
+
+
 # -- einsum (GShard/Switch) implementation -----------------------------------
 def _einsum_moe(
     p: Params, x: jnp.ndarray, probs: jnp.ndarray, args
@@ -207,13 +242,17 @@ def _einsum_moe(
 
     # [G,g,E,C] x [G,g,D] -> [E,G,C,D]: the all-to-all under ep sharding.
     expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, xg)
-    wg_ = p["experts"]["w_gate"]["weight"]
-    wu = p["experts"]["w_up"]["weight"]
-    wd = p["experts"]["w_down"]["weight"]
-    h = jax.nn.silu(jnp.einsum("ebcd,edi->ebci", expert_in, wg_)) * jnp.einsum(
-        "ebcd,edi->ebci", expert_in, wu
+    wg_, sg = _expert_bank(p["experts"], "w_gate", expert_in.dtype)
+    wu, su = _expert_bank(p["experts"], "w_up", expert_in.dtype)
+    wd, sd = _expert_bank(p["experts"], "w_down", expert_in.dtype)
+
+    def scaled(y, s):  # per-(expert, out-channel) dequant epilogue
+        return y if s is None else y * s[:, None, None, :].astype(y.dtype)
+
+    h = jax.nn.silu(scaled(jnp.einsum("ebcd,edi->ebci", expert_in, wg_), sg)) * scaled(
+        jnp.einsum("ebcd,edi->ebci", expert_in, wu), su
     )
-    expert_out = jnp.einsum("ebci,eid->ebcd", h, wd)
+    expert_out = scaled(jnp.einsum("ebci,eid->ebcd", h, wd), sd)
     out = jnp.einsum("bsec,ebcd->bsd", combine.astype(x.dtype), expert_out)
     return out.reshape(B, S_pad, D)[:, :S], dropped
 
@@ -226,6 +265,7 @@ def _grouped_ffn(
     gate_w: jnp.ndarray,
     num_experts: int,
     block_t: int,
+    precision=None,
 ) -> jnp.ndarray:
     """Sorted dropless expert FFN over local tokens.
 
@@ -256,12 +296,26 @@ def _grouped_ffn(
     x_buf = jnp.zeros((T_buf, D), x_flat.dtype).at[dest].set(x_flat[tok[order]])
 
     gs = padded
-    wg_ = experts["w_gate"]["weight"]
-    wu = experts["w_up"]["weight"]
-    wd = experts["w_down"]["weight"]
-    h = jax.nn.silu(gm.gmm(x_buf, wg_, gs, block_t=block_t)) * gm.gmm(
-        x_buf, wu, gs, block_t=block_t)
-    y_buf = gm.gmm(h, wd, gs, block_t=block_t)
+    wg_, sg = _expert_bank(experts, "w_gate", x_buf.dtype)
+    wu, su = _expert_bank(experts, "w_up", x_buf.dtype)
+    wd, sd = _expert_bank(experts, "w_down", x_buf.dtype)
+    if sg is not None or su is not None or sd is not None:
+        # Expert id of each buffer row (pad rows clamp to the last group —
+        # they are all-zero, any scale is fine).
+        row_e = jnp.minimum(
+            jnp.searchsorted(jnp.cumsum(gs), jnp.arange(T_buf), side="right"),
+            num_experts - 1)
+
+        def scaled(y, s):  # per-row dequant epilogue
+            return y if s is None else y * s[row_e].astype(y.dtype)
+    else:
+        def scaled(y, s):
+            return y
+
+    h = jax.nn.silu(
+        scaled(gm.gmm(x_buf, wg_, gs, block_t=block_t, precision=precision), sg)
+    ) * scaled(gm.gmm(x_buf, wu, gs, block_t=block_t, precision=precision), su)
+    y_buf = scaled(gm.gmm(h, wd, gs, block_t=block_t, precision=precision), sd)
 
     w_s = gate_w.reshape(TK)[order].astype(y_buf.dtype)
     out = jnp.zeros((T, D), x_flat.dtype).at[tok[order]].add(
@@ -384,9 +438,11 @@ def _grouped_moe_ep(
         dest2 = jnp.where(real2, (p_off[rid_c] + rank2).astype(jnp.int32), T_buf)
 
         x_buf = jnp.zeros((T_buf, D), rx.dtype).at[dest2].set(rx[order2])
-        h = jax.nn.silu(gm.gmm(x_buf, wg_l, padded, block_t=block_t)) * gm.gmm(
-            x_buf, wu_l, padded, block_t=block_t)
-        y_buf = gm.gmm(h, wd_l, padded, block_t=block_t)
+        prec = getattr(args, "matmul_precision", None)
+        h = jax.nn.silu(
+            gm.gmm(x_buf, wg_l, padded, block_t=block_t, precision=prec)
+        ) * gm.gmm(x_buf, wu_l, padded, block_t=block_t, precision=prec)
+        y_buf = gm.gmm(h, wd_l, padded, block_t=block_t, precision=prec)
 
         y_sorted = y_buf[jnp.minimum(dest2, T_buf - 1)] * real2[:, None]
         y_recv = jnp.zeros((R, D), y_buf.dtype).at[order2].set(y_sorted)
@@ -411,6 +467,7 @@ def _grouped_moe_ep(
         out_specs=(specs["activation"], specs["replicated"]),
         check_vma=False,
     )
+    p = _maybe_dequant_experts(p)  # ep ships fp banks through shard_map
     out, dropped = fn(
         x, gate_idx, gate_w,
         p["experts"]["w_gate"]["weight"],
@@ -457,6 +514,7 @@ def moe_block(p: Params, x: jnp.ndarray, args) -> Tuple[jnp.ndarray, jnp.ndarray
                 p["experts"], x.reshape(B * S, D), gate_idx.reshape(B * S, K),
                 gate_w.reshape(B * S, K), E,
                 gm.pick_block_t(B * S * K, E),
+                precision=getattr(args, "matmul_precision", None),
             ).reshape(B, S, D)
             dropped = jnp.zeros((), jnp.float32)
     else:

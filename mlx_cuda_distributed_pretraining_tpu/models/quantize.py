@@ -1,0 +1,294 @@
+"""Weight-only quantization plane: per-channel int8 and packed int4.
+
+Decode is HBM-bandwidth bound — every generated token re-reads the full
+weight set — so serving throughput scales almost linearly with weight
+bytes. This module is the single home for the weight-dtype transform the
+rest of the stack composes:
+
+- ``quantize_weights(params, "int8"|"int4")`` — tree walk producing the
+  quantized leaf convention the forward paths consume (``models/llama.py
+  _linear``, ``models/moe.py`` grouped/einsum experts):
+
+  =========  ================================  =====================
+  dtype      quantized leaf                    scale leaf
+  =========  ================================  =====================
+  int8       ``weight_q``  int8 [in, out]      ``weight_s`` f32 [out]
+  int4       ``weight_q4`` int8 [in//2, out]   ``weight_s`` f32 [out]
+  =========  ================================  =====================
+
+  (MoE expert banks carry a leading ``E`` dim on both leaves.) Scales
+  are symmetric per-OUTPUT-channel so they factor out of the
+  contraction: dequant is a cheap multiply on the [.., out] matmul
+  result, never a materialized fp weight copy.
+
+- int4 packs TWO adjacent contraction-dim (``in``) rows per int8 byte:
+  even row in the low nibble, odd row in the high nibble. Unpacking is
+  two arithmetic shifts (``(p << 4) >> 4`` sign-extends the low nibble,
+  ``p >> 4`` the high one) that XLA fuses into the consuming matmul.
+  Packing along ``in`` (not ``out``) keeps ``weight_s`` [out] aligned
+  with the unpacked result and halves the dim the fsdp/tp sharding
+  rules already split evenly.
+
+- ``*_np`` twins implement the same math in NumPy for the
+  checkpoint-load path (``checkpoint/manager.py shard_arrays``), where
+  each device's ``make_array_from_callback`` slice is quantized
+  host-side WITHOUT ever materializing an fp replica on device.
+
+Embeddings, the output head, norms, biases and MoE routers always stay
+full precision — they set logit quality and are a small fraction of the
+bytes. The existing int8 KV-cache quartet (``k_q/k_s/v_q/v_s``)
+composes freely: weights and cache both cross HBM at <= 1 byte/elem.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Dict[str, Any]
+
+WEIGHT_DTYPES = ("fp", "int8", "int4")
+
+# Dotted-path pattern for the linear weights the quantize-on-load path
+# transforms (checkpoint keys are flat dotted paths). Embeddings, output
+# head, norms, biases and MoE routers never match.
+QUANT_LEAF_RE = re.compile(
+    r"(attention\.w[qkvo]|feed_forward\.w_(gate|up|down)|"
+    r"experts\.w_(gate|up|down))\.weight$")
+
+_INT8_MAX = 127.0
+_INT4_MAX = 7.0  # symmetric: [-7, 7]; -8 stays unused
+
+
+def check_weight_dtype(weight_dtype: str) -> str:
+    wd = str(weight_dtype or "fp").lower()
+    if wd in ("fp32", "bf16", "none", ""):
+        wd = "fp"
+    if wd not in WEIGHT_DTYPES:
+        raise ValueError(f"unknown weight_dtype {weight_dtype!r} "
+                         f"(expected one of {WEIGHT_DTYPES})")
+    return wd
+
+
+def quantizable_path(path: str) -> bool:
+    """Whether a flat checkpoint key names a quantizable linear weight."""
+    return QUANT_LEAF_RE.search(path) is not None
+
+
+# -- per-channel scales ------------------------------------------------------
+def channel_scales(w, bits: int = 8):
+    """Symmetric per-output-channel scales over the contraction dim.
+
+    ``w`` is [in, out] (axis 0 contracts) or [E, in, out] (axis 1
+    contracts). Returns f32 scales shaped [out] / [E, out]."""
+    xp = np if isinstance(w, np.ndarray) else jnp
+    axis = 0 if w.ndim == 2 else 1
+    qmax = _INT8_MAX if bits == 8 else _INT4_MAX
+    s = xp.max(xp.abs(w.astype(xp.float32)), axis=axis) / qmax
+    return xp.where(s == 0, 1.0, s).astype(xp.float32)
+
+
+def _quantize_values(w, s, bits: int):
+    """int8-stored quantized values for precomputed scales ``s``."""
+    xp = np if isinstance(w, np.ndarray) else jnp
+    qmax = _INT8_MAX if bits == 8 else _INT4_MAX
+    se = s[None] if w.ndim == 2 else s[:, None, :]
+    q = xp.clip(xp.round(w.astype(xp.float32) / se), -qmax, qmax)
+    return q.astype(xp.int8)
+
+
+# -- int4 packing ------------------------------------------------------------
+def pack_int4(q):
+    """Pack int8-stored int4 values ([-7, 7]) two-per-byte along the
+    contraction dim: row 2i -> low nibble, row 2i+1 -> high nibble.
+    [in, out] -> [in//2, out] (or [E, in, out] -> [E, in//2, out])."""
+    xp = np if isinstance(q, np.ndarray) else jnp
+    axis = q.ndim - 2
+    if q.shape[axis] % 2:
+        raise ValueError(f"int4 packing needs an even contraction dim, "
+                         f"got shape {tuple(q.shape)}")
+    if axis == 0:
+        even, odd = q[0::2], q[1::2]
+    else:
+        even, odd = q[:, 0::2], q[:, 1::2]
+    # Low nibble keeps only the value bits; the high nibble's shift wraps
+    # mod 256 — both exact for values in [-8, 7].
+    return ((odd.astype(xp.int8) << 4) | (even.astype(xp.int8) & 0x0F)) \
+        .astype(xp.int8)
+
+
+def unpack_int4(packed):
+    """Inverse of :func:`pack_int4`: [in//2, out] -> int8 [in, out].
+
+    Pure shifts — arithmetic ``>>`` on int8 sign-extends, so the low
+    nibble round-trips through ``(p << 4) >> 4`` and the high nibble is
+    just ``p >> 4``. XLA fuses both into the consuming matmul."""
+    xp = np if isinstance(packed, np.ndarray) else jnp
+    p = packed.astype(xp.int8)
+    low = (p << 4) >> 4
+    high = p >> 4
+    axis = packed.ndim - 1  # stack position after the contraction dim
+    out = xp.stack([low, high], axis=axis)
+    shape = list(packed.shape)
+    shape[-2] *= 2
+    return out.reshape(shape)
+
+
+# -- leaf + tree transforms --------------------------------------------------
+def quantize_leaf(w, weight_dtype: str) -> Dict[str, Any]:
+    """One linear weight -> its quantized leaf dict (see module doc)."""
+    wd = check_weight_dtype(weight_dtype)
+    if wd == "fp":
+        return {"weight": w}
+    bits = 8 if wd == "int8" else 4
+    s = channel_scales(w, bits)
+    q = _quantize_values(w, s, bits)
+    if wd == "int8":
+        return {"weight_q": q, "weight_s": s}
+    return {"weight_q4": pack_int4(q), "weight_s": s}
+
+
+def dequantize_leaf(p: Dict[str, Any], dtype=jnp.float32):
+    """fp reference weight for a quantized leaf dict (tests/parity only —
+    the forward paths never call this; they keep dequant in the matmul
+    epilogue)."""
+    xp = np if isinstance(p.get("weight_s"), np.ndarray) else jnp
+    if "weight_q4" in p:
+        q = unpack_int4(p["weight_q4"])
+    elif "weight_q" in p:
+        q = p["weight_q"]
+    else:
+        return p["weight"]
+    s = p["weight_s"]
+    se = s[None] if q.ndim == 2 else s[:, None, :]
+    return (q.astype(xp.float32) * se).astype(dtype)
+
+
+def _walk_linear(p: Params, weight_dtype: str) -> Params:
+    if "weight" not in p or p["weight"].ndim not in (2, 3):
+        return dict(p)
+    out = {k: v for k, v in p.items() if k != "weight"}
+    out.update(quantize_leaf(p["weight"], weight_dtype))
+    return out
+
+
+def quantize_weights(params: Params, weight_dtype: str) -> Params:
+    """Weight-only quantization of a full param tree for serving.
+
+    Quantizes every layer linear — attention wq/wk/wv/wo, the dense
+    SwiGLU w_gate/w_up/w_down AND the stacked MoE expert banks (per
+    (expert, out-channel) scales). Embeddings, the output head, norms,
+    biases and MoE routers stay fp. ``"fp"`` is the identity."""
+    wd = check_weight_dtype(weight_dtype)
+    if wd == "fp":
+        return params
+
+    out = {k: v for k, v in params.items() if k != "layers"}
+    new_layers = []
+    for layer in params["layers"]:
+        nl = dict(layer)
+        nl["attention"] = {k: _walk_linear(v, wd) if isinstance(v, dict)
+                           else v for k, v in layer["attention"].items()}
+        ff = layer["feed_forward"]
+        if "experts" in ff:  # MoE: quantize the banks, router stays fp
+            nff = dict(ff)
+            nff["experts"] = {k: _walk_linear(v, wd) if isinstance(v, dict)
+                              else v for k, v in ff["experts"].items()}
+            nl["feed_forward"] = nff
+        elif "w_gate" in ff:
+            nl["feed_forward"] = {k: _walk_linear(v, wd)
+                                  if isinstance(v, dict) else v
+                                  for k, v in ff.items()}
+        new_layers.append(nl)
+    out["layers"] = new_layers
+    return out
+
+
+def weight_dtype_of(params: Params) -> str:
+    """Detect the weight dtype of a param tree ("fp" | "int8" | "int4")
+    from its leaf naming convention — the hot-swap path uses this to
+    quantize incoming fp checkpoints into a quantized ``like``."""
+    found = "fp"
+    for path in jax.tree_util.tree_flatten_with_path(params)[0]:
+        keys = "".join(str(getattr(k, "key", "")) for k in path[0])
+        if "weight_q4" in keys:
+            return "int4"
+        if "weight_q" in keys:
+            found = "int8"
+    return found
+
+
+# -- NumPy twins for the checkpoint-load path --------------------------------
+def quantized_key_shapes(path: str, shape: Tuple[int, ...],
+                         weight_dtype: str
+                         ) -> Optional[Dict[str, Tuple[int, ...]]]:
+    """For a flat checkpoint key: the quantized keys + shapes it loads
+    as under ``weight_dtype``, or None when it stays fp. Lets callers
+    (shard_arrays, byte accounting) plan placement without touching
+    data."""
+    wd = check_weight_dtype(weight_dtype)
+    if wd == "fp" or not quantizable_path(path) or len(shape) not in (2, 3):
+        return None
+    base = path[: -len(".weight")]
+    contraction = shape[-2]
+    s_shape = shape[:-2] + (shape[-1],)
+    if wd == "int8":
+        return {base + ".weight_q": tuple(shape), base + ".weight_s": s_shape}
+    if contraction % 2:
+        return None  # odd contraction dim: leave fp rather than pad
+    q4 = shape[:-2] + (contraction // 2, shape[-1])
+    return {base + ".weight_q4": q4, base + ".weight_s": s_shape}
+
+
+def quantize_slice_np(arr: np.ndarray, scales: np.ndarray,
+                      idx, weight_dtype: str) -> np.ndarray:
+    """Quantize ONE device's slice of a host fp array.
+
+    ``idx`` indexes the QUANTIZED shape (for int4 the contraction dim is
+    packed, so the fp rows covered are ``2*start : 2*stop``); ``scales``
+    are the full-array per-channel scales (a global reduction — computed
+    once on host, sliced per device here). Only the slice's quantized
+    bytes are ever handed to the device."""
+    wd = check_weight_dtype(weight_dtype)
+    bits = 8 if wd == "int8" else 4
+    idx = tuple(idx) if isinstance(idx, tuple) else (idx,)
+    # Normalize to one slice per dim.
+    full = [slice(None)] * arr.ndim
+    for i, sl in enumerate(idx):
+        full[i] = sl
+    caxis = arr.ndim - 2
+    if wd == "int4":
+        sl = full[caxis]
+        start = 0 if sl.start is None else sl.start
+        stop = arr.shape[caxis] // 2 if sl.stop is None else sl.stop
+        fp_idx = list(full)
+        fp_idx[caxis] = slice(2 * start, 2 * stop)
+        w = arr[tuple(fp_idx)]
+    else:
+        w = arr[tuple(full)]
+    # Scale index: leading expert dims + the out dim (last).
+    s_idx = tuple(full[:caxis]) + (full[-1],)
+    s = scales[s_idx]
+    q = _quantize_values(w, s, bits)
+    return pack_int4(q) if wd == "int4" else q
+
+
+def dequantize_np(q: np.ndarray, s: np.ndarray,
+                  packed: bool) -> np.ndarray:
+    """Host-side reference dequant (tests)."""
+    if packed:
+        q = unpack_int4(q)
+    se = s[None] if q.ndim == 2 else s[:, None, :]
+    return q.astype(np.float32) * se
+
+
+# -- byte accounting ---------------------------------------------------------
+def weight_plane_bytes(params: Params) -> int:
+    """Total bytes of every param leaf as stored (quantized trees count
+    their int + scale bytes) — the ``serve_weight_bytes`` gauge."""
+    return sum(int(np.dtype(l.dtype).itemsize) * int(l.size)
+               for l in jax.tree_util.tree_leaves(params))

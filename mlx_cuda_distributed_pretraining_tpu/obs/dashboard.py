@@ -106,7 +106,7 @@ DASHBOARD_HTML = """<!DOCTYPE html>
   <div class="panel">
     <h2>Workers</h2>
     <table id="workers"><thead><tr>
-      <th></th><th>worker</th><th>step</th><th>loss</th><th>tok/s</th><th>mfu</th><th>moe ent</th><th>cache hit</th><th>ttft p50/p95</th><th>mesh</th><th>last seen</th>
+      <th></th><th>worker</th><th>step</th><th>loss</th><th>tok/s</th><th>mfu</th><th>moe ent</th><th>cache hit</th><th>ttft p50/p95</th><th>mesh</th><th>weights</th><th>last seen</th>
     </tr></thead><tbody></tbody></table>
   </div>
 </div>
@@ -327,6 +327,8 @@ function renderWorkers(workers, agg) {
           " / " + m.ttft_ms_p95.toFixed(0) : "") : "–") + "</td>" +
       // Serving workers only: mesh shape ("tp=2" / "1dev"; training "–").
       "<td>" + (typeof m.mesh === "string" ? m.mesh : "–") + "</td>" +
+      // Serving weight dtype ("fp" / "int8" / "int4"; training "–").
+      "<td>" + (typeof m.weight_dtype === "string" ? m.weight_dtype : "–") + "</td>" +
       '<td style="color:var(' + (alive ? "--status-good" : "--status-critical") +
       ')">' + (alive ? "\\u25cf " + Math.round(ago) + "s ago" : "\\u25cb stale") + "</td>";
     tb.appendChild(tr);

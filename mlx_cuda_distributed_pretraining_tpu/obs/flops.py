@@ -14,6 +14,15 @@ run) and can be forced with ``GRAFT_PEAK_FLOPS`` for unlisted hardware.
 On CPU or unknown chips detection returns None and callers report
 ``mfu=unknown`` — same convention as bench.py's vocab-less rows.
 
+Decode is bandwidth-bound, not FLOPs-bound: every generated token must
+stream the (active) weight plane from HBM, so the decode roofline is
+``HBM bytes/s / weight bytes per token``. :func:`weight_bytes_per_token`
+models that byte cost per serving ``weight_dtype`` (fp / weight-only
+int8 / packed int4 + per-channel scales) and
+:func:`decode_roofline_tok_s` turns it into the tok/s ceiling the
+perf-gate compares measured decode rates against — the analytic
+justification for the int8 ≥ 1.5x acceptance bar.
+
 The goodput ledger answers "where did the wall clock go": every logging
 window books seconds into named components (compile, data wait, H2D
 wait, dispatch, checkpoint save, eval, restart-lost time fed in by the
@@ -41,6 +50,22 @@ _PEAK_BY_KIND = (
 )
 
 PEAK_FLOPS_ENV = "GRAFT_PEAK_FLOPS"
+
+# HBM bandwidth (bytes/s) per chip, same keying/override convention as
+# the FLOPs table. Numbers are vendor peak memory bandwidth.
+_HBM_BW_BY_KIND = (
+    ("v6e", 1640e9), ("v6 lite", 1640e9),
+    ("v5p", 2765e9),
+    ("v5e", 819e9), ("v5 lite", 819e9), ("v5lite", 819e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+    ("h100", 3350e9),
+    ("a100", 2039e9),
+    ("v100", 900e9),
+)
+
+HBM_BW_ENV = "GRAFT_HBM_BW"
 
 
 def flops_per_token(n_params: int, num_layers: int, seq_len: int,
@@ -117,6 +142,110 @@ def peak_flops_per_chip(device_kind: Optional[str] = None) -> Optional[float]:
         if needle in kind:
             return peak
     return None
+
+
+def hbm_bw_per_chip(device_kind: Optional[str] = None) -> Optional[float]:
+    """Peak HBM bytes/s for one chip, or None when undetectable.
+
+    ``GRAFT_HBM_BW`` (float, bytes/s) overrides detection, mirroring
+    ``GRAFT_PEAK_FLOPS``.
+    """
+    env = os.environ.get(HBM_BW_ENV)
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    if device_kind is None:
+        try:
+            import jax
+
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            return None
+    kind = str(device_kind).lower()
+    for needle, bw in _HBM_BW_BY_KIND:
+        if needle in kind:
+            return bw
+    return None
+
+
+def quantizable_weight_counts(model_cfg: Any) -> tuple:
+    """(matmul params, per-channel scale count) a decoded token streams.
+
+    Counts exactly the leaves the weight-only quantizer touches
+    (models/quantize.QUANT_LEAF_RE): the four attention projections and
+    the SwiGLU matrices — for MoE, the top-k ACTIVE expert banks only,
+    since decode gathers K experts per token; the router stays fp and is
+    counted with the remainder. Scales are one fp32 per output channel
+    per matrix.
+    """
+    h = int(model_cfg.hidden_size)
+    inter = int(model_cfg.intermediate_size)
+    L = int(model_cfg.num_layers)
+    dq = int(model_cfg.num_heads) * int(model_cfg.head_dim)
+    dkv = int(model_cfg.num_kv_heads) * int(model_cfg.head_dim)
+    attn_q = h * dq + 2 * h * dkv + dq * h
+    attn_s = dq + 2 * dkv + h
+    moe = dict(getattr(model_cfg, "moe", None) or {})
+    k = int(moe.get("num_experts_per_tok", 0) or 0)
+    if int(moe.get("num_local_experts", 0) or 0) > 0 and k > 0:
+        ffn_q = k * 3 * h * inter
+        ffn_s = k * (2 * inter + h)
+    else:
+        ffn_q = 3 * h * inter
+        ffn_s = 2 * inter + h
+    return L * (attn_q + ffn_q), L * (attn_s + ffn_s)
+
+
+def weight_bytes_per_token(model_cfg: Any, n_params: int,
+                           weight_dtype: str = "fp",
+                           vocab_size: Optional[int] = None,
+                           fp_bytes: int = 4) -> float:
+    """Bytes of weights one decoded token streams from HBM.
+
+    The quantizable matmul plane costs 1 byte/param at int8 and 0.5 at
+    packed int4, plus fp32 per-channel scales; everything else (norms,
+    router, output head) streams at ``fp_bytes``. The input embedding is
+    a single-row gather, not a stream — pass ``vocab_size`` to exclude
+    one [vocab, hidden] table from the fp remainder (tied heads still
+    pay it once: the logits matmul reads the full table). MoE models are
+    costed on ACTIVE params, matching :func:`model_flops_per_token`.
+    """
+    wd = str(weight_dtype or "fp").lower()
+    qbytes = {"fp": float(fp_bytes), "int8": 1.0, "int4": 0.5}.get(wd)
+    if qbytes is None:
+        raise ValueError(f"unknown weight_dtype {weight_dtype!r}")
+    moe = dict(getattr(model_cfg, "moe", None) or {})
+    n_active = int(n_params)
+    if int(moe.get("num_local_experts", 0) or 0) > 0:
+        n_active = moe_active_params(
+            n_params, int(model_cfg.num_layers), int(model_cfg.hidden_size),
+            int(model_cfg.intermediate_size),
+            int(moe.get("num_local_experts", 0) or 0),
+            int(moe.get("num_experts_per_tok", 0) or 0))
+    n_quant, n_scales = quantizable_weight_counts(model_cfg)
+    rest = max(0, n_active - n_quant)
+    if vocab_size:
+        rest = max(0, rest - int(vocab_size) * int(model_cfg.hidden_size))
+    out = n_quant * qbytes + rest * float(fp_bytes)
+    if wd != "fp":
+        out += 4.0 * n_scales
+    return out
+
+
+def decode_roofline_tok_s(bytes_per_token: float,
+                          bw_per_chip: Optional[float],
+                          n_chips: int = 1) -> Optional[float]:
+    """Bandwidth-roofline decode ceiling: HBM bytes/s over bytes/token.
+
+    None when bandwidth is undetectable — same convention as
+    :func:`mfu`. Sharded serving divides the weight stream across chips,
+    hence the ``n_chips`` multiplier.
+    """
+    if bw_per_chip is None or bw_per_chip <= 0 or bytes_per_token <= 0:
+        return None
+    return float(bw_per_chip) * max(1, int(n_chips)) / float(bytes_per_token)
 
 
 def mfu(tok_s: float, flops_per_tok: float,

@@ -52,6 +52,41 @@ except ImportError:  # pragma: no cover
 # register row so stores never touch partial lanes.
 _LANES = 128
 
+# Opt-in low-precision matmul modes (model.matmul_precision). None/"fp32"
+# is the default fp path; "bf16" casts the attention operands; "int8"
+# runs amax/scale-tracked symmetric int8 quantization of q/k/v (per-row
+# over the head dim, the same grid as the int8 KV cache quartet).
+MATMUL_PRECISIONS = (None, "fp32", "bf16", "int8")
+
+
+def check_matmul_precision(precision: Optional[str]) -> Optional[str]:
+    p = str(precision).lower() if precision is not None else None
+    if p in ("", "none", "fp32", "fp"):
+        p = None
+    if p not in MATMUL_PRECISIONS:
+        raise ValueError(f"unknown matmul_precision {precision!r} "
+                         f"(expected one of {MATMUL_PRECISIONS})")
+    return p
+
+
+def quantize_operand_int8(x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """amax/scale-tracked int8 matmul operand with a straight-through
+    backward.
+
+    Tracks the per-row amax over the contraction dim, scales onto the
+    symmetric int8 grid and requantizes: the forward value is EXACTLY
+    ``round(x/s) * s`` with ``|round(x/s)| <= 127`` — integer products
+    under fp32 accumulation are exact up to 127²·D < 2²⁴ (D <= 1024), so
+    the kernel's MXU dot is bit-equivalent to a native int8×int8→int32
+    contraction of the tracked values. The backward passes gradients
+    straight through to the fp operand (standard STE), keeping the
+    recomputation-based flash backward in full precision."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis, keepdims=True)
+    s = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s), -127, 127)
+    xq = (q * s).astype(x.dtype)
+    return x + jax.lax.stop_gradient(xq - x)
+
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
@@ -596,6 +631,7 @@ def flash_attention(
     block_kv: int = _DEF_BLOCK_KV,
     mask_fn: Optional[Callable] = None,
     score_fn: Optional[Callable] = None,
+    precision: Optional[str] = None,
 ) -> jnp.ndarray:
     """Flash attention on [B, S, H, D] layout (framework convention).
 
@@ -603,7 +639,19 @@ def flash_attention(
     prefix_lm / full); ``mask_fn``/``score_fn`` override the in-tile
     predicate (flex path): ``mask_fn(row, col) -> bool``,
     ``score_fn(scores, row, col, head) -> scores``.
+
+    ``precision`` (model.matmul_precision): "bf16" casts q/k/v; "int8"
+    quantizes them onto the symmetric int8 grid with per-row amax scales
+    (:func:`quantize_operand_int8`) — loss-parity gated vs bf16 in the
+    test suite; the backward stays full precision either way.
     """
+    precision = check_matmul_precision(precision)
+    if precision == "int8":
+        q = quantize_operand_int8(q)
+        k = quantize_operand_int8(k)
+        v = quantize_operand_int8(v)
+    elif precision == "bf16":
+        q, k, v = (t.astype(jnp.bfloat16) for t in (q, k, v))
     B, Sq, Hq, D = q.shape
     _, Skv, Hkv, _ = k.shape
     scale = (D ** -0.5) if scale is None else scale

@@ -213,6 +213,76 @@ def _tgmm_pallas(x, dy, group_sizes, n_experts, block_t, block_n):
     return jnp.where((group_sizes > 0)[:, None, None], dw, 0)
 
 
+# -- int8 forward (amax/scale tracked), fp backward --------------------------
+def _quantize_rows_int8(x):
+    """Per-row symmetric int8 over the contraction dim: [T, K] ->
+    (int8 [T, K], fp32 scales [T, 1])."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    s = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s), -127, 127)
+    return q.astype(jnp.int8), s
+
+
+def _quantize_cols_int8(w):
+    """Per-(expert, out-column) symmetric int8 over the contraction dim:
+    [E, K, N] -> (int8 [E, K, N], fp32 scales [E, 1, N])."""
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=1, keepdims=True)
+    s = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / s), -127, 127)
+    return q.astype(jnp.int8), s
+
+
+def _gmm_int8_impl(x, w, group_sizes, block_t):
+    """Real int8×int8→int32 grouped GEMM in the blocked formulation: both
+    operands are amax/scale-quantized per contraction row/column, the
+    tile-batched ``dot_general`` contracts in integers, and the scales
+    multiply back on the [T, N] result (rank-1 per tile: row scales ×
+    that tile's expert column scales)."""
+    T, K = x.shape
+    if T % block_t:
+        raise ValueError(
+            f"gmm int8 path needs T ({T}) % block_t ({block_t}) == 0; "
+            "the moe dispatcher pads for this")
+    n_t = T // block_t
+    te = tile_experts(group_sizes.astype(jnp.int32), n_t, block_t)
+    xq, sx = _quantize_rows_int8(x)
+    wq, sw = _quantize_cols_int8(w)
+    yt = jnp.einsum("tbk,tkn->tbn", xq.reshape(n_t, block_t, K), wq[te],
+                    preferred_element_type=jnp.int32)
+    y = yt.astype(jnp.float32) * sx.reshape(n_t, block_t, 1) * sw[te]
+    return y.reshape(T, w.shape[2]).astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _gmm_int8(x, w, group_sizes, block_t):
+    return _gmm_int8_impl(x, w, group_sizes, block_t)
+
+
+def _gmm_int8_fwd(x, w, group_sizes, block_t):
+    return _gmm_int8_impl(x, w, group_sizes, block_t), (x, w, group_sizes)
+
+
+def _gmm_int8_bwd(block_t, residuals, dy):
+    # Straight-through: gradients flow as if the forward were the fp
+    # grouped GEMM (the quantization error is treated as noise), keeping
+    # the backward in full precision like the flash-attention int8 path.
+    x, w, group_sizes = residuals
+    T, K = x.shape
+    n_t = T // block_t
+    te = tile_experts(group_sizes.astype(jnp.int32), n_t, block_t)
+    dx = gmm(dy, w.transpose(0, 2, 1), group_sizes, block_t=block_t,
+             backend="blocked")
+    part = jnp.einsum("tbk,tbn->tkn", x.reshape(n_t, block_t, K),
+                      dy.reshape(n_t, block_t, -1),
+                      preferred_element_type=jnp.float32)
+    dw = jnp.zeros(w.shape, jnp.float32).at[te].add(part)
+    dw = jnp.where((group_sizes > 0)[:, None, None], dw, 0)
+    return dx.astype(x.dtype), dw.astype(w.dtype), None
+
+
+_gmm_int8.defvjp(_gmm_int8_fwd, _gmm_int8_bwd)
+
+
 # -- differentiable entry point ----------------------------------------------
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _gmm_pallas_diff(x, w, group_sizes, block_t, block_n):
@@ -241,10 +311,24 @@ def gmm(
     block_t: int = DEFAULT_BLOCK_T,
     block_n: int = DEFAULT_BLOCK_N,
     backend: Optional[str] = None,
+    precision: Optional[str] = None,
 ) -> jnp.ndarray:
     """``x [T, N_in]`` × ``w [E, N_in, N_out]`` → ``[T, N_out]`` where row
     block ``e`` of ``x`` (per ``group_sizes``, block_t-aligned) multiplies
-    ``w[e]``. Differentiable in ``x`` and ``w`` on both backends."""
+    ``w[e]``. Differentiable in ``x`` and ``w`` on both backends.
+
+    ``precision`` (model.matmul_precision): "int8" runs the forward as a
+    real int8×int8→int32 grouped contraction with amax/scale tracking
+    (per activation row, per expert output column) and a full-precision
+    backward; "bf16" casts the operands. None/"fp32" is the fp path."""
+    from .flash_attention import check_matmul_precision
+
+    precision = check_matmul_precision(precision)
+    if precision == "int8":
+        return _gmm_int8(x, w, group_sizes, block_t)
+    if precision == "bf16":
+        x = x.astype(jnp.bfloat16)
+        w = w.astype(jnp.bfloat16)
     backend = backend or default_backend()
     if backend == "ragged":
         # XLA-native ragged dot: differentiates itself (dX transpose rule +

@@ -24,16 +24,28 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # (path regex, spec builder). fsdp shards the non-tp dim of every matrix.
 # Expert-parallel (MoE, models/moe.py): stacked [E, ...] expert tensors lead
 # with the ep axis so expert compute and weights partition together.
+# Weight-only quantized leaves (models/quantize.py): ``weight_q`` (int8) and
+# ``weight_q4`` (packed int4, contraction dim halved — the divisibility
+# fallback in param_pspec handles the halving) shard exactly like the fp
+# ``weight`` they replace; per-output-channel ``weight_s`` scales shard with
+# the OUT dim of their matrix so each tp/fsdp shard holds the scales for
+# exactly the output features it computes.
 _RULES = [
     (r"tok_embeddings\.weight$", ("tp", "fsdp")),  # [V, D] vocab-parallel
     (r"output\.weight$", ("fsdp", "tp")),          # [D, V]
-    (r"attention\.w[qkv]\.weight$", ("fsdp", "tp")),  # [D, H*Dh] column
-    (r"attention\.wo\.weight$", ("tp", "fsdp")),      # [H*Dh, D] row
-    (r"experts\.w_(gate|up)\.weight$", ("ep", "fsdp", "tp")),  # [E, D, I]
-    (r"experts\.w_down\.weight$", ("ep", "tp", "fsdp")),       # [E, I, D]
+    (r"attention\.w[qkv]\.weight(_q4?)?$", ("fsdp", "tp")),  # [D, H*Dh] column
+    (r"attention\.w[qkv]\.weight_s$", ("tp",)),              # [H*Dh]
+    (r"attention\.wo\.weight(_q4?)?$", ("tp", "fsdp")),      # [H*Dh, D] row
+    (r"attention\.wo\.weight_s$", ("fsdp",)),                # [D]
+    (r"experts\.w_(gate|up)\.weight(_q4?)?$", ("ep", "fsdp", "tp")),  # [E, D, I]
+    (r"experts\.w_(gate|up)\.weight_s$", ("ep", "tp")),               # [E, I]
+    (r"experts\.w_down\.weight(_q4?)?$", ("ep", "tp", "fsdp")),       # [E, I, D]
+    (r"experts\.w_down\.weight_s$", ("ep", "fsdp")),                  # [E, D]
     (r"feed_forward\.router\.weight$", ("fsdp", None)),        # [D, E]
-    (r"feed_forward\.w_(gate|up)\.weight$", ("fsdp", "tp")),  # [D, I] column
-    (r"feed_forward\.w_down\.weight$", ("tp", "fsdp")),       # [I, D] row
+    (r"feed_forward\.w_(gate|up)\.weight(_q4?)?$", ("fsdp", "tp")),  # [D, I] column
+    (r"feed_forward\.w_(gate|up)\.weight_s$", ("tp",)),              # [I]
+    (r"feed_forward\.w_down\.weight(_q4?)?$", ("tp", "fsdp")),       # [I, D] row
+    (r"feed_forward\.w_down\.weight_s$", ("fsdp",)),                 # [D]
     (r"\.bias$", (None,)),
     (r"norm\.weight$", (None,)),
 ]

@@ -53,6 +53,8 @@ class EngineConfig:
     max_queue: int = 32         # admission queue depth; beyond -> 429
     prefill_chunk: int = 256    # prompt tokens written per iteration
     kv_quant: bool = False      # int8 pool buffers (same path as --kv-quant)
+    weight_dtype: str = "fp"    # weight-only quant: "fp" | "int8" | "int4"
+    #                             (models/quantize.py; embeddings/norms stay fp)
     kv_backend: str = "paged"   # "paged" (block tables) | "slotted" (PR 1)
     block_size: int = 32        # paged: tokens per KV block (power of two)
     num_blocks: int = 0         # paged: KV arena size; 0 = slotted-equivalent
@@ -104,6 +106,10 @@ class EngineConfig:
         serving = doc.get("serving")
         if isinstance(serving, dict) and "mesh" in serving:
             serve.setdefault("mesh", serving["mesh"])
+        # serving: {weight_dtype: int8} — weight-only quantization knob
+        # lives beside the mesh it shards under.
+        if isinstance(serving, dict) and "weight_dtype" in serving:
+            serve.setdefault("weight_dtype", serving["weight_dtype"])
         if isinstance(serve.get("mesh"), str):
             from ..parallel import parse_mesh_spec
 
@@ -134,6 +140,21 @@ class BatchEngine:
         self.mesh = mesh
         if self.mesh is not None:
             self.params = self._place_params(params, self.mesh)
+        # Weight-only quantization (models/quantize.py). Params that arrive
+        # already quantized (checkpoint/manager.py quantize-on-load — the
+        # preferred path: no fp replica ever lands on device) win over the
+        # config knob; fp params with weight_dtype set are quantized here.
+        from ..models.quantize import (check_weight_dtype, quantize_weights,
+                                       weight_dtype_of, weight_plane_bytes)
+
+        wd = check_weight_dtype(self.cfg.weight_dtype)
+        have = weight_dtype_of(self.params)
+        if have != "fp":
+            wd = have
+        elif wd != "fp":
+            self.params = quantize_weights(self.params, wd)
+        self.weight_dtype = wd
+        self._weight_bytes = weight_plane_bytes(self.params)
         if self.cfg.kv_backend == "paged":
             self.pool = PagedKVPool(
                 args, self.cfg.num_slots, self.cfg.max_len,
@@ -272,6 +293,15 @@ class BatchEngine:
         self._mg_mesh_devices.set(self.mesh.size if self.mesh else 1)
         for ax, n in (dict(self.mesh.shape) if self.mesh else {}).items():
             self._mg_mesh_axis.set(n, axis=ax)
+        # Resident weight-plane bytes as stored (int + scale leaves for a
+        # quantized tree): the decode-bandwidth denominator obs/flops.py's
+        # ceiling model reads, labeled by dtype so one scrape shows a
+        # mixed fp/int8/int4 fleet.
+        self._mg_weight_bytes = reg.gauge(
+            "serve_weight_bytes",
+            "bytes of resident model weights (as stored)")
+        self._mg_weight_bytes.set(self._weight_bytes,
+                                  weight_dtype=self.weight_dtype)
 
     @staticmethod
     def _place_params(params, mesh):
@@ -370,12 +400,26 @@ class BatchEngine:
             # the serving weights untouched (the rolling-swap driver's
             # canary/rollback path handles the error).
             raise RuntimeError("injected swap failure")
+        # A quantized engine hot-swaps quantized: the load path quantizes
+        # on the way in (load_params infers the dtype from ``like``), but
+        # callers handing raw fp trees get the same treatment here so the
+        # resident weight plane never changes dtype across a swap.
+        if self.weight_dtype != "fp":
+            from ..models.quantize import quantize_weights, weight_dtype_of
+
+            if weight_dtype_of(new_params) == "fp":
+                new_params = quantize_weights(new_params, self.weight_dtype)
         placed = (self._place_params(new_params, self.mesh)
                   if self.mesh is not None else new_params)
+        from ..models.quantize import weight_plane_bytes
+
+        nbytes = weight_plane_bytes(placed)
 
         def _cutover():
             self.params = placed
             self.params_version += 1
+            self._weight_bytes = nbytes
+            self._mg_weight_bytes.set(nbytes, weight_dtype=self.weight_dtype)
             self._mc_swaps.inc()
             return self.params_version
 
@@ -565,6 +609,10 @@ class BatchEngine:
             # Dashboard "mesh" column: "tp=2" / "tp=2,dp=2" / "1dev".
             "mesh": (",".join(f"{a}={n}" for a, n in self.mesh.shape.items())
                      if self.mesh is not None else "1dev"),
+            # Dashboard "weights" column + the decode-bandwidth ceiling
+            # inputs (obs/flops.py weight_bytes_per_token).
+            "weight_dtype": self.weight_dtype,
+            "weight_bytes": int(self._weight_bytes),
         }
         if self.pool.kind == "paged":
             snap.update({

@@ -1577,7 +1577,8 @@ def _enable_compilation_cache(cache_dir: str) -> str:
     return f"compilation cache: {cache_dir} — {entries} entries, {state}"
 
 
-def load_trained(run_name_or_dir: str, runs_root: str = "runs", mesh=None):
+def load_trained(run_name_or_dir: str, runs_root: str = "runs", mesh=None,
+                 weight_dtype: str = "fp"):
     """Load a finished run for inference: (params, args, tokenizer, config).
     Mirrors ``Trainer(for_training=False)`` + final-checkpoint load
     (reference: core/generation.py:33-43).
@@ -1586,7 +1587,12 @@ def load_trained(run_name_or_dir: str, runs_root: str = "runs", mesh=None):
     params reshard on load: checkpoints are mesh-agnostic on disk, and each
     leaf is placed straight into the serving mesh's ``NamedSharding`` per
     the training sharding rules — whatever mesh shape trained it, with no
-    full-replica materialization (see CheckpointManager.shard_arrays)."""
+    full-replica materialization (see CheckpointManager.shard_arrays).
+
+    ``weight_dtype`` "int8"/"int4" quantizes the linear weights at the load
+    boundary (models/quantize.py; the fp file on disk stays canonical). On
+    the mesh path each device quantizes only its own slice, so a quantized
+    serving replica never holds an fp copy of a quantized weight."""
     run_dir = run_name_or_dir if os.path.isdir(run_name_or_dir) else os.path.join(runs_root, run_name_or_dir)
     cfg = Config.from_yaml(os.path.join(run_dir, "config.yaml"))
     tok = TokenizerManager.from_run_dir(run_dir)
@@ -1602,14 +1608,25 @@ def load_trained(run_name_or_dir: str, runs_root: str = "runs", mesh=None):
         raise FileNotFoundError(f"no verified checkpoints in {run_dir}")
     model_path, _, _ = ckpts.paths_for_step(tag)
     ref = resolve_architecture(cfg.model.architecture)
+    from ..models.quantize import check_weight_dtype, quantize_weights
+
+    wd = check_weight_dtype(weight_dtype)
     params0 = jax.eval_shape(lambda: ref.init_params(jax.random.PRNGKey(0), args))
+    if wd != "fp":
+        # Restructure against the QUANTIZED shape tree — the loaded arrays
+        # carry weight_q/weight_q4/weight_s leaves, not fp weights.
+        params0 = jax.eval_shape(lambda p: quantize_weights(p, wd), params0)
+    from ..checkpoint.manager import _quantize_flat_np
     from ..checkpoint.safetensors_io import load_safetensors
     from ..utils.tree import unflatten_dict
 
     arrays, _ = load_safetensors(model_path)
     if mesh is not None:
-        nested = unflatten_dict(CheckpointManager.shard_arrays(arrays, mesh))
+        nested = unflatten_dict(
+            CheckpointManager.shard_arrays(arrays, mesh, weight_dtype=wd))
     else:
+        if wd != "fp":
+            arrays = _quantize_flat_np(arrays, wd)
         nested = unflatten_dict({k: jnp.asarray(v) for k, v in arrays.items()})
     params = _restructure(params0, nested)
     return params, args, tok, cfg

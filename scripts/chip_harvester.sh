@@ -70,6 +70,8 @@ JOBS=(
   "breakdown_100m 700"
   "one_decode_100m 450"
   "one_decode_100m_16k_int8 560"
+  "one_decode_100m_16k_w8 600"
+  "one_decode_100m_16k_w4 600"
   "one_trainer_spd8 700"
   "train40m 1600"
   "infbench40m 700"

@@ -22,8 +22,24 @@ comparable) — bench.py's ``_perf_gate`` treats 2 like the audit gate
 treats a crash: logged, never gating. Rows whose values are null
 (device-unreachable skip rows) are skipped, not failed.
 
-``--write-baseline`` regenerates bench_baseline.json from the newest
-doc's complete rows, preserving the configured tolerance.
+The committed baseline is PER-BACKEND (schema v2): numbers measured on
+a CPU host must never gate a TPU run and vice versa, so
+``bench_baseline.json`` keys its pinned cases by the backend family
+("cpu" / "tpu" / "gpu", derived from the bench doc's ``device`` stamp)
+and the gate compares only the section matching the doc under test. A
+doc whose backend has no committed section exits 2 (infrastructure, not
+regression) with a ``--write-baseline`` hint. Legacy v1 baselines
+(top-level ``cases``) are read as if their cases belonged to the
+current doc's backend.
+
+Serving decode rows (``decode_tok_s`` / ``prefill_tok_s`` — including
+the weight-only int8/int4 ``decode_*_w8``/``_w4`` arms) are gateable
+metrics alongside the training ones, so a quantized-serving perf
+regression fails the gate like a train-step one.
+
+``--write-baseline`` regenerates the CURRENT backend's section from the
+newest doc's complete rows, preserving the other backends' sections and
+the configured tolerance.
 
 Stdlib only; run as ``python scripts/perf_gate.py`` from anywhere.
 """
@@ -44,6 +60,8 @@ DEFAULT_BASELINE = os.path.join(REPO, "bench_baseline.json")
 DIRECTIONS = {
     "tok_s": +1,
     "mfu": +1,
+    "decode_tok_s": +1,
+    "prefill_tok_s": +1,
     "prof_compute_frac": +1,
     "prof_overlap_frac": +1,
     "prof_comm_frac": -1,
@@ -71,15 +89,28 @@ def find_newest_bench(root: str) -> Optional[str]:
     return best[1]
 
 
+def doc_backend(doc: Dict[str, Any]) -> str:
+    """Backend family ("cpu" | "tpu" | "gpu") of a bench doc's device
+    stamp, e.g. "TFRT_CPU_0" -> cpu, "TPU v5e" -> tpu."""
+    device = str(doc.get("device") or "").lower()
+    if "tpu" in device:
+        return "tpu"
+    if any(k in device for k in ("gpu", "cuda", "nvidia", "rocm")):
+        return "gpu"
+    return "cpu"
+
+
 def _rows_by_case(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
-    """First complete (tok_s numeric, not preempted) row per case —
-    same clean-row preference as bench.py's headline pick."""
+    """First complete (any gateable metric numeric, not preempted) row
+    per case — same clean-row preference as bench.py's headline pick.
+    Decode/serve rows carry ``decode_tok_s`` instead of ``tok_s``, so
+    completeness means ANY direction-pinned metric measured."""
     out: Dict[str, Dict[str, Any]] = {}
     for row in doc.get("matrix") or []:
         case = row.get("case")
         if not case or case in out:
             continue
-        if not isinstance(row.get("tok_s"), (int, float)):
+        if not any(isinstance(row.get(m), (int, float)) for m in DIRECTIONS):
             continue
         if row.get("preempted"):
             continue
@@ -87,17 +118,35 @@ def _rows_by_case(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
     return out
 
 
+def backend_section(baseline: Dict[str, Any], backend: str
+                    ) -> Optional[Dict[str, Any]]:
+    """The {source, cases} section gating ``backend``, or None.
+
+    v2 looks it up under ``backends``; a v1 baseline (top-level
+    ``cases``) is treated as the current backend's section."""
+    backends = baseline.get("backends")
+    if isinstance(backends, dict):
+        sec = backends.get(backend)
+        return sec if isinstance(sec, dict) else None
+    if isinstance(baseline.get("cases"), dict):  # legacy v1
+        return {"source": baseline.get("source"),
+                "cases": baseline["cases"]}
+    return None
+
+
 def compare(doc: Dict[str, Any], baseline: Dict[str, Any],
             tolerance: Optional[float] = None
             ) -> Tuple[List[str], List[str], List[str]]:
-    """(lines, regressions, improvements) over every pinned metric."""
+    """(lines, regressions, improvements) over every pinned metric of
+    the section matching the doc's backend."""
     tol = float(baseline.get("tolerance", 0.15)
                 if tolerance is None else tolerance)
     rows = _rows_by_case(doc)
+    section = backend_section(baseline, doc_backend(doc)) or {}
     lines: List[str] = []
     regressions: List[str] = []
     improvements: List[str] = []
-    for case, pinned in sorted((baseline.get("cases") or {}).items()):
+    for case, pinned in sorted((section.get("cases") or {}).items()):
         row = rows.get(case)
         if row is None:
             lines.append(f"perf_gate: case={case} SKIP (no complete row "
@@ -139,15 +188,31 @@ def compare(doc: Dict[str, Any], baseline: Dict[str, Any],
 
 def write_baseline(doc: Dict[str, Any], path: str, tolerance: float,
                    source: str) -> int:
-    """Pin every complete row's gateable metrics; returns cases pinned."""
+    """Pin every complete row's gateable metrics under the doc's
+    backend, preserving other backends' committed sections; returns
+    cases pinned."""
     cases: Dict[str, Dict[str, float]] = {}
     for case, row in sorted(_rows_by_case(doc).items()):
         pinned = {m: row[m] for m in BASELINE_METRICS
                   if isinstance(row.get(m), (int, float))}
         if pinned:
             cases[case] = pinned
-    out = {"version": 1, "tool": "perf_gate", "tolerance": tolerance,
-           "source": os.path.basename(source), "cases": cases}
+    backends: Dict[str, Any] = {}
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            prev = json.load(f)
+        if isinstance(prev.get("backends"), dict):
+            backends = dict(prev["backends"])
+        elif isinstance(prev.get("cases"), dict):  # migrate v1 in place
+            backends = {doc_backend({"device": prev.get("device")}):
+                        {"source": prev.get("source"),
+                         "cases": prev["cases"]}}
+    except (OSError, json.JSONDecodeError, ValueError):
+        pass
+    backends[doc_backend(doc)] = {"source": os.path.basename(source),
+                                  "cases": cases}
+    out = {"version": 2, "tool": "perf_gate", "tolerance": tolerance,
+           "backends": backends}
     tmp = path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump(out, f, indent=1, sort_keys=True)
@@ -206,9 +271,17 @@ def main(argv=None) -> int:
               f"create one with --write-baseline", file=sys.stderr)
         return 2
 
+    backend = doc_backend(doc)
+    if backend_section(baseline, backend) is None:
+        print(f"perf_gate: baseline has no section for backend "
+              f"`{backend}` — create one with --write-baseline",
+              file=sys.stderr)
+        return 2
+
     lines, regressions, improvements = compare(doc, baseline,
                                                args.tolerance)
     print(f"perf_gate: doc={os.path.basename(bench_path)} "
+          f"backend={backend} "
           f"baseline={os.path.basename(args.baseline)}")
     for line in lines:
         print(line)

@@ -528,7 +528,10 @@ def test_perf_gate_write_baseline_roundtrip(tmp_path):
     assert rc == 0
     with open(base_path) as f:
         base = json.load(f)
-    assert base["cases"] == {"2m_flash": {
+    # Schema v2: cases pinned under the doc's backend section (the doc
+    # carries no device stamp, so it lands under "cpu").
+    assert base["version"] == 2
+    assert base["backends"]["cpu"]["cases"] == {"2m_flash": {
         "tok_s": 1200.0, "mfu": 0.06,
         "prof_compute_frac": 0.7, "prof_idle_frac": 0.1}}
     # And the fresh baseline gates its own doc clean.
@@ -540,10 +543,13 @@ def test_committed_baseline_is_valid():
     gate = _load_script("perf_gate")
     with open(os.path.join(REPO, "bench_baseline.json")) as f:
         base = json.load(f)
-    assert base["cases"]
-    for case, pinned in base["cases"].items():
-        for metric in pinned:
-            assert metric in gate.DIRECTIONS, (case, metric)
+    assert base["version"] == 2 and base["backends"]
+    for backend, section in base["backends"].items():
+        assert backend in ("cpu", "tpu", "gpu")
+        assert section["cases"]
+        for case, pinned in section["cases"].items():
+            for metric in pinned:
+                assert metric in gate.DIRECTIONS, (backend, case, metric)
 
 
 # -- trainer auto-report (slow) -------------------------------------------

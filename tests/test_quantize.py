@@ -1,0 +1,505 @@
+"""Low-precision end to end: weight-only int8/int4 serving + scaled
+low-precision training matmuls.
+
+Serving bar: per-channel weight-only quantization is a LAYOUT change,
+never a decode-policy change — greedy w8 serving must be text-identical
+to fp on the test model (including on top of the int8 KV cache and the
+prefix cache), quantize-on-load must place only quantized slices (per-
+device byte accounting, no fp replica), and a live engine must hot-swap
+an fp checkpoint INTO its quantized layout. Training bar: the opt-in
+int8 matmul path (model.matmul_precision) tracks loss parity with the
+bf16 cast within the same order of deviation.
+"""
+
+import dataclasses
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlx_cuda_distributed_pretraining_tpu.checkpoint.manager import (
+    CheckpointManager,
+)
+from mlx_cuda_distributed_pretraining_tpu.checkpoint.safetensors_io import (
+    save_safetensors,
+)
+from mlx_cuda_distributed_pretraining_tpu.config import DataConfig, ModelConfig
+from mlx_cuda_distributed_pretraining_tpu.models import llama
+from mlx_cuda_distributed_pretraining_tpu.models.llama import LlamaArgs
+from mlx_cuda_distributed_pretraining_tpu.models.quantize import (
+    check_weight_dtype,
+    dequantize_leaf,
+    pack_int4,
+    quantize_leaf,
+    quantize_weights,
+    quantized_key_shapes,
+    unpack_int4,
+    weight_dtype_of,
+    weight_plane_bytes,
+)
+from mlx_cuda_distributed_pretraining_tpu.parallel import build_serve_mesh
+from mlx_cuda_distributed_pretraining_tpu.parallel.sharding_rules import (
+    param_pspec,
+)
+from mlx_cuda_distributed_pretraining_tpu.serve import BatchEngine, EngineConfig
+from mlx_cuda_distributed_pretraining_tpu.tokenizer import TokenizerManager
+from mlx_cuda_distributed_pretraining_tpu.utils.tree import flatten_dict
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOK = TokenizerManager(DataConfig())
+ARGS = LlamaArgs(
+    vocab_size=TOK.vocab_size, hidden_size=32, intermediate_size=64,
+    num_layers=2, num_heads=4, num_kv_heads=2, head_dim=8,
+    max_position_embeddings=128,
+)
+PARAMS = llama.init_params(jax.random.PRNGKey(0), ARGS)
+MAX_LEN = 128
+PROMPTS = ["the quick brown fox", "a b c a b c a"]
+
+
+# -- quantization core --------------------------------------------------------
+
+def test_check_weight_dtype_normalizes_and_rejects():
+    assert check_weight_dtype(None) == "fp"
+    assert check_weight_dtype("") == "fp"
+    assert check_weight_dtype("FP32") == "fp"
+    assert check_weight_dtype("bf16") == "fp"
+    assert check_weight_dtype("INT8") == "int8"
+    assert check_weight_dtype("int4") == "int4"
+    with pytest.raises(ValueError, match="weight_dtype"):
+        check_weight_dtype("fp8")
+
+
+@pytest.mark.parametrize("wd", ["int8", "int4"])
+def test_per_channel_round_trip(wd):
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(64, 48)).astype(np.float32))
+    leaf = quantize_leaf(w, wd)
+    back = dequantize_leaf(leaf)
+    # Symmetric per-output-channel grid: worst-case round-trip error is
+    # half a quantization step of that channel's own scale.
+    step = np.asarray(leaf["weight_s"])
+    err = np.abs(np.asarray(back) - np.asarray(w))
+    assert (err.max(axis=0) <= step / 2 + 1e-6).all()
+
+
+def test_int4_pack_unpack_exact():
+    rng = np.random.default_rng(1)
+    vals = jnp.asarray(rng.integers(-7, 8, size=(64, 24)).astype(np.int8))
+    packed = pack_int4(vals)
+    assert packed.shape == (32, 24) and packed.dtype == jnp.int8
+    assert (unpack_int4(packed) == vals).all()
+    # expert-bank layout round-trips too
+    bank = jnp.asarray(rng.integers(-7, 8, size=(3, 16, 8)).astype(np.int8))
+    assert (unpack_int4(pack_int4(bank)) == bank).all()
+    with pytest.raises(ValueError, match="even contraction"):
+        pack_int4(vals[:63])
+
+
+def test_quantized_key_shapes_and_odd_contraction():
+    out = quantized_key_shapes("layers.0.attention.wq.weight", (32, 32),
+                               "int4")
+    assert out == {"layers.0.attention.wq.weight_q4": (16, 32),
+                   "layers.0.attention.wq.weight_s": (32,)}
+    # odd contraction dim cannot pack two nibbles per byte: stays fp
+    assert quantized_key_shapes("layers.0.attention.wq.weight", (33, 32),
+                                "int4") is None
+    # non-matmul leaves never quantize
+    assert quantized_key_shapes("layers.0.attention_norm.weight", (32,),
+                                "int8") is None
+    assert quantized_key_shapes("tok_embeddings.weight", (256, 32),
+                                "int8") is None
+
+
+@pytest.mark.parametrize("wd", ["int8", "int4"])
+def test_forward_matches_dequantized_oracle(wd):
+    # The quantized apply (int storage, scale in the matmul epilogue)
+    # must match the fp forward over DEQUANTIZED weights — same grid
+    # points, different layout; only float associativity differs.
+    pq = quantize_weights(PARAMS, wd)
+    assert weight_dtype_of(pq) == wd
+
+    def dequant(tree):
+        if isinstance(tree, dict):
+            if "weight_q" in tree or "weight_q4" in tree:
+                out = {k: v for k, v in tree.items()
+                       if k not in ("weight_q", "weight_q4", "weight_s")}
+                out["weight"] = dequantize_leaf(tree)
+                return out
+            return {k: dequant(v) for k, v in tree.items()}
+        if isinstance(tree, list):
+            return [dequant(v) for v in tree]
+        return tree
+
+    toks = jnp.asarray([[5, 9, 3, 7, 2, 8]], jnp.int32)
+    out_q, _ = llama.forward(pq, toks, ARGS)
+    out_ref, _ = llama.forward(dequant(pq), toks, ARGS)
+    np.testing.assert_allclose(np.asarray(out_q), np.asarray(out_ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_weight_plane_bytes_ratios():
+    fp = weight_plane_bytes(PARAMS)
+    w8 = weight_plane_bytes(quantize_weights(PARAMS, "int8"))
+    w4 = weight_plane_bytes(quantize_weights(PARAMS, "int4"))
+    assert fp > w8 > w4
+
+
+# -- engine parity ------------------------------------------------------------
+
+def _engine(mesh=None, **kw):
+    cfg = EngineConfig(**{"num_slots": 2, "max_len": MAX_LEN,
+                          "prefill_chunk": 16, **kw})
+    return BatchEngine(PARAMS, ARGS, TOK, cfg, mesh=mesh)
+
+
+def _collect(eng, prompts, max_tokens=20):
+    eng.start()
+    outs = [None] * len(prompts)
+    try:
+        def run(i):
+            outs[i] = eng.generate(prompts[i], max_tokens=max_tokens,
+                                   temperature=0.0, timeout=300.0)
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        metrics = eng.metrics()
+    finally:
+        eng.stop()
+    return outs, metrics
+
+
+@pytest.mark.parametrize("arm", [
+    {"weight_dtype": "int8"},
+    {"weight_dtype": "int8", "kv_quant": True},   # w8 on top of int8 KV
+    {"weight_dtype": "int4"},
+], ids=["w8", "w8_kv8", "w4"])
+def test_weight_quant_greedy_matches_fp(arm):
+    ref, _ = _collect(_engine(**{k: v for k, v in arm.items()
+                                 if k != "weight_dtype"}), PROMPTS)
+    q, m = _collect(_engine(**arm), PROMPTS)
+    assert m["weight_dtype"] == arm["weight_dtype"]
+    assert m["weight_bytes"] < weight_plane_bytes(PARAMS)
+    for r, t in zip(ref, q):
+        assert t["finish_reason"] == r["finish_reason"]
+        if arm["weight_dtype"] == "int8":
+            # acceptance bar: w8 greedy is token-exact vs fp
+            assert t["text"] == r["text"]
+            assert t["tokens"] == r["tokens"]
+
+
+def test_weight_quant_prefix_cache_adoption_parity():
+    shared = "the quick brown fox jumps over the lazy dog and then"
+    prompts = [shared + " stops", shared + " keeps going"]
+
+    def run(eng):
+        eng.start()
+        try:
+            outs = [eng.generate(p, max_tokens=16, temperature=0.0,
+                                 timeout=300.0) for p in prompts]
+            return outs, eng.metrics()["prefix_cache_hits"]
+        finally:
+            eng.stop()
+
+    ref, ref_hits = run(_engine(block_size=16, prefix_min_hit_blocks=1))
+    q, q_hits = run(_engine(block_size=16, prefix_min_hit_blocks=1,
+                            weight_dtype="int8"))
+    assert q_hits == ref_hits and q_hits >= 1
+    for r, t in zip(ref, q):
+        assert t["text"] == r["text"]
+        assert t["prefix_cached_tokens"] == r["prefix_cached_tokens"]
+
+
+def test_engine_hot_swap_fp_checkpoint_into_quantized_replica(tmp_path):
+    # A live w8 replica receives an fp checkpoint (the trainer's output):
+    # swap_params must quantize it INTO the serving layout, bump the
+    # version, and keep greedy output identical (same weights in).
+    flat = {k: np.asarray(v) for k, v in flatten_dict(PARAMS).items()}
+    path = str(tmp_path / "model.safetensors")
+    save_safetensors(path, flat)
+
+    eng = _engine(weight_dtype="int8")
+    eng.start()
+    try:
+        base = eng.generate(PROMPTS[0], max_tokens=16, temperature=0.0,
+                            timeout=300.0)
+        loaded = CheckpointManager.load_params(path, like=PARAMS)
+        version = eng.swap_params(loaded)
+        assert version == 1
+        post = eng.generate(PROMPTS[0], max_tokens=16, temperature=0.0,
+                            timeout=300.0)
+        m = eng.metrics()
+        assert m["params_version"] == 1
+        assert m["weight_dtype"] == "int8"
+        assert weight_dtype_of(eng.params) == "int8"
+        assert post["text"] == base["text"]
+        assert post["tokens"] == base["tokens"]
+    finally:
+        eng.stop()
+
+
+# -- quantize-on-load ---------------------------------------------------------
+
+def test_load_params_quantize_matches_host_quantization(tmp_path):
+    flat = {k: np.asarray(v) for k, v in flatten_dict(PARAMS).items()}
+    path = str(tmp_path / "model.safetensors")
+    save_safetensors(path, flat)
+    loaded = CheckpointManager.load_params(path, like=PARAMS,
+                                           weight_dtype="int8")
+    want = flatten_dict(quantize_weights(PARAMS, "int8"))
+    got = flatten_dict(loaded)
+    assert set(want) == set(got)
+    for k in want:
+        np.testing.assert_array_equal(np.asarray(want[k]),
+                                      np.asarray(got[k]), err_msg=k)
+
+
+def test_reshard_on_load_int4_per_device_byte_budget(tmp_path):
+    # fp safetensors stays canonical; each tp=2 device quantizes only its
+    # own slice. Whole-tree per-device byte accounting must come out
+    # EXACTLY at the quantized layout's cost (sharded leaves place once
+    # across the mesh, replicated ones once per device — no fp replica of
+    # any quantized weight anywhere), with the quantized plane itself
+    # under a quarter of its fp bytes.
+    flat_host = {k: np.asarray(v) for k, v in flatten_dict(PARAMS).items()}
+    path = str(tmp_path / "model.safetensors")
+    save_safetensors(path, flat_host)
+
+    mesh = build_serve_mesh({"tp": 2}, devices=jax.devices()[:2])
+    loaded = CheckpointManager.load_params(path, like=PARAMS, mesh=mesh,
+                                           weight_dtype="int4")
+    assert weight_dtype_of(loaded) == "int4"
+    flat = flatten_dict(loaded)
+
+    expected = actual = 0
+    for k, v in flat.items():
+        sharded = any(ax is not None for ax in param_pspec(k, v.shape, mesh))
+        expected += v.nbytes * (1 if sharded else 2)
+        actual += sum(s.data.nbytes for s in v.addressable_shards)
+    assert actual == expected
+
+    # per-device slices reproduce the host-side full quantization exactly
+    want = flatten_dict(quantize_weights(PARAMS, "int4"))
+    assert set(want) == set(flat)
+    for k in want:
+        np.testing.assert_array_equal(np.asarray(want[k]),
+                                      np.asarray(flat[k]), err_msg=k)
+
+    # quantized plane (ints + scales) lands below fp/4
+    q_bytes = sum(v.nbytes for k, v in flat.items()
+                  if k.endswith(("weight_q4", "weight_s")))
+    fp_bytes = sum(v.nbytes for k, v in flatten_dict(PARAMS).items()
+                   if quantized_key_shapes(k, v.shape, "int4"))
+    assert 0 < q_bytes < fp_bytes / 4
+
+    # and it serves: greedy output matches the host-quantized engine
+    cfg = EngineConfig(num_slots=2, max_len=MAX_LEN, prefill_chunk=16)
+    q, _ = _collect(BatchEngine(loaded, ARGS, TOK, cfg, mesh=mesh),
+                    PROMPTS[:1])
+    host_q, _ = _collect(_engine(weight_dtype="int4"), PROMPTS[:1])
+    assert q[0]["text"] == host_q[0]["text"]
+
+
+# -- training matmul precision ------------------------------------------------
+
+def test_model_config_matmul_precision_validation():
+    assert ModelConfig(matmul_precision="INT8").matmul_precision == "int8"
+    assert ModelConfig(matmul_precision="fp32").matmul_precision is None
+    assert ModelConfig().matmul_precision is None
+    with pytest.raises(ValueError, match="matmul_precision"):
+        ModelConfig(matmul_precision="fp8")
+    mc = ModelConfig(matmul_precision="bf16")
+    assert LlamaArgs.from_config(mc, 256).matmul_precision == "bf16"
+
+
+def test_matmul_precision_loss_parity_vs_bf16():
+    # int8 fake-quant forward must track the fp loss within the same
+    # order of deviation as the bf16 operand cast — the "is low precision
+    # safe to turn on" gate.
+    args = dataclasses.replace(ARGS, attention_type="flash")
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 128), 0,
+                              ARGS.vocab_size)
+
+    def loss_fn(p, a):
+        logits, _ = llama.forward(p, toks, a)
+        lse = jax.nn.log_softmax(logits.astype(jnp.float32))
+        return -jnp.mean(jnp.take_along_axis(lse[:, :-1],
+                                             toks[:, 1:, None], -1))
+
+    losses = {}
+    for prec in (None, "bf16", "int8"):
+        a = dataclasses.replace(args, matmul_precision=prec)
+        losses[prec] = float(loss_fn(PARAMS, a))
+        g = jax.grad(loss_fn)(PARAMS, a)
+        assert all(bool(jnp.all(jnp.isfinite(x)))
+                   for x in jax.tree_util.tree_leaves(g))
+    base = abs(losses[None]) + 1e-12
+    dev_bf16 = abs(losses["bf16"] - losses[None]) / base
+    dev_int8 = abs(losses["int8"] - losses[None]) / base
+    assert dev_int8 < 1e-4
+    assert dev_int8 <= max(10.0 * dev_bf16, 1e-5)
+
+
+def test_gmm_int8_precision_fwd_bwd():
+    from mlx_cuda_distributed_pretraining_tpu.ops import grouped_matmul as gm
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(256, 64)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(4, 64, 96)), jnp.float32) * 0.1
+    gs = jnp.array([64, 128, 0, 64], jnp.int32)
+    y_fp = gm.gmm(x, w, gs, block_t=64)
+    y_q = gm.gmm(x, w, gs, block_t=64, precision="int8")
+    rel = float(jnp.linalg.norm(y_q - y_fp) / jnp.linalg.norm(y_fp))
+    assert 0 < rel < 0.02  # quantized but close
+
+    def loss(prec):
+        def f(x, w):
+            return jnp.sum(gm.gmm(x, w, gs, block_t=64, precision=prec) ** 2)
+        return f
+
+    gx_fp, gw_fp = jax.grad(loss(None), argnums=(0, 1))(x, w)
+    gx_q, gw_q = jax.grad(loss("int8"), argnums=(0, 1))(x, w)
+    for a, b in ((gx_q, gx_fp), (gw_q, gw_fp)):
+        rel = float(jnp.linalg.norm(a - b) / jnp.linalg.norm(b))
+        assert rel < 0.02
+
+
+def test_flash_attention_precision_modes():
+    from mlx_cuda_distributed_pretraining_tpu.ops.flash_attention import (
+        check_matmul_precision,
+        flash_attention,
+    )
+
+    assert check_matmul_precision(None) is None
+    assert check_matmul_precision("FP32") is None
+    assert check_matmul_precision("int8") == "int8"
+    with pytest.raises(ValueError, match="precision"):
+        check_matmul_precision("fp8")
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(2, 128, 4, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 128, 4, 16)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 128, 4, 16)), jnp.float32)
+    o = flash_attention(q, k, v, mask_type="causal")
+    o8 = flash_attention(q, k, v, mask_type="causal", precision="int8")
+    rel = float(jnp.linalg.norm(o8 - o) / jnp.linalg.norm(o))
+    assert 0 < rel < 0.05
+    g = jax.grad(lambda q: jnp.sum(flash_attention(
+        q, k, v, mask_type="causal", precision="int8") ** 2))(q)
+    assert bool(jnp.all(jnp.isfinite(g)))
+
+
+# -- bandwidth decode model ---------------------------------------------------
+
+def test_weight_bytes_per_token_and_roofline():
+    from mlx_cuda_distributed_pretraining_tpu.obs.flops import (
+        decode_roofline_tok_s,
+        weight_bytes_per_token,
+    )
+
+    mc = ModelConfig(dimensions={"hidden_size": 256, "intermediate_size": 512,
+                                 "num_layers": 4},
+                     attention={"num_heads": 8, "num_kv_heads": 8,
+                                "head_dim": 32})
+    n = 3_000_000  # matmul-plane dominated at these dims
+    fp = weight_bytes_per_token(mc, n, "fp")
+    w8 = weight_bytes_per_token(mc, n, "int8")
+    w4 = weight_bytes_per_token(mc, n, "int4")
+    assert fp > w8 > w4
+    with pytest.raises(ValueError, match="weight_dtype"):
+        weight_bytes_per_token(mc, n, "fp8")
+    assert decode_roofline_tok_s(w8, None) is None
+    assert decode_roofline_tok_s(w8, 1e12) == pytest.approx(1e12 / w8)
+    # the int8 roofline clears the 1.5x decode acceptance bar analytically
+    assert decode_roofline_tok_s(w8, 1e12) > 1.5 * decode_roofline_tok_s(
+        fp, 1e12)
+
+
+# -- graftaudit rule ----------------------------------------------------------
+
+def _rule_prog(fn, paths, *avals):
+    from mlx_cuda_distributed_pretraining_tpu.analysis.audit_rules import (
+        ArgLeaf,
+        AuditProgram,
+    )
+
+    traced = jax.jit(fn).trace(*avals)
+    leaves = []
+    for i, (p, a) in enumerate(zip(paths, jax.tree_util.tree_leaves(avals))):
+        leaves.append(ArgLeaf(index=i, name=p, path=p, shape=tuple(a.shape),
+                              dtype=str(a.dtype),
+                              nbytes=a.size * a.dtype.itemsize,
+                              donated=False))
+    return AuditProgram(name="t", config_name="t", lowered=traced.lower(),
+                        closed_jaxpr=traced.jaxpr, arg_leaves=leaves,
+                        out_avals=list(traced.jaxpr.out_avals))
+
+
+def test_dequant_materialization_rule():
+    from mlx_cuda_distributed_pretraining_tpu.analysis.audit_rules import (
+        DequantMaterialization,
+    )
+
+    rule = DequantMaterialization()
+    W = jax.ShapeDtypeStruct((512, 512), jnp.int8)
+    S = jax.ShapeDtypeStruct((512,), jnp.float32)
+    X = jax.ShapeDtypeStruct((4, 512), jnp.float32)
+    paths = ["a.weight_q", "a.weight_s", "x"]
+
+    # fused epilogue: convert feeds exactly one dot, scale after — clean
+    good = lambda wq, s, x: (x @ wq.astype(jnp.float32)) * s
+    assert list(rule.check(_rule_prog(good, paths, W, S, X))) == []
+
+    # dequant-then-scale BEFORE the dot: fp copy feeds a mul — flagged
+    bad = lambda wq, s, x: x @ (wq.astype(jnp.float32) * s)
+    found = list(rule.check(_rule_prog(bad, paths, W, S, X)))
+    assert len(found) == 1 and "a.weight_q" in found[0].message
+
+    # fp copy escaping as a program output — flagged
+    esc = lambda wq, s, x: ((x @ wq.astype(jnp.float32)) * s,
+                            wq.astype(jnp.float32))
+    assert len(list(rule.check(_rule_prog(esc, paths, W, S, X)))) == 1
+
+    # one fp copy reused by two matmuls — flagged
+    def reuse(wq, s, x):
+        w = wq.astype(jnp.float32)
+        return x @ w + (x * 2.0) @ w
+    assert len(list(rule.check(_rule_prog(reuse, paths, W, S, X)))) == 1
+
+    # int4 unpack chain (shifts -> convert -> single dot) — clean
+    def int4(wq4, s, x):
+        low = (wq4 << 4) >> 4
+        high = wq4 >> 4
+        w = jnp.stack([low, high], axis=1).reshape(1024, 512)
+        return (x @ w.astype(jnp.float32)) * s
+    W4 = jax.ShapeDtypeStruct((512, 512), jnp.int8)
+    X4 = jax.ShapeDtypeStruct((4, 1024), jnp.float32)
+    assert list(rule.check(_rule_prog(
+        int4, ["a.weight_q4", "a.weight_s", "x"], W4, S, X4))) == []
+
+
+@pytest.mark.slow
+def test_audit_serve_decode_quantized_programs_clean():
+    from mlx_cuda_distributed_pretraining_tpu.analysis.audit import (
+        build_programs,
+    )
+    from mlx_cuda_distributed_pretraining_tpu.analysis.audit_rules import (
+        DequantMaterialization,
+    )
+
+    progs = build_programs(
+        os.path.join(REPO, "configs", "model-config-sample.yaml"),
+        wanted=("serve_decode_w8", "serve_decode_w4"))
+    assert [p.name for p in progs] == ["serve_decode_w8", "serve_decode_w4"]
+    rule = DequantMaterialization()
+    for prog in progs:
+        assert any(leaf.path.endswith(("weight_q", "weight_q4"))
+                   for leaf in prog.arg_leaves), "params not quantized"
+        assert list(rule.check(prog)) == []
