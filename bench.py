@@ -2924,6 +2924,41 @@ def _audit_gate() -> None:
     sys.exit(1)
 
 
+def _alerts_gate() -> None:
+    """graftscope companion to the lint gate: refuse to bench a tree
+    whose configs/alerts.yaml is invalid — a typo'd metric name or a
+    dangling capture action means the fleet the bench exercises would
+    silently never alert. Missing file passes (alerts are optional);
+    shares BENCH_LINT=0 as the escape hatch."""
+    if os.environ.get("BENCH_LINT") == "0":
+        return
+    repo = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(repo, "configs", "alerts.yaml")
+    if not os.path.isfile(path):
+        return
+    try:
+        import yaml
+
+        from mlx_cuda_distributed_pretraining_tpu.obs.alerts import (
+            validate_rules)
+        with open(path) as fh:
+            doc = yaml.safe_load(fh) or {}
+        errors = validate_rules(doc)
+    except Exception as e:  # noqa: BLE001 - a validator bug must not brick benching
+        log(f"[bench] alerts gate errored ({e}); continuing without it")
+        return
+    if not errors:
+        return
+    for err in errors[:20]:
+        log(f"[bench] alerts: {err}")
+    print(json.dumps({
+        "error": f"configs/alerts.yaml has {len(errors)} error(s) — fix "
+                 "them first (BENCH_LINT=0 to force)",
+        "value": 0,
+    }), flush=True)
+    sys.exit(1)
+
+
 def _perf_gate() -> None:
     """Perf companion to the lint/audit gates, run AFTER the bench so it
     scores the matrix this run just measured: scripts/perf_gate.py
@@ -3023,6 +3058,7 @@ if __name__ == "__main__":
         _lint_gate()  # before the atexit hook: a refusal must emit no doc
         _sync_gate()
         _audit_gate()
+        _alerts_gate()
         atexit.register(emit, "atexit")
         signal.signal(signal.SIGTERM, _on_signal)
         signal.signal(signal.SIGINT, _on_signal)

@@ -230,6 +230,14 @@ class LoggingConfig:
     # defaults on — a captured trace that nobody attributes is the
     # status quo this knob exists to end; top_k sizes the op table.
     profile_report: Dict[str, Any] = field(default_factory=dict)
+    # events.jsonl policy: {max_bytes: int}. max_bytes > 0 rotates the
+    # live log to events.1.jsonl when it would exceed the cap
+    # (obs/events.py EventLog); 0 keeps the legacy unbounded file.
+    events: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def events_max_bytes(self) -> int:
+        return int(_get(self.events, "max_bytes", 0))
 
     @property
     def logging_interval(self) -> int:

@@ -106,7 +106,7 @@ DASHBOARD_HTML = """<!DOCTYPE html>
   <div class="panel">
     <h2>Workers</h2>
     <table id="workers"><thead><tr>
-      <th></th><th>worker</th><th>step</th><th>loss</th><th>tok/s</th><th>mfu</th><th>moe ent</th><th>cache hit</th><th>ttft p50/p95</th><th>mesh</th><th>weights</th><th>last seen</th>
+      <th></th><th>worker</th><th>step</th><th>loss</th><th>tok/s</th><th>mfu</th><th>moe ent</th><th>cache hit</th><th>ttft p50/p95</th><th>mesh</th><th>weights</th><th>alerts</th><th>last seen</th>
     </tr></thead><tbody></tbody></table>
   </div>
 </div>
@@ -329,6 +329,13 @@ function renderWorkers(workers, agg) {
       "<td>" + (typeof m.mesh === "string" ? m.mesh : "–") + "</td>" +
       // Serving weight dtype ("fp" / "int8" / "int4"; training "–").
       "<td>" + (typeof m.weight_dtype === "string" ? m.weight_dtype : "–") + "</td>" +
+      // graftscope column: workers whose stats carry a firing-alert
+      // count (GET /alerts fed by obs/scope.py). Absent key -> "–" so
+      // fleets without a collector render unchanged.
+      "<td>" + (typeof m.alerts_firing === "number" ?
+        (m.alerts_firing > 0 ?
+          '<span style="color:var(--status-critical)">\\u26a0 ' +
+            m.alerts_firing.toFixed(0) + "</span>" : "0") : "–") + "</td>" +
       '<td style="color:var(' + (alive ? "--status-good" : "--status-critical") +
       ')">' + (alive ? "\\u25cf " + Math.round(ago) + "s ago" : "\\u25cb stale") + "</td>";
     tb.appendChild(tr);

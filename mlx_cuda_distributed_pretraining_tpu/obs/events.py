@@ -42,11 +42,21 @@ from typing import Any, Callable, Dict, Iterator, Optional
 
 SCHEMA_VERSION = 1
 EVENTS_FILENAME = "events.jsonl"
+ROTATED_EVENTS_FILENAME = "events.1.jsonl"
 HEARTBEAT_FILENAME = "heartbeat.json"
 
 
 def events_path(run_dir: str) -> str:
     return os.path.join(run_dir, EVENTS_FILENAME)
+
+
+def rotated_events_path(path: str) -> str:
+    """``events.jsonl`` → ``events.1.jsonl`` next to it (one rotation
+    depth: the previous generation is enough for resume replay, and a
+    bounded pair keeps long runs from growing without limit)."""
+    d, base = os.path.split(path)
+    stem, ext = os.path.splitext(base)
+    return os.path.join(d, f"{stem}.1{ext}")
 
 
 def heartbeat_path(run_dir: str, process_index: int = 0) -> str:
@@ -82,19 +92,53 @@ def read_fleet_heartbeats(run_dir: str) -> Dict[int, Dict[str, Any]]:
 
 class EventLog:
     """Append-only writer. Keeps the fd open; one flushed write per event
-    so a crash loses at most the in-flight line (which readers skip)."""
+    so a crash loses at most the in-flight line (which readers skip).
 
-    def __init__(self, path: str, now: Callable[[], float] = time.time):
+    ``max_bytes`` (``logging.events.max_bytes`` in the config) bounds the
+    live file: when an append would push past the cap the current file is
+    rotated to ``events.1.jsonl`` (replacing any previous rotation) and a
+    fresh ``events.jsonl`` is opened.  Rotation happens BETWEEN complete
+    lines, so both files stay independently torn-tail tolerant and
+    :func:`iter_events`/:func:`replay_into` read the pair in order.
+    0 (the default) means unbounded — the pre-rotation behavior."""
+
+    def __init__(self, path: str, now: Callable[[], float] = time.time,
+                 max_bytes: int = 0):
         self.path = path
         self._now = now
+        self.max_bytes = int(max_bytes or 0)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._f = open(path, "a", encoding="utf-8")
+        try:
+            self._size = os.fstat(self._f.fileno()).st_size
+        except OSError:
+            self._size = 0
+
+    def _rotate(self) -> None:
+        try:
+            self._f.close()
+        except Exception:
+            pass
+        try:
+            os.replace(self.path, rotated_events_path(self.path))
+        except OSError:
+            pass  # keep appending to the oversized file over losing events
+        self._f = open(self.path, "a", encoding="utf-8")
+        try:
+            self._size = os.fstat(self._f.fileno()).st_size
+        except OSError:
+            self._size = 0
 
     def append(self, type: str, **fields: Any) -> Dict[str, Any]:
         ev = {"v": SCHEMA_VERSION, "type": str(type),
               "t": float(self._now()), **fields}
-        self._f.write(json.dumps(ev, separators=(",", ":")) + "\n")
+        line = json.dumps(ev, separators=(",", ":")) + "\n"
+        if (self.max_bytes > 0 and self._size > 0
+                and self._size + len(line) > self.max_bytes):
+            self._rotate()
+        self._f.write(line)
         self._f.flush()
+        self._size += len(line)
         return ev
 
     def close(self) -> None:
@@ -115,21 +159,25 @@ def append_event(path: str, type: str, **fields: Any) -> None:
 
 
 def iter_events(path: str) -> Iterator[Dict[str, Any]]:
-    """Yield parsed events; torn/garbage lines are skipped, unknown future
-    schema versions are yielded as-is (readers filter on what they know)."""
-    if not os.path.isfile(path):
-        return
-    with open(path, "r", encoding="utf-8") as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                ev = json.loads(line)
-            except json.JSONDecodeError:
-                continue  # torn final line from a crash mid-append
-            if isinstance(ev, dict) and "type" in ev:
-                yield ev
+    """Yield parsed events in append order; torn/garbage lines are
+    skipped, unknown future schema versions are yielded as-is (readers
+    filter on what they know).  When a rotated generation
+    (``events.1.jsonl``) sits next to ``path`` it is read first, so
+    replay after a size-capped rotation still sees the whole history."""
+    for p in (rotated_events_path(path), path):
+        if not os.path.isfile(p):
+            continue
+        with open(p, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn final line from a crash mid-append
+                if isinstance(ev, dict) and "type" in ev:
+                    yield ev
 
 
 def replay_into(registry, path: str) -> int:

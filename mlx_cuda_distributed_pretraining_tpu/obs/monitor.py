@@ -18,6 +18,39 @@ from .events import read_fleet_heartbeats
 from .plotting import STEP_RE, VAL_RE, KV_RE, parse_value, plot_run
 
 
+def fetch_alerts(url: Optional[str],
+                 timeout: float = 2.0) -> Optional[Dict[str, object]]:
+    """Best-effort ``GET /alerts`` from a graftscope collector.
+
+    Returns the parsed document or None — absent collector, connection
+    refused, bad JSON all read as "no alert data" (the monitor keeps its
+    plain status line, same absent-key tolerance as mfu/ttft)."""
+    if not url:
+        return None
+    import json
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url.rstrip("/") + "/alerts",
+                                    timeout=timeout) as resp:
+            doc = json.loads(resp.read().decode("utf-8", "replace"))
+        return doc if isinstance(doc, dict) else None
+    except Exception:
+        return None
+
+
+def alerts_status(doc: Optional[Dict[str, object]]) -> str:
+    """``alerts=2(rule-a,rule-b)`` from a /alerts document, or '' when
+    the doc is absent/empty/malformed."""
+    if not doc:
+        return ""
+    firing = [a.get("rule", "?") for a in doc.get("alerts", [])
+              if isinstance(a, dict) and a.get("state") == "firing"]
+    if not firing:
+        return "alerts=0"
+    return "alerts=%d(%s)" % (len(firing), ",".join(sorted(map(str, firing))))
+
+
 def fleet_status(run_dir: str, now: Optional[float] = None) -> str:
     """One-line per-host heartbeat summary for a multi-host run:
     ``hosts p0:s12(0.4s) p1:s12(0.6s)`` — step and heartbeat age per
@@ -127,6 +160,7 @@ def monitor(
     max_iters: Optional[int] = None,
     plot_every: int = 0,
     on_status: Optional[Callable[[str], None]] = None,
+    alerts_url: Optional[str] = None,
 ) -> LogTailer:
     """Poll loop. ``max_iters`` bounds iterations (None = until Ctrl-C)."""
     tailer = LogTailer(os.path.join(run_dir, "log.txt"))
@@ -137,7 +171,12 @@ def monitor(
             if tailer.poll():
                 line = tailer.status_line()
                 fleet = fleet_status(run_dir)
-                emit(f"{line} | {fleet}" if fleet else line)
+                if fleet:
+                    line = f"{line} | {fleet}"
+                alerts = alerts_status(fetch_alerts(alerts_url))
+                if alerts:
+                    line = f"{line} | {alerts}"
+                emit(line)
                 if plot_every and len(tailer.steps) % plot_every == 0:
                     try:
                         plot_run(run_dir)
@@ -159,6 +198,10 @@ def main(argv=None):
     parser.add_argument("--interval", type=float, default=5.0)
     parser.add_argument("--plot-every", type=int, default=0,
                         help="re-render loss_curve.png every N metric lines")
+    parser.add_argument("--alerts-url", default=None,
+                        help="graftscope collector base URL; firing-alert "
+                             "counts join the status line (absent-key "
+                             "tolerant — no collector, no column)")
     a = parser.parse_args(argv)
     run_dir = a.run
     if run_dir is None:
@@ -168,7 +211,8 @@ def main(argv=None):
         print(f"monitoring {run_dir}")
     elif not os.path.isdir(run_dir):
         run_dir = os.path.join(a.runs_root, run_dir)
-    monitor(run_dir, a.interval, plot_every=a.plot_every)
+    monitor(run_dir, a.interval, plot_every=a.plot_every,
+            alerts_url=a.alerts_url)
 
 
 if __name__ == "__main__":

@@ -82,27 +82,41 @@ def render_prometheus(snapshot: Dict[str, Any],
 
 class MetricsServer:
     """Background HTTP server bound to one registry. ``port`` is the bound
-    port (useful when constructed with port 0 in tests)."""
+    port (useful when constructed with port 0 in tests).
+
+    ``extra_routes`` lets an owner graft additional read-only GET paths
+    onto the same listener (graftscope's ``/alerts``) without a second
+    port: each value is a zero-arg callable returning
+    ``(body_bytes, content_type)``."""
 
     def __init__(self, registry, host: str = "127.0.0.1", port: int = 0,
-                 process_index: Optional[int] = None):
+                 process_index: Optional[int] = None,
+                 extra_routes: Optional[Dict[str, Any]] = None):
         self.registry = registry
         self.process_index = process_index
+        self.extra_routes = dict(extra_routes or {})
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — http.server API
-                if self.path.split("?")[0] == "/metrics":
+                route = self.path.split("?")[0]
+                if route == "/metrics":
                     body = render_prometheus(
                         outer.registry.snapshot(),
                         process_index=outer.process_index).encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
-                elif self.path.split("?")[0] in ("/healthz", "/health"):
+                elif route in ("/healthz", "/health"):
                     body = b"ok\n"
                     ctype = "text/plain; charset=utf-8"
-                elif self.path.split("?")[0] == "/snapshot":
+                elif route == "/snapshot":
                     body = (json.dumps(outer.registry.snapshot()) + "\n").encode()
                     ctype = "application/json"
+                elif route in outer.extra_routes:
+                    try:
+                        body, ctype = outer.extra_routes[route]()
+                    except Exception:
+                        self.send_error(500)
+                        return
                 else:
                     self.send_error(404)
                     return

@@ -645,7 +645,10 @@ class BatchEngine:
         return snap
 
     def _ttft_quantiles(self) -> Dict[str, float]:
-        """p50/p95/p99 TTFT estimated from the bounded histogram."""
+        """p50/p95/p99 TTFT estimated from the bounded histogram, plus
+        the histogram's sum/count so JSON consumers (graftscope, external
+        scrapers without the Prometheus port) can compute averages — the
+        quantile keys alone cannot recover a mean."""
         from ..obs.metrics import quantile_from_buckets
 
         snap = self.metrics_registry.snapshot().get("serve_ttft_ms")
@@ -658,6 +661,9 @@ class BatchEngine:
             v = quantile_from_buckets(s["buckets"], s["count"], q)
             if v is not None:
                 out[key] = round(v, 1)
+        if out:
+            out["ttft_ms_sum"] = round(float(s["sum"]), 3)
+            out["ttft_ms_count"] = int(s["count"])
         return out
 
     def _publish(self) -> None:

@@ -344,7 +344,8 @@ class FleetController:
                  spawn_fn: Optional[Callable[[str], Optional[str]]] = None,
                  stop_fn: Optional[Callable[[str], None]] = None,
                  fleet_dir: Optional[str] = None,
-                 log: Optional[Callable[[str], None]] = None):
+                 log: Optional[Callable[[str], None]] = None,
+                 scope=None):
         self.router = router
         self.cfg = cfg or FleetConfig()
         self.spawn_fn = spawn_fn    # role -> url of a fresh replica
@@ -354,6 +355,9 @@ class FleetController:
         self._idle_ticks: Dict[str, int] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # graftscope collector (obs/scope.py): one per fleet, lifecycle
+        # tied to the controller's — start() and stop() drive both.
+        self.scope = scope
 
     # -- pool pressure --------------------------------------------------------
     def pool_stats(self) -> Dict[str, Dict[str, object]]:
@@ -600,6 +604,8 @@ class FleetController:
             self._thread = threading.Thread(target=loop, daemon=True,
                                             name="fleet-controller")
             self._thread.start()
+        if self.scope is not None:
+            self.scope.start()
         return self
 
     def stop(self) -> None:
@@ -607,6 +613,8 @@ class FleetController:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+        if self.scope is not None:
+            self.scope.stop()
 
 
 # -- CLI ---------------------------------------------------------------------
@@ -631,6 +639,20 @@ def main(argv=None) -> int:
     p.add_argument("--trace", action="store_true",
                    help="record route spans (merge with replica traces "
                         "via scripts/trace_report.py)")
+    p.add_argument("--scope", action="store_true",
+                   help="start a graftscope collector for this fleet "
+                        "(scrapes every member + the router, evaluates "
+                        "--alerts-config, serves GET /alerts)")
+    p.add_argument("--alerts-config", default=None,
+                   help="alerts.yaml for --scope (default: "
+                        "configs/alerts.yaml when present)")
+    p.add_argument("--scope-port", type=int, default=None,
+                   help="port for the collector's /alerts + /metrics "
+                        "surface (default: router port + 100)")
+    p.add_argument("--run-dir", default=None,
+                   help="directory for --scope evidence: events.jsonl, "
+                        "scope_tsdb/, bundles/ (default: <fleet-dir>/scope "
+                        "or ./scope_run)")
     a = p.parse_args(argv)
     cfg = FleetConfig.from_yaml(a.config) if a.config else FleetConfig()
     if a.canary_fraction is not None:
@@ -650,8 +672,30 @@ def main(argv=None) -> int:
                          canary_fraction=cfg.canary_fraction,
                          handoff_min_prompt_bytes=cfg.handoff_min_prompt_bytes,
                          trace=a.trace)
+    scope = None
+    if a.scope:
+        try:
+            from ..obs.scope import Collector, ScopeConfig
+
+            alerts_path = a.alerts_config
+            if alerts_path is None and os.path.isfile(
+                    os.path.join("configs", "alerts.yaml")):
+                alerts_path = os.path.join("configs", "alerts.yaml")
+            run_dir = a.run_dir or (os.path.join(a.fleet_dir, "scope")
+                                    if a.fleet_dir else "scope_run")
+            scope_port = (a.scope_port if a.scope_port is not None
+                          else a.port + 100)
+            scope = Collector(ScopeConfig(
+                targets=[{"name": "router", "role": "router",
+                          "url": f"http://{a.host}:{a.port}"}],
+                fleet_dir=a.fleet_dir, run_dir=run_dir,
+                alerts_path=alerts_path, port=scope_port), log=print)
+            print(f"graftscope: /alerts on port {scope.server.port}"
+                  if scope.server else "graftscope: collector started")
+        except Exception as e:  # noqa: BLE001 - observability is optional
+            print(f"graftscope: disabled ({type(e).__name__}: {e})")
     controller = FleetController(router, cfg, fleet_dir=a.fleet_dir,
-                                 log=print)
+                                 log=print, scope=scope)
     httpd = serve_router(router, a.host, a.port)
     controller.start()
     print(f"fleet router: {len(prefill)} prefill + {len(decode)} decode "

@@ -546,9 +546,59 @@ def supervise_from_args(args) -> Dict[str, Any]:
         process_count=int(getattr(args, "num_processes", None) or 1),
         barrier_timeout_s=barrier_timeout,
     )
-    rc = sup.run()
+    scope = _start_scope_sidecar(args, merged, run_dir)
+    try:
+        rc = sup.run()
+    finally:
+        if scope is not None:
+            scope.stop()
     return {"supervised": True, "exit_code": rc, "restarts": sup.restarts,
             "hangs": sup.hangs, "run_dir": run_dir}
+
+
+def _start_scope_sidecar(args, merged: Dict[str, Any], run_dir: str):
+    """Optional graftscope collector next to the supervisor (--scope).
+
+    Scrapes the child trainer's metrics port (one target per process),
+    evaluates the alerts config, and captures evidence into the same
+    run dir the supervisor owns.  Best-effort by charter: a broken
+    alerts config or a missing metrics port logs and returns None —
+    observability must never stop a training launch."""
+    if not getattr(args, "scope", False):
+        return None
+    try:
+        from ..obs.scope import Collector, ScopeConfig
+
+        scope_cfg = (merged.get("scope") or {})
+        port = int(((merged.get("logging") or {}).get("metrics_port")) or 0)
+        if not port:
+            print("scope: logging.metrics_port is 0 — no trainer surface "
+                  "to scrape; sidecar disabled")
+            return None
+        n_proc = int(getattr(args, "num_processes", None) or 1)
+        targets = [{"name": "trainer%d" % i,
+                    "url": "http://127.0.0.1:%d" % (port + i),
+                    "role": "trainer"} for i in range(n_proc)]
+        alerts_path = getattr(args, "alerts_config", None) \
+            or scope_cfg.get("alerts_path")
+        if alerts_path is None and os.path.isfile(
+                os.path.join("configs", "alerts.yaml")):
+            alerts_path = os.path.join("configs", "alerts.yaml")
+        cfg = ScopeConfig(
+            interval_s=float(scope_cfg.get("interval_s", 5.0)),
+            targets=targets,
+            run_dir=run_dir,
+            alerts_path=alerts_path,
+            port=scope_cfg.get("port"),
+            scrape_timeout_s=float(scope_cfg.get("scrape_timeout_s", 2.0)))
+        collector = Collector(cfg, log=print)
+        collector.start()
+        print("scope: collector started (%d target(s), rules from %s)"
+              % (len(targets), alerts_path or "<none>"))
+        return collector
+    except Exception as e:  # noqa: BLE001 - sidecar must not block training
+        print("scope: sidecar disabled (%s: %s)" % (type(e).__name__, e))
+        return None
 
 
 def main(argv=None) -> Dict[str, Any]:

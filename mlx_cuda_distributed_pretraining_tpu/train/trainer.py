@@ -491,7 +491,9 @@ class Trainer:
                 self.logger.log(
                     f"telemetry: registry rebuilt from {replayed} events "
                     f"in {events_path(run_dir)}")
-            self.events = EventLog(events_path(run_dir))
+            self.events = EventLog(
+                events_path(run_dir),
+                max_bytes=self.config.logging.events_max_bytes)
         if for_training:
             # Per-host heartbeat: process 0 keeps the legacy heartbeat.json
             # name; peers write heartbeat_p<idx>.json — so a supervisor
@@ -537,6 +539,15 @@ class Trainer:
             "train_tok_s", "global tokens/second over the last window")
         self._g_mfu = self.metrics.gauge(
             "train_mfu", "model FLOPs utilization over the last window")
+        # graftscope anomaly-rule inputs: the gradient norm was only ever
+        # a log-line field, and non-finite loss windows only a warning —
+        # export both so the grad-norm-blowup and NaN-sentinel rules have
+        # a scrapeable series.
+        self._g_grad_norm = self.metrics.gauge(
+            "train_grad_norm", "global gradient norm over the last window")
+        self._m_nonfinite = self.metrics.counter(
+            "train_nonfinite_total",
+            "logging windows whose loss came back NaN/Inf")
         self._g_prof = {
             "prof_compute_frac": self.metrics.gauge(
                 "prof_compute_frac",
@@ -1352,6 +1363,7 @@ class Trainer:
                     }
                     if "grad_norm" in metrics:
                         line["grad_norm"] = float(metrics["grad_norm"])
+                        self._g_grad_norm.set(line["grad_norm"])
                     if self.pipeline:
                         # Honest schedule accounting: the bubble is a
                         # property of (pp, M, V), constant across the run,
@@ -1386,6 +1398,7 @@ class Trainer:
                         window_moe = []
                     if int(metrics["nonfinite"]):
                         self.logger.log(f"WARNING: non-finite loss at step {step}")
+                        self._m_nonfinite.inc()
                     self.logger.log_metrics(step, line)
                     if self.stats_client is not None:
                         self.stats_client.log_metrics(step, line)
@@ -1692,6 +1705,16 @@ def build_parser() -> argparse.ArgumentParser:
                              "trainer when its heartbeat makes no progress "
                              "for this many seconds (overrides "
                              "supervisor.hang_timeout_s; 0 disables)")
+    # graftscope sidecar (obs/scope.py): with --auto-resume, the
+    # supervisor runs a collector that scrapes the trainer's /metrics
+    # port, evaluates the alert rules, and captures evidence on fire.
+    parser.add_argument("--scope", action="store_true",
+                        help="with --auto-resume: start a graftscope "
+                             "collector sidecar scraping the trainer's "
+                             "metrics port (requires logging.metrics_port)")
+    parser.add_argument("--alerts-config", default=None,
+                        help="alerts.yaml for the --scope sidecar "
+                             "(default: configs/alerts.yaml when present)")
     # Multi-host rendezvous (parallel/elastic.py). With --auto-resume these
     # configure the multi-host supervisor instead: each host runs one
     # supervisor, children rendezvous per generation.

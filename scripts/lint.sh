@@ -13,7 +13,10 @@
 # programs of the sample config on CPU and audits the jaxpr/HLO —
 # donation gaps, collective census vs the committed budget, fp32 creep,
 # captured constants, replicated params.
-# LINT_SYNC=0 skips stage 2; LINT_AUDIT=0 skips stage 3.
+# Stage 4 (LINT_ALERTS) validates configs/alerts.yaml against the
+# graftscope rule grammar + exported-metric catalogue, when present.
+# LINT_SYNC=0 skips stage 2; LINT_AUDIT=0 skips stage 3; LINT_ALERTS=0
+# skips stage 4.
 set -eu
 cd "$(dirname "$0")/.."
 JAX_PLATFORMS=cpu python -m mlx_cuda_distributed_pretraining_tpu.analysis.lint "$@"
@@ -25,4 +28,8 @@ fi
 if [ "${LINT_AUDIT:-1}" != "0" ]; then
     JAX_PLATFORMS=cpu python -m mlx_cuda_distributed_pretraining_tpu.analysis.audit \
         --config configs/model-config-sample.yaml
+fi
+if [ "${LINT_ALERTS:-1}" != "0" ] && [ -f configs/alerts.yaml ]; then
+    JAX_PLATFORMS=cpu python -m mlx_cuda_distributed_pretraining_tpu.obs.alerts \
+        --validate configs/alerts.yaml
 fi

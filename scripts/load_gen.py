@@ -138,7 +138,8 @@ def run_load(url: str, concurrency: int, requests: int, prompt: str,
              timeout: float, shared_prefix_tokens: int = 0,
              prefix_groups: int = 1, trace_out: str | None = None,
              mix: str | None = None,
-             mix_shapes: dict | None = None) -> dict:
+             mix_shapes: dict | None = None,
+             alerts_url: str | None = None) -> dict:
     results: list = []
     lock = threading.Lock()
     counter = iter(range(requests))
@@ -307,6 +308,22 @@ def run_load(url: str, concurrency: int, requests: int, prompt: str,
             summary["server_metrics"] = json.loads(resp.read())
     except Exception:  # noqa: BLE001 - summary is still useful without it
         pass
+    # graftscope rollup next to the outcome counts: which SLO rules were
+    # firing when the run ended. Same tolerance as server_metrics — no
+    # collector (or no /alerts route on the target), no keys.
+    try:
+        with urllib.request.urlopen(
+                (alerts_url or url).rstrip("/") + "/alerts",
+                timeout=10) as resp:
+            doc = json.loads(resp.read())
+        firing = sorted(str(al.get("rule", "?"))
+                        for al in doc.get("alerts", [])
+                        if isinstance(al, dict)
+                        and al.get("state") == "firing")
+        summary["alerts_firing"] = len(firing)
+        summary["alerts_firing_rules"] = firing
+    except Exception:  # noqa: BLE001 - alerts are optional evidence
+        pass
     return summary
 
 
@@ -346,6 +363,10 @@ def main(argv=None) -> int:
                    help="decode-heavy class: ~prompt tokens per request")
     p.add_argument("--mix-decode-gen", type=int, default=128,
                    help="decode-heavy class: generated tokens per request")
+    p.add_argument("--alerts-url", default=None,
+                   help="graftscope collector base URL for the end-of-run "
+                        "firing-alert count (default: --url, which only "
+                        "answers when the target itself serves /alerts)")
     a = p.parse_args(argv)
     summary = run_load(a.url, a.concurrency, a.requests, a.prompt,
                        a.max_tokens, a.temperature, a.deadline_s, a.timeout,
@@ -355,7 +376,8 @@ def main(argv=None) -> int:
                            "prefill-heavy": (a.mix_prefill_prompt,
                                              a.mix_prefill_gen),
                            "decode-heavy": (a.mix_decode_prompt,
-                                            a.mix_decode_gen)})
+                                            a.mix_decode_gen)},
+                       alerts_url=a.alerts_url)
     print(json.dumps(summary))
     return 0
 

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Offline graftscope report: alert timeline + series sparklines.
+
+Input: a run directory that a graftscope collector (obs/scope.py) wrote
+into — ``events.jsonl`` (alert/bundle events, rotation-aware via
+obs/events.py) and the ``scope_tsdb/`` per-series store
+(obs/tsdb.py). Prints, in ``key=value`` form:
+
+  * an accounting line — rounds the collector completed, series
+    retained, alert transitions and bundles captured;
+  * the alert timeline — every pending/firing/resolved transition in
+    order with the rule name and the offending value;
+  * per-rule firing totals (how long each rule spent firing, how many
+    distinct episodes);
+  * sparklines for the headline series (``--series`` to pick your own):
+    scrape health, per-instance TTFT p99, router error increase, loss.
+
+Stdlib-only on dumped files; the in-repo package import has a repo-root
+fallback so the script runs uninstalled from a checkout:
+
+    python scripts/scope_report.py runs/myrun --series train_loss
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+try:
+    from mlx_cuda_distributed_pretraining_tpu.obs import events as _events
+    from mlx_cuda_distributed_pretraining_tpu.obs import tsdb as _tsdb
+except ImportError:  # uninstalled checkout
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    from mlx_cuda_distributed_pretraining_tpu.obs import events as _events
+    from mlx_cuda_distributed_pretraining_tpu.obs import tsdb as _tsdb
+
+# Series worth a sparkline in every report, when present. Anything
+# else is reachable with --series.
+DEFAULT_SERIES = (
+    "graftscope_scrape_up",
+    "serve_ttft_ms_p99",
+    "ttft_ms_p99",
+    "train_loss",
+    "train_grad_norm",
+    "train_mfu",
+)
+
+
+def load_alert_events(run_dir: str) -> List[Dict[str, Any]]:
+    """alert/bundle events from the run's events.jsonl (+ rotated
+    predecessor), in append order."""
+    path = _events.events_path(run_dir)
+    out: List[Dict[str, Any]] = []
+    for ev in _events.iter_events(path):
+        if ev.get("type") in ("alert", "bundle"):
+            out.append(ev)
+    return out
+
+
+def timeline_lines(evs: List[Dict[str, Any]]) -> List[str]:
+    lines = []
+    for ev in evs:
+        if ev.get("type") == "bundle":
+            lines.append(f"t={ev.get('t')} bundle rule={ev.get('rule')} "
+                         f"dir={ev.get('dir')}")
+            continue
+        val = ev.get("value")
+        vs = f" value={val}" if val is not None else ""
+        lines.append(f"t={ev.get('t')} alert rule={ev.get('rule')} "
+                     f"{ev.get('from_state')}->{ev.get('to_state')}{vs}")
+    return lines
+
+
+def firing_totals(evs: List[Dict[str, Any]]) -> List[str]:
+    """Per-rule firing episodes and total seconds spent firing.
+
+    An episode still firing at the end of the log counts with an open
+    interval (duration measured to the last event timestamp seen)."""
+    open_at: Dict[str, float] = {}
+    episodes: Dict[str, int] = {}
+    total_s: Dict[str, float] = {}
+    last_t = 0.0
+    for ev in evs:
+        t = float(ev.get("t", 0.0) or 0.0)
+        last_t = max(last_t, t)
+        if ev.get("type") != "alert":
+            continue
+        rule = str(ev.get("rule", "?"))
+        if ev.get("to_state") == "firing":
+            open_at[rule] = t
+            episodes[rule] = episodes.get(rule, 0) + 1
+        elif ev.get("from_state") == "firing":
+            t0 = open_at.pop(rule, None)
+            if t0 is not None:
+                total_s[rule] = total_s.get(rule, 0.0) + (t - t0)
+    for rule, t0 in open_at.items():
+        total_s[rule] = total_s.get(rule, 0.0) + (last_t - t0)
+    lines = []
+    for rule in sorted(episodes):
+        still = " still_firing=1" if rule in open_at else ""
+        lines.append(f"rule={rule} episodes={episodes[rule]} "
+                     f"firing_s={total_s.get(rule, 0.0):.0f}{still}")
+    return lines
+
+
+def series_lines(db: "_tsdb.TSDB", names: List[str],
+                 width: int = 40) -> List[str]:
+    """One sparkline per retained (name, labels) series matching any of
+    ``names``; min/max/last annotate the glyphs."""
+    lines = []
+    for key in db.keys():
+        name, labels = _tsdb.parse_series_key(key)
+        if name not in names:
+            continue
+        pts = db.query(name, labels)
+        if not pts:
+            continue
+        vals = [v for _, v in pts]
+        spark = _tsdb.sparkline(vals, width=width)
+        lines.append(f"series={key} n={len(vals)} min={min(vals):.4g} "
+                     f"max={max(vals):.4g} last={vals[-1]:.4g} |{spark}|")
+    return lines
+
+
+def bundles_summary(run_dir: str) -> List[str]:
+    bdir = os.path.join(run_dir, "bundles")
+    if not os.path.isdir(bdir):
+        return []
+    lines = []
+    for name in sorted(os.listdir(bdir)):
+        path = os.path.join(bdir, name)
+        if not os.path.isdir(path):
+            continue
+        members = sorted(d for d in os.listdir(path)
+                         if os.path.isdir(os.path.join(path, d)))
+        rule = name
+        meta = os.path.join(path, "alert.json")
+        if os.path.isfile(meta):
+            try:
+                with open(meta) as fh:
+                    rule = json.load(fh).get("alert", {}).get("rule", name)
+            except (OSError, ValueError):
+                pass
+        lines.append(f"bundle={name} rule={rule} members={len(members)}"
+                     + (f"({','.join(members)})" if members else ""))
+    return lines
+
+
+def report(run_dir: str, series: Optional[List[str]] = None,
+           width: int = 40) -> List[str]:
+    evs = load_alert_events(run_dir)
+    tsdb_dir = os.path.join(run_dir, "scope_tsdb")
+    db = _tsdb.TSDB(dir=tsdb_dir if os.path.isdir(tsdb_dir) else None)
+    n_alerts = sum(1 for e in evs if e.get("type") == "alert")
+    n_bundles = sum(1 for e in evs if e.get("type") == "bundle")
+    rounds = 0
+    for key in db.keys():
+        name, labels = _tsdb.parse_series_key(key)
+        if name == "graftscope_rounds_total":
+            pts = db.query(name, labels)
+            if pts:
+                rounds = max(rounds, int(pts[-1][1]))
+    lines = [f"run_dir={run_dir} rounds={rounds} series={len(db.keys())} "
+             f"alert_transitions={n_alerts} bundles={n_bundles}"]
+    lines.extend(timeline_lines(evs))
+    lines.extend(firing_totals(evs))
+    lines.extend(series_lines(db, list(series or DEFAULT_SERIES),
+                              width=width))
+    lines.extend(bundles_summary(run_dir))
+    return lines
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("run_dir", help="run dir a graftscope collector wrote "
+                                   "(events.jsonl + scope_tsdb/)")
+    p.add_argument("--series", action="append", default=None,
+                   help="metric name to sparkline (repeatable; default: "
+                        "the headline set)")
+    p.add_argument("--width", type=int, default=40,
+                   help="sparkline width in characters")
+    a = p.parse_args(argv)
+    if not os.path.isdir(a.run_dir):
+        p.error(f"not a directory: {a.run_dir}")
+    for line in report(a.run_dir, series=a.series, width=a.width):
+        print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -77,3 +77,24 @@ def spawn_with_devices(argv, n, **popen_kw):
     kw = dict(stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
     kw.update(popen_kw)
     return subprocess.Popen(argv, env=device_env(n), **kw)
+
+
+# --- serial scheduling for thread-heavy drills ------------------------------
+# A few serving tests run several live HTTP servers plus engine/router
+# threads inside the test process and assert on stream timing. Under a
+# loaded batch (xdist workers, a busy CI box) they flake purely from
+# scheduler contention. The ``serial`` marker (pytest.ini) moves them to
+# the END of the collection order — they run after the bulk of the suite
+# has released its threads — and pins them all to one xdist group so a
+# parallel runner never splits them across simultaneously-busy workers.
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+
+    serial = [it for it in items if it.get_closest_marker("serial")]
+    if not serial:
+        return
+    rest = [it for it in items if not it.get_closest_marker("serial")]
+    for it in serial:
+        it.add_marker(pytest.mark.xdist_group("serial"))
+    items[:] = rest + serial

@@ -518,3 +518,113 @@ def test_watchdog_restarts_hung_child_and_books_lost_time(tmp_path):
     lost = reg.counter("goodput_seconds_total").value(
         component="restart_lost_s")
     assert lost == pytest.approx(restart["lost_s"])
+
+
+# -- events.jsonl rotation (logging.events.max_bytes) -----------------------
+
+def test_events_rotation_bounds_live_file_and_replay_reads_pair(tmp_path):
+    from mlx_cuda_distributed_pretraining_tpu.obs.events import (
+        rotated_events_path)
+
+    path = str(tmp_path / "events.jsonl")
+    cap = 600
+    log = EventLog(path, now=lambda: 1000.0, max_bytes=cap)
+    for i in range(30):
+        log.append("step_window", step=(i + 1) * 5, steps=5, toks=10)
+    log.close()
+    rotated = rotated_events_path(path)
+    assert os.path.exists(rotated)
+    # rotation happens between complete lines, so both generations stay
+    # under the cap (the live file strictly, the rotated one too)
+    assert os.path.getsize(path) <= cap
+    assert os.path.getsize(rotated) <= cap
+    # readers see a contiguous SUFFIX of history ending at the newest
+    # event — older generations age out by design, nothing interleaves
+    evs = list(iter_events(path))
+    steps = [e["step"] for e in evs]
+    assert steps == list(range(steps[0], 151, 5)) and steps[-1] == 150
+    assert 2 <= len(evs) < 30
+    # a torn tail on the live file is still skipped, not fatal
+    with open(path, "a") as f:
+        f.write('{"v":1,"type":"torn')
+    assert [e["step"] for e in iter_events(path)] == steps
+    # replay_into rebuilds from the pair: 5 steps per surviving window
+    reg = MetricsRegistry()
+    assert replay_into(reg, path) == len(evs)
+    assert reg.counter("train_steps_total").value() == 5.0 * len(evs)
+
+
+def test_events_max_bytes_zero_never_rotates(tmp_path):
+    from mlx_cuda_distributed_pretraining_tpu.obs.events import (
+        rotated_events_path)
+
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(path, max_bytes=0)
+    for i in range(50):
+        log.append("step_window", step=i, steps=1, toks=1)
+    log.close()
+    assert not os.path.exists(rotated_events_path(path))
+    assert len(list(iter_events(path))) == 50
+
+
+def test_logging_config_events_max_bytes_key():
+    from mlx_cuda_distributed_pretraining_tpu.config import LoggingConfig
+
+    assert LoggingConfig().events_max_bytes == 0
+    cfg = LoggingConfig(events={"max_bytes": 1 << 20})
+    assert cfg.events_max_bytes == 1 << 20
+
+
+# -- TTFT histogram exposition pins -----------------------------------------
+
+def test_ttft_prometheus_text_format_pin():
+    """The serve_ttft_ms exposition shape external scrapers (graftscope,
+    real Prometheus) parse: every LATENCY_MS_BUCKETS le line in order,
+    cumulative counts, then _sum and _count. A bucket-boundary or
+    formatting change must be a deliberate one."""
+    from mlx_cuda_distributed_pretraining_tpu.obs.metrics import (
+        LATENCY_MS_BUCKETS)
+
+    reg = MetricsRegistry()
+    h = reg.histogram("serve_ttft_ms", "time to first token (ms)",
+                      buckets=LATENCY_MS_BUCKETS)
+    for v in (3.0, 40.0, 800.0):
+        h.observe(v)
+    text = render_prometheus(reg.snapshot())
+    lines = [ln for ln in text.splitlines()
+             if ln.startswith("serve_ttft_ms")]
+    want_cum = {1.0: 0, 2.5: 0, 5.0: 1, 10.0: 1, 25.0: 1, 50.0: 2,
+                100.0: 2, 250.0: 2, 500.0: 2, 1000.0: 3, 2500.0: 3,
+                5000.0: 3, 10000.0: 3, 30000.0: 3}
+    expected = ['serve_ttft_ms_bucket{le="%g"} %d' % (le, want_cum[le])
+                for le in LATENCY_MS_BUCKETS]
+    expected += ['serve_ttft_ms_bucket{le="+Inf"} 3',
+                 "serve_ttft_ms_sum 843",
+                 "serve_ttft_ms_count 3"]
+    assert lines == expected
+    assert "# TYPE serve_ttft_ms histogram" in text
+
+
+def test_engine_json_metrics_include_ttft_sum_and_count():
+    """BatchEngine._ttft_quantiles feeds the JSON /metrics surface: the
+    quantile keys alone cannot recover a mean, so sum/count ride along
+    (graftscope and port-less scrapers compute averages from them)."""
+    from types import SimpleNamespace
+
+    from mlx_cuda_distributed_pretraining_tpu.obs.metrics import (
+        LATENCY_MS_BUCKETS)
+    from mlx_cuda_distributed_pretraining_tpu.serve.engine import (
+        BatchEngine)
+
+    reg = MetricsRegistry()
+    h = reg.histogram("serve_ttft_ms", "", buckets=LATENCY_MS_BUCKETS)
+    for v in (10.0, 20.0, 400.0):
+        h.observe(v)
+    stub = SimpleNamespace(metrics_registry=reg)
+    out = BatchEngine._ttft_quantiles(stub)
+    assert set(out) == {"ttft_ms_p50", "ttft_ms_p95", "ttft_ms_p99",
+                        "ttft_ms_sum", "ttft_ms_count"}
+    assert out["ttft_ms_sum"] == 430.0 and out["ttft_ms_count"] == 3
+    # empty histogram: the whole block stays absent (no fake zeros)
+    assert BatchEngine._ttft_quantiles(
+        SimpleNamespace(metrics_registry=MetricsRegistry())) == {}

@@ -1,0 +1,629 @@
+"""graftscope — fleet-wide SLO control plane (obs/scope.py, obs/tsdb.py,
+obs/alerts.py).
+
+Pure-unit tests pin the TSDB encoding (delta-of-delta varints, torn-tail
+truncation, retention compaction, counter-reset-aware increase, bucket
+quantiles), the rule grammar validator, and every rule kind's evaluator
+against a hand-built store. The collector tests run real MetricsServer
+targets and drive failures through the graftchaos choke point
+(scrape.timeout) to prove a sick target never wedges a round. The chaos
+drill replays a scripted error-ratio outage on a logical clock and
+asserts the whole alert lifecycle — pending inside the burn windows,
+firing after the for_s hold-down, a debug bundle naming every member,
+resolved after the fault window — is **bit-identical** across two runs.
+"""
+
+import json
+import math
+import os
+import socket
+import urllib.request
+
+import pytest
+
+from mlx_cuda_distributed_pretraining_tpu.obs import tsdb as tsdb_mod
+from mlx_cuda_distributed_pretraining_tpu.obs.alerts import (
+    AlertState,
+    RuleEngine,
+    RuleError,
+    validate_rules,
+)
+from mlx_cuda_distributed_pretraining_tpu.obs.events import iter_events
+from mlx_cuda_distributed_pretraining_tpu.obs.metrics import MetricsRegistry
+from mlx_cuda_distributed_pretraining_tpu.obs.prometheus import MetricsServer
+from mlx_cuda_distributed_pretraining_tpu.obs.scope import (
+    Collector,
+    ScopeConfig,
+    parse_json_metrics,
+    parse_prom_text,
+)
+from mlx_cuda_distributed_pretraining_tpu.obs.tsdb import (
+    TSDB,
+    decode_records,
+    encode_record,
+    parse_series_key,
+    series_key,
+    sparkline,
+)
+from mlx_cuda_distributed_pretraining_tpu.serve import faults
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# -- tsdb: encoding ---------------------------------------------------------
+
+def test_tsdb_record_round_trip_including_float_escape():
+    samples = [(1000, 2.5), (2000, 2.5), (3500, -1.0),
+               (3600, 0.1234567), (10_000, 1e12)]
+    buf = bytearray()
+    prev_t, prev_delta, prev_v = 0, 0, 0.0
+    for t_ms, v in samples:
+        rec = encode_record(t_ms, prev_t, prev_delta, v, prev_v)
+        buf.extend(rec)
+        prev_delta = t_ms - prev_t
+        prev_t, prev_v = t_ms, v
+    out = decode_records(bytes(buf))
+    assert [(t, round(v, 9)) for t, v in out] == \
+        [(t, round(v, 9)) for t, v in samples]
+
+
+def test_tsdb_append_query_and_persistence(tmp_path):
+    d = str(tmp_path / "tsdb")
+    db = TSDB(d)
+    for i in range(10):
+        db.append("loss", {"instance": "t0"}, 100.0 + i, 3.0 - i * 0.1)
+    pts = db.query("loss", {"instance": "t0"})
+    assert len(pts) == 10
+    assert pts[0] == (100.0, 3.0)
+    assert abs(pts[-1][1] - 2.1) < 1e-9
+    # windowed query
+    win = db.query("loss", {"instance": "t0"}, 103.0, 105.0)
+    assert [t for t, _ in win] == [103.0, 104.0, 105.0]
+    # a second TSDB over the same dir sees the same data (reload path)
+    db2 = TSDB(d)
+    assert db2.query("loss", {"instance": "t0"}) == pts
+    # non-monotonic appends are dropped, the series stays sane
+    db2.append("loss", {"instance": "t0"}, 50.0, 9.9)
+    assert db2.query("loss", {"instance": "t0"}) == pts
+
+
+def test_tsdb_torn_tail_truncated_then_appendable(tmp_path):
+    d = str(tmp_path / "tsdb")
+    db = TSDB(d)
+    for i in range(5):
+        db.append("x", None, 10.0 + i, float(i))
+    path = db._series[series_key("x", None)].path
+    with open(path, "ab") as fh:
+        fh.write(b"\x83\x41")  # half a record: crash mid-append
+    db2 = TSDB(d)
+    assert [v for _, v in db2.query("x")] == [0.0, 1.0, 2.0, 3.0, 4.0]
+    # the torn bytes were truncated away, so new appends stay decodable
+    db2.append("x", None, 20.0, 9.0)
+    db3 = TSDB(d)
+    assert db3.query("x")[-1] == (20.0, 9.0)
+
+
+def test_tsdb_retention_compaction(tmp_path):
+    db = TSDB(str(tmp_path / "tsdb"), max_points=16)
+    for i in range(100):
+        db.append("c", None, float(i), float(i))
+    pts = db.query("c")
+    assert len(pts) <= 32  # compaction triggers at 2x max_points
+    assert pts[-1] == (99.0, 99.0)
+    # the retained window survives a reload with the newest points intact
+    db2 = TSDB(str(tmp_path / "tsdb"), max_points=16)
+    assert db2.query("c")[-1] == (99.0, 99.0)
+    assert len(db2.query("c")) == len(pts)
+
+
+def test_tsdb_increase_rate_and_counter_reset():
+    db = TSDB()
+    for t, v in [(0, 0.0), (10, 5.0), (20, 12.0), (30, 3.0), (40, 8.0)]:
+        db.append("req_total", {"i": "a"}, float(t), v)
+    # 0->5->12 is +12; the reset to 3 contributes its new value; 3->8 is +5
+    assert db.increase("req_total", {"i": "a"}, 0.0, 40.0) == 12.0 + 3.0 + 5.0
+    assert db.rate("req_total", {"i": "a"}, 0.0, 40.0) == 20.0 / 40.0
+    db.append("req_total", {"i": "b"}, 0.0, 0.0)
+    db.append("req_total", {"i": "b"}, 40.0, 10.0)
+    assert db.sum_increase("req_total", {}, 0.0, 40.0) == 30.0
+
+
+def test_tsdb_quantile_from_bucket_series():
+    db = TSDB()
+    # 100 observations in [0, t]: 50 under 10ms, 90 under 100ms, all under +Inf
+    for t, b10, b100, inf in [(0, 0, 0, 0), (60, 50, 90, 100)]:
+        db.append("lat_ms_bucket", {"le": "10"}, float(t), float(b10))
+        db.append("lat_ms_bucket", {"le": "100"}, float(t), float(b100))
+        db.append("lat_ms_bucket", {"le": "+Inf"}, float(t), float(inf))
+    p50 = db.quantile("lat_ms", {}, 0.5, 0.0, 60.0)
+    p99 = db.quantile("lat_ms", {}, 0.99, 0.0, 60.0)
+    assert p50 is not None and p50 <= 10.0
+    assert p99 is not None and p99 >= 100.0
+
+
+def test_series_key_round_trip_and_sparkline():
+    key = series_key("m", {"b": "2", "a": "1"})
+    assert key == 'm{a=1,b=2}'
+    assert parse_series_key(key) == ("m", {"a": "1", "b": "2"})
+    s = sparkline([0, 1, 2, 3], width=4)
+    assert len(s) == 4 and s[0] == "▁" and s[-1] == "█"
+    assert sparkline([], width=4) == ""
+
+
+# -- alerts: validation -----------------------------------------------------
+
+def _rule(**kw):
+    base = {"name": "r", "kind": "threshold", "metric": "train_loss",
+            "value": 1.0}
+    base.update(kw)
+    return {"alerts": {"rules": [base]}}
+
+
+def test_validate_rules_catches_typos():
+    assert validate_rules(_rule()) == []
+    assert any("unknown kind" in e
+               for e in validate_rules(_rule(kind="treshold")))
+    assert any("unknown metric" in e
+               for e in validate_rules(_rule(metric="serve_ttft_msec")))
+    # custom_metric: true is the escape hatch for out-of-tree exporters
+    assert validate_rules(_rule(metric="my_metric",
+                                custom_metric=True)) == []
+    assert any("unknown action" in e
+               for e in validate_rules(_rule(actions=["pager"])))
+    assert any("for_s" in e for e in validate_rules(_rule(for_s=-5)))
+    assert any("op must be" in e for e in validate_rules(_rule(op="eq")))
+
+
+def test_validate_rules_burn_window_ordering_and_objective():
+    doc = {"alerts": {"rules": [{
+        "name": "b", "kind": "error_burn_rate",
+        "metric": "serve_router_requests_total",
+        "bad_label": "outcome", "bad_values": ["error"],
+        "objective": 0.99, "fast_window_s": 300, "slow_window_s": 60}]}}
+    assert any("must be < slow_window_s" in e for e in validate_rules(doc))
+    doc["alerts"]["rules"][0].update(fast_window_s=60, slow_window_s=300,
+                                     objective=1.5)
+    assert any("objective" in e for e in validate_rules(doc))
+
+
+def test_validate_rules_duplicates_and_engine_refuses_invalid():
+    doc = {"alerts": {"rules": [
+        {"name": "same", "kind": "threshold", "metric": "train_loss",
+         "value": 1.0},
+        {"name": "same", "kind": "threshold", "metric": "train_loss",
+         "value": 2.0}]}}
+    assert any("duplicate" in e for e in validate_rules(doc))
+    with pytest.raises(RuleError):
+        RuleEngine([{"name": "x", "kind": "nope"}], TSDB())
+
+
+def test_validate_alerts_yaml_cli_and_shipped_config(tmp_path):
+    from mlx_cuda_distributed_pretraining_tpu.obs.alerts import main
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    shipped = os.path.join(repo, "configs", "alerts.yaml")
+    assert main(["--validate", shipped]) == 0
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("alerts:\n  rules:\n    - name: x\n      kind: nope\n")
+    assert main(["--validate", str(bad)]) == 1
+
+
+# -- alerts: state machine --------------------------------------------------
+
+def test_alert_state_for_s_hold_down():
+    st = AlertState({"name": "r", "kind": "threshold", "for_s": 20})
+    assert [t["to"] for t in st.step(True, 5.0, 100.0)] == ["pending"]
+    assert st.step(True, 5.0, 110.0) == []          # still inside for_s
+    trs = st.step(True, 6.0, 120.0)
+    assert [t["to"] for t in trs] == ["firing"] and st.fire_count == 1
+    assert st.step(True, 6.0, 130.0) == []          # stays firing quietly
+    trs = st.step(False, 0.0, 140.0)
+    assert [(t["from"], t["to"]) for t in trs] == [("firing", "resolved")]
+    # a blip that clears inside the hold-down never fires
+    st.step(True, 5.0, 150.0)
+    trs = st.step(False, 0.0, 160.0)
+    assert [(t["from"], t["to"]) for t in trs] == [("pending", "inactive")]
+    assert st.fire_count == 1
+
+
+def test_alert_state_immediate_fire_without_for_s():
+    st = AlertState({"name": "r", "kind": "threshold"})
+    assert [t["to"] for t in st.step(True, 1.0, 10.0)] == ["firing"]
+
+
+# -- alerts: rule kinds against a hand-built store --------------------------
+
+def _engine(rules, db):
+    return RuleEngine(rules, db)
+
+
+def test_threshold_rule_worst_series_wins():
+    db = TSDB()
+    db.append("train_grad_norm", {"instance": "p0"}, 100.0, 2.0)
+    db.append("train_grad_norm", {"instance": "p1"}, 100.0, 150.0)
+    eng = _engine([{"name": "gn", "kind": "threshold",
+                    "metric": "train_grad_norm", "op": "gt",
+                    "value": 100.0}], db)
+    trs = eng.evaluate(100.0)
+    assert [t["to"] for t in trs] == ["firing"]
+    assert trs[0]["value"] == 150.0
+
+
+def test_zscore_rule_fires_on_loss_spike():
+    db = TSDB()
+    for i in range(20):
+        db.append("train_loss", {"instance": "p0"}, float(i), 2.0)
+    db.append("train_loss", {"instance": "p0"}, 20.0, 2.0001)
+    eng = _engine([{"name": "spike", "kind": "zscore",
+                    "metric": "train_loss", "z": 4.0, "window_s": 600}], db)
+    assert eng.evaluate(20.0) == []  # tiny wiggle: no alert
+    db.append("train_loss", {"instance": "p0"}, 21.0, 9.0)
+    trs = eng.evaluate(21.0)
+    assert [t["to"] for t in trs] == ["firing"]
+
+
+def test_nonfinite_rule_gauge_and_sentinel_counter():
+    db = TSDB()
+    db.append("train_loss", None, 10.0, float("nan"))
+    eng = _engine([{"name": "nan", "kind": "nonfinite",
+                    "metric": "train_loss"}], db)
+    trs = eng.evaluate(10.0)
+    assert [t["to"] for t in trs] == ["firing"]
+    assert math.isnan(trs[0]["value"])
+    db2 = TSDB()
+    db2.append("train_nonfinite_total", None, 0.0, 0.0)
+    db2.append("train_nonfinite_total", None, 50.0, 2.0)
+    eng2 = _engine([{"name": "nf", "kind": "nonfinite",
+                     "metric": "train_nonfinite_total"}], db2)
+    assert [t["to"] for t in eng2.evaluate(50.0)] == ["firing"]
+
+
+def test_flap_rule_counts_breaker_transitions():
+    db = TSDB()
+    vals = [0, 2, 0, 2, 0, 2]  # closed<->open, 5 flips
+    for i, v in enumerate(vals):
+        db.append("serve_breaker_state", {"dest": "r0"}, float(i * 10), v)
+    eng = _engine([{"name": "flap", "kind": "flap",
+                    "metric": "serve_breaker_state", "window_s": 300,
+                    "threshold": 4}], db)
+    assert [t["to"] for t in eng.evaluate(50.0)] == ["firing"]
+
+
+def test_goodput_floor_rule():
+    db = TSDB()
+    for t, disp, other in [(0, 0.0, 0.0), (300, 50.0, 70.0)]:
+        db.append("goodput_seconds_total", {"component": "dispatch"},
+                  float(t), disp)
+        db.append("goodput_seconds_total", {"component": "data_wait_s"},
+                  float(t), other)
+    eng = _engine([{"name": "gp", "kind": "goodput_floor",
+                    "metric": "goodput_seconds_total", "floor": 0.6,
+                    "good_components": ["dispatch"], "window_s": 300}], db)
+    trs = eng.evaluate(300.0)
+    assert [t["to"] for t in trs] == ["firing"]
+    assert abs(trs[0]["value"] - 50.0 / 120.0) < 1e-6
+
+
+def test_baseline_drop_rule_reads_committed_baseline(tmp_path):
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps({
+        "version": 2, "backends": {"cpu": {"cases": {
+            "100m_flash": {"mfu": 0.30}}}}}))
+    rule = {"name": "mfu", "kind": "baseline_drop", "metric": "train_mfu",
+            "baseline_file": str(baseline), "backend": "cpu",
+            "case": "100m_flash", "baseline_key": "mfu",
+            "max_drop_frac": 0.5, "window_s": 300, "min_points": 3}
+    db = TSDB()
+    for i, v in enumerate([0.25, 0.26, 0.24]):
+        db.append("train_mfu", None, float(i * 10), v)
+    assert _engine([dict(rule)], db).evaluate(30.0) == []  # above the floor
+    db2 = TSDB()
+    for i, v in enumerate([0.10, 0.12, 0.11]):
+        db2.append("train_mfu", None, float(i * 10), v)
+    trs = _engine([dict(rule)], db2).evaluate(30.0)
+    assert [t["to"] for t in trs] == ["firing"]
+
+
+def test_latency_burn_rule_over_threshold_share():
+    db = TSDB()
+    # 10 requests in the window, only 2 under the 100ms objective bucket
+    # (the mid-window sample keeps the fast window's increase non-empty)
+    for t, b100, inf, count in [(0, 0, 0, 0), (40, 1, 5, 5),
+                                (60, 2, 10, 10)]:
+        db.append("serve_ttft_ms_bucket", {"le": "100"}, float(t),
+                  float(b100))
+        db.append("serve_ttft_ms_bucket", {"le": "+Inf"}, float(t),
+                  float(inf))
+        db.append("serve_ttft_ms_count", {}, float(t), float(count))
+    eng = _engine([{"name": "lat", "kind": "latency_burn_rate",
+                    "metric": "serve_ttft_ms", "threshold_ms": 100,
+                    "objective": 0.5, "fast_window_s": 30,
+                    "slow_window_s": 60}], db)
+    trs = eng.evaluate(60.0)
+    assert [t["to"] for t in trs] == ["firing"]
+    assert trs[0]["value"] == pytest.approx((0.8) / 0.5)
+
+
+def test_rule_evaluator_bug_reads_as_no_data():
+    db = TSDB()
+    db.append("train_loss", None, 0.0, 1.0)
+    # value: None would crash the threshold evaluator's float() — the
+    # engine must swallow it (no-data), not take down the collector.
+    eng = RuleEngine([{"name": "ok", "kind": "threshold",
+                       "metric": "train_loss", "value": 10.0}], db)
+    eng.states[0].rule["value"] = None
+    assert eng.evaluate(0.0) == []
+    assert eng.states[0].state == "inactive"
+
+
+# -- scrape parsing ---------------------------------------------------------
+
+def test_parse_prom_text_and_json_metrics():
+    text = ("# HELP x y\n# TYPE x counter\n"
+            'x{a="1",b="two"} 3\n'
+            "plain 1.5\n"
+            "bad_value nan_is_fine nope\n")
+    samples = parse_prom_text(text)
+    assert ("x", {"a": "1", "b": "two"}, 3.0) in samples
+    assert ("plain", {}, 1.5) in samples
+    assert len(samples) == 2
+    js = parse_json_metrics({"queue_depth": 3, "tok/s": 12.5,
+                             "engine": "batch", "live": True})
+    assert ("queue_depth", {}, 3.0) in js
+    assert ("tok_s", {}, 12.5) in js  # key normalized, strings/bools skipped
+    assert len(js) == 2
+
+
+# -- collector: scraping through the policy ---------------------------------
+
+def test_collector_scrapes_target_with_instance_label(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("serve_requests_total", "").inc(7, outcome="ok")
+    srv = MetricsServer(reg, port=0)
+    try:
+        cfg = ScopeConfig(targets=[
+            {"name": "router0", "url": f"http://127.0.0.1:{srv.port}",
+             "role": "router"}],
+            run_dir=str(tmp_path / "run"), rules=[])
+        c = Collector(cfg, now_fn=lambda: 1000.0)
+        res = c.collect_once(now=1000.0)
+        assert res["targets"] == 1 and res["up"] == 1
+        pts = c.db.query("serve_requests_total",
+                         {"outcome": "ok", "instance": "router0"})
+        assert pts == [(1000.0, 7.0)]
+        assert c.registry.gauge("graftscope_scrape_up").value(
+            instance="router0") == 1.0
+    finally:
+        srv.shutdown()
+
+
+def test_collector_discovers_fleet_members_and_skips_stale(tmp_path):
+    from mlx_cuda_distributed_pretraining_tpu.serve.fleet import (
+        register_replica)
+    fleet_dir = str(tmp_path / "fleet")
+    p0 = register_replica(fleet_dir, "http://127.0.0.1:1/", role="decode",
+                          index=0)
+    register_replica(fleet_dir, "http://127.0.0.1:2", role="prefill",
+                     index=1)
+    cfg = ScopeConfig(targets=["http://127.0.0.1:3"], fleet_dir=fleet_dir,
+                      rules=[])
+    c = Collector(cfg)
+    names = [t["name"] for t in c.targets()]
+    assert names == sorted(names)
+    assert "decode0" in names and "prefill1" in names
+    # a member whose heartbeat went stale drops out of the scrape set
+    rec = json.load(open(p0))
+    rec["t"] -= 3600.0
+    json.dump(rec, open(p0, "w"))
+    names = [t["name"] for t in c.targets()]
+    assert "decode0" not in names and "prefill1" in names
+
+
+def test_sick_target_never_wedges_the_round(tmp_path):
+    """One live target + one armed with scrape.timeout + one dead port:
+    the round completes, the live target's samples land, the sick ones
+    read up=0, and repeated connect-refusals open the breaker (the next
+    rounds fail fast locally instead of dialing a corpse)."""
+    reg = MetricsRegistry()
+    reg.gauge("serve_queue_depth", "").set(4)
+    live = MetricsServer(reg, port=0)
+    sick = MetricsServer(MetricsRegistry(), port=0)
+    dead_url = f"http://127.0.0.1:{_free_port()}"
+    try:
+        faults.inject("scrape.timeout", every=1, match=f":{sick.port}/")
+        cfg = ScopeConfig(targets=[
+            {"name": "live0", "url": f"http://127.0.0.1:{live.port}"},
+            {"name": "sick0", "url": f"http://127.0.0.1:{sick.port}"},
+            {"name": "dead0", "url": dead_url}],
+            rules=[], scrape_timeout_s=2.0)
+        c = Collector(cfg)
+        for i in range(6):
+            res = c.collect_once(now=1000.0 + i)
+            assert res["up"] == 1  # the round always completes
+        up = c.registry.gauge("graftscope_scrape_up")
+        assert up.value(instance="live0") == 1.0
+        assert up.value(instance="sick0") == 0.0
+        assert up.value(instance="dead0") == 0.0
+        assert c.db.query("serve_queue_depth", {"instance": "live0"})
+        # 6 consecutive connect-refusals exceed breaker_threshold=5
+        assert c.policy.breaker_state(dead_url) == "open"
+        errs = c.registry.counter("graftscope_scrape_errors_total")
+        assert errs.value(instance="sick0") == 6.0
+        assert errs.value(instance="dead0") == 6.0
+    finally:
+        live.shutdown()
+        sick.shutdown()
+
+
+# -- the deterministic chaos drill ------------------------------------------
+
+BURN_RULE = {
+    "name": "router-error-burn", "kind": "error_burn_rate",
+    "metric": "serve_router_requests_total",
+    "bad_label": "outcome", "bad_values": ["error"],
+    "objective": 0.9, "fast_window_s": 30, "slow_window_s": 60,
+    "for_s": 15, "actions": ["bundle"],
+}
+
+
+def _drill(run_dir, serve_port_holder=None):
+    """One scripted outage on a logical clock: 3 clean rounds, 4 rounds
+    of errors, 5 clean recovery rounds, 10 s apart. A second target is
+    kept permanently sick through the graftchaos scrape.timeout point.
+    Returns (timeline, alerts_doc, bundle_listing)."""
+    faults.reset()
+    reg = MetricsRegistry()
+    req = reg.counter("serve_router_requests_total", "")
+    router = MetricsServer(reg, port=0)
+    ghost = MetricsServer(MetricsRegistry(), port=0)
+    clock = {"t": 1000.0}
+    try:
+        faults.inject("scrape.timeout", every=1, match=f":{ghost.port}/")
+        cfg = ScopeConfig(targets=[
+            {"name": "router0", "url": f"http://127.0.0.1:{router.port}",
+             "role": "router"},
+            {"name": "ghost0", "url": f"http://127.0.0.1:{ghost.port}",
+             "role": "decode"}],
+            run_dir=str(run_dir), rules=[dict(BURN_RULE)],
+            port=0 if serve_port_holder is not None else None)
+        c = Collector(cfg, now_fn=lambda: clock["t"])
+        if serve_port_holder is not None:
+            serve_port_holder.append(c)
+        script = ["ok"] * 3 + ["error"] * 4 + ["ok"] * 5
+        for outcome in script:
+            req.inc(10, outcome=outcome)
+            c.collect_once(now=clock["t"])
+            clock["t"] += 10.0
+        timeline = c.alerts()["timeline"]
+        alerts_doc = c.alerts()["alerts"]
+        bdir = os.path.join(str(run_dir), "bundles")
+        listing = []
+        for root, dirs, files in os.walk(bdir):
+            rel = os.path.relpath(root, bdir)
+            for f in sorted(files):
+                listing.append(os.path.join(rel, f))
+            dirs.sort()
+        if serve_port_holder is None:
+            c.stop()
+        return timeline, alerts_doc, sorted(listing)
+    finally:
+        router.shutdown()
+        ghost.shutdown()
+
+
+def test_chaos_drill_alert_lifecycle_and_bundle(tmp_path):
+    holder = []
+    timeline, alerts_doc, listing = _drill(tmp_path / "run", holder)
+    c = holder[0]
+    try:
+        # pending inside the burn windows, firing after for_s, resolved
+        # after the fault window drains out of both windows
+        trans = [(t["from"], t["to"], t["t"]) for t in timeline]
+        assert [x[:2] for x in trans] == [
+            ("inactive", "pending"), ("pending", "firing"),
+            ("firing", "resolved")]
+        t_pending, t_firing, t_resolved = (x[2] for x in trans)
+        assert t_firing - t_pending >= BURN_RULE["for_s"]
+        assert t_resolved > t_firing
+        # the bundle captured at fire time names every member; the live
+        # router contributed its snapshots, the sick ghost a bare dir
+        bdir = os.path.join(str(tmp_path / "run"), "bundles",
+                            "router-error-burn_%d" % int(t_firing))
+        meta = json.load(open(os.path.join(bdir, "alert.json")))
+        assert meta["alert"]["rule"] == "router-error-burn"
+        assert meta["members"] == ["ghost0", "router0"]
+        assert os.path.isfile(os.path.join(bdir, "router0", "metrics.txt"))
+        assert os.path.isfile(os.path.join(bdir, "router0",
+                                           "snapshot.json"))
+        assert os.path.isdir(os.path.join(bdir, "ghost0"))
+        assert os.path.isfile(os.path.join(bdir, "events_tail.jsonl"))
+        # alert events landed in events.jsonl with logical timestamps
+        evs = [e for e in iter_events(
+            os.path.join(str(tmp_path / "run"), "events.jsonl"))
+            if e.get("type") == "alert"]
+        assert len(evs) == 3
+        assert all(float(e["t"]).is_integer() for e in evs)
+        # the firing gauge and GET /alerts agree with the final state
+        assert c.registry.gauge("graftscope_alerts_firing").value(
+            rule="router-error-burn") == 0.0
+        url = f"http://127.0.0.1:{c.server.port}/alerts"
+        doc = json.loads(urllib.request.urlopen(url, timeout=5).read())
+        assert doc["alerts"][0]["state"] == "inactive"
+        assert doc["alerts"][0]["fire_count"] == 1
+        assert len(doc["timeline"]) == 3
+    finally:
+        c.stop()
+
+
+def test_chaos_drill_is_bit_identical_across_runs(tmp_path):
+    t1, a1, l1 = _drill(tmp_path / "run_a")
+    t2, a2, l2 = _drill(tmp_path / "run_b")
+    assert json.dumps(t1, sort_keys=True) == json.dumps(t2, sort_keys=True)
+    assert json.dumps(a1, sort_keys=True) == json.dumps(a2, sort_keys=True)
+    assert l1 == l2 and l1  # same bundles, and there are bundles
+    ev_a = open(tmp_path / "run_a" / "events.jsonl", "rb").read()
+    ev_b = open(tmp_path / "run_b" / "events.jsonl", "rb").read()
+    assert ev_a == ev_b  # byte-for-byte: logical clock all the way down
+
+
+# -- scope_report ------------------------------------------------------------
+
+def test_scope_report_renders_timeline_and_sparklines(tmp_path, capsys):
+    import scope_report  # via the scripts/ path hook below
+
+    _drill(tmp_path / "run")
+    rc = scope_report.main([str(tmp_path / "run"),
+                            "--series", "serve_router_requests_total"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "alert_transitions=3" in out
+    assert "rule=router-error-burn episodes=1" in out
+    assert "inactive->pending" in out and "firing->resolved" in out
+    assert "bundle=router-error-burn_" in out and "members=2" in out
+    assert "series=serve_router_requests_total{" in out
+
+
+def _import_scripts_path():
+    import sys
+    scripts = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+
+
+_import_scripts_path()
+
+
+# -- config plumbing ---------------------------------------------------------
+
+def test_scope_config_from_yaml_block(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text("scope:\n  interval_s: 2.5\n  port: 0\n"
+                 "  targets: [\"http://127.0.0.1:9\"]\n"
+                 "  max_points: 64\n")
+    cfg = ScopeConfig.from_yaml(str(p))
+    assert cfg.interval_s == 2.5 and cfg.max_points == 64
+    assert cfg.targets == ["http://127.0.0.1:9"]
+
+
+def test_shipped_sample_configs_carry_scope_blocks():
+    import yaml
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for fname in ("configs/serve-sample.yaml",
+                  "configs/model-config-sample.yaml"):
+        with open(os.path.join(repo, fname)) as fh:
+            doc = yaml.safe_load(fh)
+        assert "scope" in doc, fname
+        cfg = ScopeConfig.from_dict(doc["scope"])
+        assert cfg.interval_s > 0 and cfg.alerts_path

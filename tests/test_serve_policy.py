@@ -70,6 +70,18 @@ def _disarm_faults():
     faults.reset()
 
 
+@pytest.fixture
+def _clean_fault_registry():
+    """Registry hygiene for the thread-heavy drills, both directions: the
+    autouse fixture only resets AFTER a test, so a rule leaked by an
+    earlier test that died before its teardown (or armed in a still-draining
+    background thread) could tear this test's first stream. Reset before
+    AND after so these drills always start from a silent registry."""
+    faults.reset()
+    yield
+    faults.reset()
+
+
 def _engine(**kw):
     cfg = EngineConfig(**{"num_slots": 2, "max_len": MAX_LEN,
                           "prefill_chunk": 16, **kw})
@@ -445,7 +457,9 @@ def test_generate_wait_derives_from_default_deadline(monkeypatch):
 
 # -- router stream retry through the choke point (tiny model) -----------------
 
-def test_router_stream_retries_before_first_byte_on_truncation():
+@pytest.mark.serial
+def test_router_stream_retries_before_first_byte_on_truncation(
+        _clean_fault_registry):
     sa, ha, ua = _replica()
     sb, hb, ub = _replica()
     router = Router([ua, ub], poll_interval_s=30.0, retries=2)
@@ -484,7 +498,9 @@ def test_router_stream_retries_before_first_byte_on_truncation():
 
 # -- KV corrupt/drop -> quarantine -> local-prefill fallback (tiny model) -----
 
-def test_kv_corrupt_quarantined_then_local_prefill_fallback():
+@pytest.mark.serial
+def test_kv_corrupt_quarantined_then_local_prefill_fallback(
+        _clean_fault_registry):
     pre_s, pre_h, pre_url = _replica(prefix_cache=True, block_size=16,
                                      role="prefill")
     dec_s, dec_h, dec_url = _replica(prefix_cache=True, block_size=16,

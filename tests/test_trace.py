@@ -221,7 +221,8 @@ def test_engine_tracing_spans_breakdown_and_ttft_histograms():
              for s in snap["serve_ttft_component_ms"]["series"]}
     assert {"queue", "prefill", "decode"} <= comps
     assert eng._ttft_quantiles().keys() == {
-        "ttft_ms_p50", "ttft_ms_p95", "ttft_ms_p99"}
+        "ttft_ms_p50", "ttft_ms_p95", "ttft_ms_p99",
+        "ttft_ms_sum", "ttft_ms_count"}
 
 
 def test_engine_tracing_disabled_records_nothing_on_hot_path():
